@@ -1,0 +1,49 @@
+"""ray_tpu — a TPU-native distributed computing framework.
+
+Task/actor/object-store runtime with the capabilities of the Ray v1.2-era
+core (reference: /root/reference, photoszzt/ray), redesigned TPU-first:
+XLA-collective data plane over ICI, jit/pjit compute, slice-aware
+scheduling, and JAX-native ML libraries (train/tune/rllib/serve) on top.
+"""
+
+from ray_tpu._version import __version__
+from ray_tpu import exceptions
+from ray_tpu.actor import ActorClass, ActorHandle, exit_actor
+from ray_tpu.api import (
+    available_resources,
+    cancel,
+    cluster_resources,
+    get,
+    get_actor,
+    init,
+    is_initialized,
+    kill,
+    nodes,
+    put,
+    remote,
+    shutdown,
+    wait,
+)
+from ray_tpu.object_ref import ObjectRef
+
+__all__ = [
+    "ActorClass",
+    "ActorHandle",
+    "ObjectRef",
+    "__version__",
+    "available_resources",
+    "cancel",
+    "cluster_resources",
+    "exceptions",
+    "exit_actor",
+    "get",
+    "get_actor",
+    "init",
+    "is_initialized",
+    "kill",
+    "nodes",
+    "put",
+    "remote",
+    "shutdown",
+    "wait",
+]

@@ -1,0 +1,108 @@
+"""Global config registry — the RAY_CONFIG-equivalent.
+
+The reference defines ~90 `RAY_CONFIG(type, name, default)` flags in a single
+header (reference: src/ray/common/ray_config_def.h) initialized from a JSON
+`_system_config` and propagated to every spawned process. We keep the same
+single-source-of-truth + env/JSON override design: every knob is declared
+here, overridable via the RAY_TPU_SYSTEM_CONFIG env var (JSON) or the
+`_system_config` argument to `ray_tpu.init`, and child processes inherit the
+merged dict through that env var.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any
+
+_ENV_VAR = "RAY_TPU_SYSTEM_CONFIG"
+
+
+@dataclasses.dataclass
+class Config:
+    # --- object plane ---
+    # Objects at or below this size are passed inline through the owner's
+    # in-process memory store instead of the shared-memory store
+    # (reference: ray_config_def.h max_direct_call_object_size=100KB).
+    max_direct_call_object_size: int = 100 * 1024
+    # Default shared-memory store capacity per node (bytes).
+    object_store_memory: int = 2 * 1024**3
+    # Chunk size for node-to-node object transfer.
+    object_transfer_chunk_size: int = 5 * 1024**2
+    # Spill directory ("" = session dir /spill).
+    object_spilling_path: str = ""
+    # Spill when store usage exceeds this fraction.
+    object_spilling_threshold: float = 0.8
+
+    # --- control plane ---
+    # Heartbeat cadence + miss tolerance (reference: raylet 100ms beats,
+    # declared dead after 300 misses; we beat less often, die faster).
+    heartbeat_interval_s: float = 0.5
+    num_heartbeats_timeout: int = 20
+    gcs_port: int = 0  # 0 = pick free port
+
+    # --- scheduling ---
+    # Max in-flight lease-reused tasks pushed to one worker
+    # (reference: direct_task_transport.h max_tasks_in_flight_per_worker).
+    max_tasks_in_flight_per_worker: int = 10
+    # Initial worker-pool size per node; workers are also started on demand.
+    # -1 = auto (min(num_cpus, 8)). Prestarting matters on TPU hosts: every
+    # Python start pays the jax/plugin import cost, so cold workers are slow.
+    num_initial_workers: int = -1
+    # Hard cap on worker processes per node (0 = num_cpus).
+    max_workers_per_node: int = 0
+    worker_register_timeout_s: float = 30.0
+
+    # --- fault tolerance ---
+    task_max_retries: int = 3
+    actor_max_restarts: int = 0
+    lineage_pinning_enabled: bool = True
+
+    # --- TPU topology ---
+    # Logical ICI slice size used by the slice-aware scheduler when packing
+    # STRICT_PACK placement groups onto TPU hosts.
+    tpu_slice_hosts: int = 1
+    tpu_chips_per_host: int = 4
+
+    # --- rpc ---
+    rpc_connect_timeout_s: float = 10.0
+    rpc_call_timeout_s: float = 0.0  # 0 = no timeout
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self))
+
+    @classmethod
+    def load(cls, overrides: dict[str, Any] | None = None) -> "Config":
+        cfg = cls()
+        env = os.environ.get(_ENV_VAR)
+        merged: dict[str, Any] = {}
+        if env:
+            merged.update(json.loads(env))
+        if overrides:
+            merged.update(overrides)
+        known = {f.name for f in dataclasses.fields(cls)}
+        for key, value in merged.items():
+            if key not in known:
+                raise ValueError(f"Unknown system config key: {key}")
+            setattr(cfg, key, value)
+        return cfg
+
+    def child_env(self) -> dict[str, str]:
+        """Env vars to propagate this config to spawned processes."""
+        return {_ENV_VAR: self.to_json()}
+
+
+_global_config: Config | None = None
+
+
+def get_config() -> Config:
+    global _global_config
+    if _global_config is None:
+        _global_config = Config.load()
+    return _global_config
+
+
+def set_config(cfg: Config) -> None:
+    global _global_config
+    _global_config = cfg

@@ -1,0 +1,27 @@
+"""Per-process logging setup: each service logs to its own file in the
+session dir (reference behavior: per-process files in the session dir,
+src/ray/util/logging.h RAY_LOG + python log_monitor tailing)."""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+
+def setup_process_logging(name: str, log_file: str | None = None,
+                          level=logging.INFO):
+    fmt = logging.Formatter(
+        f"[%(asctime)s %(levelname).1s {name} pid={os.getpid()}] "
+        "%(name)s: %(message)s"
+    )
+    root = logging.getLogger()
+    root.setLevel(level)
+    if log_file:
+        os.makedirs(os.path.dirname(log_file), exist_ok=True)
+        handler: logging.Handler = logging.FileHandler(log_file)
+    else:
+        handler = logging.StreamHandler(sys.stderr)
+    handler.setFormatter(fmt)
+    root.addHandler(handler)
+    return root

@@ -1,0 +1,101 @@
+"""In-process memory store: futures + small-object values.
+
+The analog of the reference's CoreWorkerMemoryStore (reference:
+src/ray/core_worker/store_provider/memory_store/memory_store.h:26): every
+ObjectRef known to this process resolves here first. An entry is either
+PENDING (a future — the producing task hasn't replied yet), a concrete
+value, an error, or IN_PLASMA (sentinel meaning: fetch the bytes from the
+shared-memory store).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+from ray_tpu._private.ids import ObjectID
+
+IN_PLASMA = object()  # sentinel value
+
+
+class _Entry:
+    __slots__ = ("value", "is_exception", "ready")
+
+    def __init__(self):
+        self.value = None
+        self.is_exception = False
+        self.ready = False
+
+
+class MemoryStore:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._entries: dict[ObjectID, _Entry] = {}
+
+    def open(self, object_id: ObjectID) -> None:
+        """Ensure a pending entry exists (called at submit time)."""
+        with self._lock:
+            self._entries.setdefault(object_id, _Entry())
+
+    def put(self, object_id: ObjectID, value: Any, is_exception=False) -> None:
+        with self._cv:
+            entry = self._entries.setdefault(object_id, _Entry())
+            if entry.ready:
+                return  # first write wins
+            entry.value = value
+            entry.is_exception = is_exception
+            entry.ready = True
+            self._cv.notify_all()
+
+    def put_in_plasma(self, object_id: ObjectID) -> None:
+        self.put(object_id, IN_PLASMA)
+
+    def contains(self, object_id: ObjectID) -> bool:
+        with self._lock:
+            entry = self._entries.get(object_id)
+            return entry is not None and entry.ready
+
+    def get_if_ready(self, object_id: ObjectID):
+        """Returns (found, value, is_exception)."""
+        with self._lock:
+            entry = self._entries.get(object_id)
+            if entry is None or not entry.ready:
+                return False, None, False
+            return True, entry.value, entry.is_exception
+
+    def wait(self, object_ids, num_returns: int, timeout: float | None):
+        """Block until num_returns of object_ids are ready. Returns ready set."""
+        deadline = None
+        if timeout is not None:
+            deadline = threading.TIMEOUT_MAX if timeout < 0 else timeout
+
+        def ready_set():
+            return {
+                oid
+                for oid in object_ids
+                if (e := self._entries.get(oid)) is not None and e.ready
+            }
+
+        import time
+
+        end = time.monotonic() + deadline if deadline is not None else None
+        with self._cv:
+            while True:
+                ready = ready_set()
+                if len(ready) >= num_returns:
+                    return ready
+                remaining = None
+                if end is not None:
+                    remaining = end - time.monotonic()
+                    if remaining <= 0:
+                        return ready
+                self._cv.wait(remaining)
+
+    def delete(self, object_id: ObjectID) -> None:
+        with self._lock:
+            self._entries.pop(object_id, None)
+
+    def size(self) -> int:
+        with self._lock:
+            return len(self._entries)

@@ -1,0 +1,160 @@
+"""Node bootstrap: spawn/stop the GCS and raylet service processes
+(reference: python/ray/node.py:52 Node, start_head_processes :854,
+start_ray_processes :875; python/ray/_private/services.py spawners)."""
+
+from __future__ import annotations
+
+import atexit
+import json
+import logging
+import os
+import signal
+import subprocess
+import sys
+import time
+import uuid
+
+from ray_tpu._private.config import Config
+from ray_tpu._private.ids import NodeID
+from ray_tpu._private.object_store import default_store_root
+
+logger = logging.getLogger("ray_tpu.node")
+
+
+def new_session_dir() -> str:
+    base = os.environ.get("RAY_TPU_TMPDIR", "/tmp/ray_tpu")
+    session = f"session_{time.strftime('%Y%m%d_%H%M%S')}_{uuid.uuid4().hex[:8]}"
+    path = os.path.join(base, session)
+    os.makedirs(os.path.join(path, "logs"), exist_ok=True)
+    return path
+
+
+def _wait_ready(ready_file: str, proc: subprocess.Popen, what: str,
+                timeout: float = 30.0) -> str:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if os.path.exists(ready_file):
+            with open(ready_file) as f:
+                return f.read().strip()
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"{what} exited with code {proc.returncode} during startup")
+        time.sleep(0.02)
+    raise TimeoutError(f"{what} did not become ready in {timeout}s")
+
+
+class ServiceProcess:
+    def __init__(self, name: str, proc: subprocess.Popen):
+        self.name = name
+        self.proc = proc
+
+    def alive(self) -> bool:
+        return self.proc.poll() is None
+
+    def kill(self, sig=signal.SIGKILL):
+        if self.alive():
+            try:
+                os.killpg(os.getpgid(self.proc.pid), sig)
+            except (ProcessLookupError, PermissionError):
+                try:
+                    self.proc.kill()
+                except ProcessLookupError:
+                    pass
+        try:
+            self.proc.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            pass
+
+
+def _spawn(cmd: list[str], config: Config, name: str) -> ServiceProcess:
+    env = dict(os.environ)
+    env.update(config.child_env())
+    proc = subprocess.Popen(
+        cmd, env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        start_new_session=True)
+    return ServiceProcess(name, proc)
+
+
+def start_gcs(session_dir: str, config: Config, port: int = 0) -> tuple[ServiceProcess, str]:
+    ready = os.path.join(session_dir, f"gcs_ready_{uuid.uuid4().hex[:6]}")
+    log_file = os.path.join(session_dir, "logs", "gcs_server.log")
+    svc = _spawn([
+        sys.executable, "-m", "ray_tpu.gcs.server",
+        "--port", str(port),
+        "--ready-file", ready,
+        "--log-file", log_file,
+    ], config, "gcs_server")
+    actual_port = _wait_ready(ready, svc.proc, "gcs_server")
+    return svc, f"127.0.0.1:{actual_port}"
+
+
+def start_raylet(session_dir: str, gcs_address: str, config: Config, *,
+                 node_id: NodeID | None = None, num_cpus: float | None = None,
+                 num_tpus: float = 0, resources: dict | None = None,
+                 labels: dict | None = None, is_head=False,
+                 store_root: str | None = None) -> tuple[ServiceProcess, str, NodeID, str]:
+    node_id = node_id or NodeID.from_random()
+    ready = os.path.join(session_dir, f"raylet_ready_{node_id.hex()[:8]}")
+    log_file = os.path.join(session_dir, "logs",
+                            f"raylet-{node_id.hex()[:8]}.log")
+    if store_root is None:
+        store_root = os.path.join(default_store_root(session_dir),
+                                  node_id.hex()[:8])
+    cmd = [
+        sys.executable, "-m", "ray_tpu.raylet.raylet",
+        "--gcs-address", gcs_address,
+        "--session-dir", session_dir,
+        "--store-root", store_root,
+        "--node-id", node_id.hex(),
+        "--resources", json.dumps(resources or {}),
+        "--labels", json.dumps(labels or {}),
+        "--ready-file", ready,
+        "--log-file", log_file,
+    ]
+    if num_cpus is not None:
+        cmd += ["--num-cpus", str(num_cpus)]
+    if num_tpus:
+        cmd += ["--num-tpus", str(num_tpus)]
+    if is_head:
+        cmd += ["--is-head"]
+    svc = _spawn(cmd, config, f"raylet-{node_id.hex()[:8]}")
+    address = _wait_ready(ready, svc.proc, "raylet")
+    return svc, address, node_id, store_root
+
+
+class Node:
+    """A local cluster head (GCS + one raylet) or an added worker node."""
+
+    def __init__(self, *, config: Config, session_dir: str | None = None,
+                 gcs_address: str | None = None, num_cpus=None, num_tpus=0,
+                 resources=None, labels=None):
+        self.config = config
+        self.session_dir = session_dir or new_session_dir()
+        self.processes: list[ServiceProcess] = []
+        self.is_head = gcs_address is None
+        if gcs_address is None:
+            gcs_proc, gcs_address = start_gcs(self.session_dir, config,
+                                              config.gcs_port)
+            self.processes.append(gcs_proc)
+        self.gcs_address = gcs_address
+        raylet_proc, raylet_addr, node_id, store_root = start_raylet(
+            self.session_dir, gcs_address, config,
+            num_cpus=num_cpus, num_tpus=num_tpus, resources=resources,
+            labels=labels, is_head=self.is_head)
+        self.processes.append(raylet_proc)
+        self.raylet_address = raylet_addr
+        self.node_id = node_id
+        self.store_root = store_root
+        atexit.register(self.kill_all_processes)
+
+    def kill_all_processes(self):
+        for svc in reversed(self.processes):
+            svc.kill()
+        self.processes.clear()
+
+    def kill_raylet(self):
+        """Fault injection: kill this node's raylet (reference test idiom:
+        Node._kill_process_type, node.py:894)."""
+        for svc in self.processes:
+            if svc.name.startswith("raylet"):
+                svc.kill()

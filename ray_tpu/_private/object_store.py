@@ -1,0 +1,145 @@
+"""Node-local shared-memory object store (the plasma equivalent).
+
+Capability parity with the reference's plasma store (reference:
+src/ray/object_manager/plasma/store.h:53, client.h) with a TPU-host-native
+design instead of a store server process + fd passing: each sealed object is
+one file under /dev/shm/<session>/objects, created as `<hex>.build`, written
+through mmap, and sealed by an atomic rename. Any process on the node mmaps
+sealed objects read-only — creation and reads are zero-copy and lock-free;
+there is no store server in the data path at all. Capacity accounting,
+eviction, and spill-to-disk live in the raylet's LocalObjectManager
+(reference: src/ray/raylet/local_object_manager.h), which is the only
+deleter. A C++ slab-allocator backend can replace the file-per-object layout
+behind this same interface (see native/store).
+"""
+
+from __future__ import annotations
+
+import mmap
+import os
+import tempfile
+
+from ray_tpu._private.ids import ObjectID
+
+
+class ObjectBuffer:
+    """A writable or read-only mmap view of one object."""
+
+    def __init__(self, path: str, size: int, create: bool):
+        self.path = path
+        self.size = size
+        if create:
+            fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_RDWR, 0o600)
+            try:
+                os.ftruncate(fd, size)
+                self._mmap = mmap.mmap(fd, size) if size else None
+            finally:
+                os.close(fd)
+        else:
+            fd = os.open(path, os.O_RDONLY)
+            try:
+                size = os.fstat(fd).st_size
+                self.size = size
+                self._mmap = (
+                    mmap.mmap(fd, size, prot=mmap.PROT_READ) if size else None
+                )
+            finally:
+                os.close(fd)
+        self.view = memoryview(self._mmap) if self._mmap else memoryview(b"")
+
+    def close(self):
+        try:
+            self.view.release()
+            if self._mmap is not None:
+                self._mmap.close()
+        except (BufferError, ValueError):
+            # Still-referenced views keep the mapping alive; the OS reclaims
+            # on process exit.
+            pass
+
+
+class LocalObjectStore:
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _path(self, object_id: ObjectID) -> str:
+        return os.path.join(self.root, object_id.hex())
+
+    def create(self, object_id: ObjectID, size: int) -> ObjectBuffer:
+        return ObjectBuffer(self._path(object_id) + ".build", size, create=True)
+
+    def seal(self, object_id: ObjectID) -> None:
+        os.rename(self._path(object_id) + ".build", self._path(object_id))
+
+    def abort(self, object_id: ObjectID) -> None:
+        try:
+            os.unlink(self._path(object_id) + ".build")
+        except FileNotFoundError:
+            pass
+
+    def contains(self, object_id: ObjectID) -> bool:
+        return os.path.exists(self._path(object_id))
+
+    def get(self, object_id: ObjectID) -> ObjectBuffer | None:
+        try:
+            return ObjectBuffer(self._path(object_id), 0, create=False)
+        except FileNotFoundError:
+            return None
+
+    def size_of(self, object_id: ObjectID) -> int:
+        return os.stat(self._path(object_id)).st_size
+
+    def delete(self, object_id: ObjectID) -> int:
+        """Returns freed bytes."""
+        try:
+            size = self.size_of(object_id)
+            os.unlink(self._path(object_id))
+            return size
+        except FileNotFoundError:
+            return 0
+
+    def put_serialized(self, object_id: ObjectID, header: bytes,
+                       buffers: list[memoryview]) -> int:
+        """Write header+buffers and seal. Returns total size."""
+        total = len(header) + sum(b.nbytes for b in buffers)
+        buf = self.create(object_id, total)
+        try:
+            view = buf.view
+            view[: len(header)] = header
+            offset = len(header)
+            for b in buffers:
+                flat = b.cast("B") if b.ndim != 1 or b.format != "B" else b
+                view[offset : offset + flat.nbytes] = flat
+                offset += flat.nbytes
+            buf.close()
+            self.seal(object_id)
+        except BaseException:
+            buf.close()
+            self.abort(object_id)
+            raise
+        return total
+
+    def put_bytes(self, object_id: ObjectID, data: bytes | memoryview) -> int:
+        return self.put_serialized(object_id, b"", [memoryview(data).cast("B")])
+
+    def list_objects(self) -> list[ObjectID]:
+        out = []
+        for name in os.listdir(self.root):
+            if not name.endswith(".build"):
+                try:
+                    out.append(ObjectID.from_hex(name))
+                except ValueError:
+                    pass
+        return out
+
+
+def default_store_root(session_dir: str) -> str:
+    """Prefer /dev/shm (true shared memory) when available."""
+    shm = "/dev/shm"
+    if os.path.isdir(shm) and os.access(shm, os.W_OK):
+        base = os.path.join(shm, "ray_tpu", os.path.basename(session_dir))
+    else:  # pragma: no cover
+        base = os.path.join(tempfile.gettempdir(), "ray_tpu_store",
+                            os.path.basename(session_dir))
+    return os.path.join(base, "objects")

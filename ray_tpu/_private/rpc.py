@@ -1,0 +1,300 @@
+"""Lightweight asyncio RPC: length-prefixed msgpack frames over UDS/TCP.
+
+Plays the role of the reference's gRPC layer (reference: src/ray/rpc/
+grpc_server.h, grpc_client.h, client_call.h) for the control plane. Design
+differences are deliberate: a single multiplexed duplex connection per
+client with integer-correlated requests, msgpack payloads (bytes pass
+through zero-copy on the read side), and first-class server->client pushes
+(used for pubsub and task dispatch) instead of gRPC streaming.
+
+Wire format: 4-byte big-endian frame length, then
+    msgpack([msgtype, msgid, method, data])
+msgtype: 0=request 1=reply-ok 2=reply-err 3=oneway 4=push.
+`data` is any msgpack value; application payloads that need pickling are
+passed as bytes.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import logging
+import pickle
+import socket
+import struct
+import threading
+import traceback
+from typing import Any, Awaitable, Callable
+
+import msgpack
+
+logger = logging.getLogger(__name__)
+
+REQUEST, REPLY_OK, REPLY_ERR, ONEWAY, PUSH = 0, 1, 2, 3, 4
+
+_HDR = struct.Struct(">I")
+_MAX_FRAME = 1 << 31
+
+
+class RpcError(Exception):
+    pass
+
+
+class RemoteError(RpcError):
+    """Handler on the other side raised; carries its pickled exception."""
+
+    def __init__(self, exc: BaseException, tb: str):
+        self.exc = exc
+        self.tb = tb
+        super().__init__(f"{exc!r}\nRemote traceback:\n{tb}")
+
+
+class ConnectionLost(RpcError):
+    pass
+
+
+def _pack(msg) -> bytes:
+    body = msgpack.packb(msg, use_bin_type=True)
+    return _HDR.pack(len(body)) + body
+
+
+async def _read_frame(reader: asyncio.StreamReader):
+    hdr = await reader.readexactly(_HDR.size)
+    (length,) = _HDR.unpack(hdr)
+    if length > _MAX_FRAME:
+        raise RpcError(f"frame too large: {length}")
+    body = await reader.readexactly(length)
+    return msgpack.unpackb(body, raw=False)
+
+
+class Connection:
+    """One duplex connection; usable as both caller and callee side."""
+
+    def __init__(self, reader, writer, handlers, on_disconnect=None, name=""):
+        self._reader = reader
+        self._writer = writer
+        self._handlers = handlers
+        self._on_disconnect = on_disconnect
+        self.name = name
+        self._msgid = itertools.count(1)
+        self._pending: dict[int, asyncio.Future] = {}
+        self._push_handler: Callable[[str, Any], Awaitable[None]] | None = None
+        self._send_lock = asyncio.Lock()
+        self._closed = False
+        self._reader_task = asyncio.create_task(self._read_loop())
+        # Opaque per-connection state slot for servers (e.g. worker identity).
+        self.context: dict[str, Any] = {}
+
+    def set_push_handler(self, fn):
+        self._push_handler = fn
+
+    async def _read_loop(self):
+        try:
+            while True:
+                msg = await _read_frame(self._reader)
+                msgtype = msg[0]
+                if msgtype == REQUEST:
+                    asyncio.create_task(self._dispatch(msg[1], msg[2], msg[3]))
+                elif msgtype in (REPLY_OK, REPLY_ERR):
+                    fut = self._pending.pop(msg[1], None)
+                    if fut is not None and not fut.done():
+                        if msgtype == REPLY_OK:
+                            fut.set_result(msg[3])
+                        else:
+                            exc, tb = pickle.loads(msg[3][0]), msg[3][1]
+                            fut.set_exception(RemoteError(exc, tb))
+                elif msgtype == ONEWAY:
+                    asyncio.create_task(self._dispatch(None, msg[2], msg[3]))
+                elif msgtype == PUSH:
+                    if self._push_handler is not None:
+                        asyncio.create_task(self._push_handler(msg[2], msg[3]))
+        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+            pass
+        except Exception:
+            logger.exception("rpc read loop error (%s)", self.name)
+        finally:
+            await self._shutdown()
+
+    async def _shutdown(self):
+        if self._closed:
+            return
+        self._closed = True
+        for fut in self._pending.values():
+            if not fut.done():
+                fut.set_exception(ConnectionLost(f"connection {self.name} lost"))
+        self._pending.clear()
+        try:
+            self._writer.close()
+        except Exception:
+            pass
+        if self._on_disconnect is not None:
+            try:
+                await self._on_disconnect(self)
+            except Exception:
+                logger.exception("on_disconnect callback failed")
+
+    async def _dispatch(self, msgid, method, data):
+        handler = self._handlers.get(method)
+        try:
+            if handler is None:
+                raise RpcError(f"no handler for method {method!r}")
+            result = handler(self, data)
+            if asyncio.iscoroutine(result):
+                result = await result
+            if msgid is not None:
+                await self._send([REPLY_OK, msgid, method, result])
+        except Exception as e:
+            if msgid is not None:
+                payload = [pickle.dumps(e), traceback.format_exc()]
+                try:
+                    await self._send([REPLY_ERR, msgid, method, payload])
+                except Exception:
+                    pass
+            else:
+                logger.exception("oneway handler %s failed", method)
+
+    async def _send(self, msg):
+        if self._closed:
+            raise ConnectionLost(f"connection {self.name} closed")
+        data = _pack(msg)
+        async with self._send_lock:
+            self._writer.write(data)
+            await self._writer.drain()
+
+    async def call(self, method: str, data: Any = None, timeout: float | None = None):
+        msgid = next(self._msgid)
+        fut = asyncio.get_running_loop().create_future()
+        self._pending[msgid] = fut
+        await self._send([REQUEST, msgid, method, data])
+        if timeout:
+            return await asyncio.wait_for(fut, timeout)
+        return await fut
+
+    async def notify(self, method: str, data: Any = None):
+        await self._send([ONEWAY, None, method, data])
+
+    async def push(self, channel: str, data: Any = None):
+        await self._send([PUSH, None, channel, data])
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    async def close(self):
+        self._reader_task.cancel()
+        await self._shutdown()
+
+
+class Server:
+    """RPC server bound to a UDS path and/or TCP port."""
+
+    def __init__(self, handlers: dict[str, Callable], on_disconnect=None,
+                 on_connect=None, name="server"):
+        self.handlers = handlers
+        self.on_disconnect = on_disconnect
+        self.on_connect = on_connect
+        self.name = name
+        self._servers: list[asyncio.AbstractServer] = []
+        self.connections: set[Connection] = set()
+        self.tcp_port: int | None = None
+
+    async def _accept(self, reader, writer):
+        conn = Connection(reader, writer, self.handlers,
+                          on_disconnect=self._handle_disconnect, name=self.name)
+        self.connections.add(conn)
+        if self.on_connect is not None:
+            try:
+                res = self.on_connect(conn)
+                if asyncio.iscoroutine(res):
+                    await res
+            except Exception:
+                logger.exception("on_connect failed")
+
+    async def _handle_disconnect(self, conn):
+        self.connections.discard(conn)
+        if self.on_disconnect is not None:
+            res = self.on_disconnect(conn)
+            if asyncio.iscoroutine(res):
+                await res
+
+    async def start_unix(self, path: str):
+        srv = await asyncio.start_unix_server(self._accept, path=path)
+        self._servers.append(srv)
+
+    async def start_tcp(self, host: str = "127.0.0.1", port: int = 0):
+        srv = await asyncio.start_server(self._accept, host=host, port=port)
+        self.tcp_port = srv.sockets[0].getsockname()[1]
+        self._servers.append(srv)
+        return self.tcp_port
+
+    async def close(self):
+        for srv in self._servers:
+            srv.close()
+        for conn in list(self.connections):
+            await conn.close()
+
+
+async def connect(address: str, handlers: dict | None = None,
+                  on_disconnect=None, name="client",
+                  timeout: float = 10.0) -> Connection:
+    """address: 'unix:/path' or 'host:port'."""
+    deadline = asyncio.get_running_loop().time() + timeout
+    last_err: Exception | None = None
+    while asyncio.get_running_loop().time() < deadline:
+        try:
+            if address.startswith("unix:"):
+                reader, writer = await asyncio.open_unix_connection(address[5:])
+            else:
+                host, port = address.rsplit(":", 1)
+                reader, writer = await asyncio.open_connection(host, int(port))
+            return Connection(reader, writer, handlers or {},
+                              on_disconnect=on_disconnect, name=name)
+        except (ConnectionError, FileNotFoundError, OSError) as e:
+            last_err = e
+            await asyncio.sleep(0.05)
+    raise ConnectionLost(f"could not connect to {address}: {last_err}")
+
+
+class EventLoopThread:
+    """A dedicated asyncio loop on a daemon thread.
+
+    The synchronous driver/worker API (get/put/remote) fronts all its async
+    IO through one of these — the analog of the reference core worker's
+    io_service threads (reference: core_worker.cc io_service_).
+    """
+
+    def __init__(self, name="ray_tpu-io"):
+        self.loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(target=self._run, name=name, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        asyncio.set_event_loop(self.loop)
+        self.loop.run_forever()
+
+    def run(self, coro, timeout=None):
+        fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
+        return fut.result(timeout)
+
+    def submit(self, coro):
+        return asyncio.run_coroutine_threadsafe(coro, self.loop)
+
+    def stop(self):
+        def _cancel_all():
+            for task in asyncio.all_tasks(self.loop):
+                task.cancel()
+            self.loop.call_soon(self.loop.stop)
+
+        try:
+            self.loop.call_soon_threadsafe(_cancel_all)
+        except RuntimeError:
+            return
+        self._thread.join(timeout=5)
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port

@@ -1,0 +1,594 @@
+"""GCS — global control store server (head-node control plane).
+
+Capability parity with the reference's gcs_server process (reference:
+src/ray/gcs/gcs_server/gcs_server.h:57): cluster membership + heartbeat
+failure detection (GcsHeartbeatManager, gcs_heartbeat_manager.h:32), actor
+lifecycle + restart (GcsActorManager, gcs_actor_manager.h:157), actor
+scheduling (GcsActorScheduler, gcs_actor_scheduler.h:83), job registry,
+KV store + pubsub (GcsPubSub over Redis in the reference — here an
+in-process table + push channels over our RPC layer; no Redis process),
+object location directory (GcsObjectManager), and placement groups
+(GcsPlacementGroupManager, gcs_placement_group_manager.h:130).
+
+State is in-memory with optional JSON snapshot persistence; a restarted GCS
+reloads the snapshot (the reference equivalently restores from Redis).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import os
+import random
+import time
+
+from ray_tpu._private import rpc
+from ray_tpu._private.common import ResourceSet
+from ray_tpu._private.config import Config, get_config, set_config
+
+logger = logging.getLogger("ray_tpu.gcs")
+
+# Actor states (reference: src/ray/protobuf/gcs.proto ActorTableData.ActorState)
+DEPENDENCIES_UNREADY = "DEPENDENCIES_UNREADY"
+PENDING_CREATION = "PENDING_CREATION"
+ALIVE = "ALIVE"
+RESTARTING = "RESTARTING"
+DEAD = "DEAD"
+
+
+class GcsServer:
+    def __init__(self, config: Config):
+        self.config = config
+        self.kv: dict[str, bytes] = {}
+        self.subscriptions: dict[str, set[rpc.Connection]] = {}
+        # node_id(bytes) -> node info dict
+        self.nodes: dict[bytes, dict] = {}
+        self.node_conns: dict[bytes, rpc.Connection] = {}
+        self.last_heartbeat: dict[bytes, float] = {}
+        self.available: dict[bytes, ResourceSet] = {}
+        # actor_id -> record
+        self.actors: dict[bytes, dict] = {}
+        self.named_actors: dict[tuple[str, str], bytes] = {}
+        self.jobs: dict[bytes, dict] = {}
+        self.next_job = 1
+        # object_id -> set of node_ids
+        self.object_locations: dict[bytes, set[bytes]] = {}
+        self.placement_groups: dict[bytes, dict] = {}
+        self.server = rpc.Server(self._handlers(), on_disconnect=self._on_disconnect,
+                                 name="gcs")
+        self._pending_actor_queue: list[bytes] = []
+
+    def _handlers(self):
+        return {
+            "kv_put": self.h_kv_put,
+            "kv_get": self.h_kv_get,
+            "kv_del": self.h_kv_del,
+            "kv_exists": self.h_kv_exists,
+            "kv_keys": self.h_kv_keys,
+            "subscribe": self.h_subscribe,
+            "unsubscribe": self.h_unsubscribe,
+            "publish": self.h_publish,
+            "register_node": self.h_register_node,
+            "heartbeat": self.h_heartbeat,
+            "get_all_nodes": self.h_get_all_nodes,
+            "drain_node": self.h_drain_node,
+            "register_job": self.h_register_job,
+            "register_actor": self.h_register_actor,
+            "get_actor": self.h_get_actor,
+            "get_named_actor": self.h_get_named_actor,
+            "list_actors": self.h_list_actors,
+            "kill_actor": self.h_kill_actor,
+            "actor_alive": self.h_actor_alive,
+            "report_worker_failure": self.h_report_worker_failure,
+            "add_object_location": self.h_add_object_location,
+            "remove_object_location": self.h_remove_object_location,
+            "get_object_locations": self.h_get_object_locations,
+            "create_placement_group": self.h_create_placement_group,
+            "remove_placement_group": self.h_remove_placement_group,
+            "get_placement_group": self.h_get_placement_group,
+            "ping": lambda conn, data: "pong",
+        }
+
+    # ---- kv ----
+    async def h_kv_put(self, conn, d):
+        key = d["key"]
+        if not d.get("overwrite", True) and key in self.kv:
+            return False
+        self.kv[key] = d["value"]
+        return True
+
+    async def h_kv_get(self, conn, d):
+        return self.kv.get(d["key"])
+
+    async def h_kv_del(self, conn, d):
+        return self.kv.pop(d["key"], None) is not None
+
+    async def h_kv_exists(self, conn, d):
+        return d["key"] in self.kv
+
+    async def h_kv_keys(self, conn, d):
+        prefix = d.get("prefix", "")
+        return [k for k in self.kv if k.startswith(prefix)]
+
+    # ---- pubsub ----
+    async def h_subscribe(self, conn, d):
+        self.subscriptions.setdefault(d["channel"], set()).add(conn)
+        return True
+
+    async def h_unsubscribe(self, conn, d):
+        self.subscriptions.get(d["channel"], set()).discard(conn)
+        return True
+
+    async def h_publish(self, conn, d):
+        await self.publish(d["channel"], d["data"])
+        return True
+
+    async def publish(self, channel: str, data):
+        for conn in list(self.subscriptions.get(channel, ())):
+            if conn.closed:
+                self.subscriptions[channel].discard(conn)
+                continue
+            try:
+                await conn.push(channel, data)
+            except Exception:
+                self.subscriptions[channel].discard(conn)
+
+    # ---- nodes ----
+    async def h_register_node(self, conn, d):
+        node_id = d["node_id"]
+        info = {
+            "node_id": node_id,
+            "address": d["address"],  # raylet rpc address
+            "object_manager_address": d.get("object_manager_address", d["address"]),
+            "resources": d["resources"],  # raw quantized dict
+            "hostname": d.get("hostname", ""),
+            "is_head": d.get("is_head", False),
+            "labels": d.get("labels", {}),
+            "state": "ALIVE",
+            "start_time": time.time(),
+        }
+        self.nodes[node_id] = info
+        self.available[node_id] = ResourceSet.from_raw(d["resources"])
+        self.last_heartbeat[node_id] = time.monotonic()
+        conn.context["node_id"] = node_id
+        self.node_conns[node_id] = conn
+        await self.publish("nodes", {"event": "added", "node": _node_public(info)})
+        logger.info("node registered: %s @ %s", node_id.hex()[:8], d["address"])
+        await self._try_schedule_pending_actors()
+        return True
+
+    async def h_heartbeat(self, conn, d):
+        node_id = d["node_id"]
+        self.last_heartbeat[node_id] = time.monotonic()
+        if "available" in d and node_id in self.nodes:
+            self.available[node_id] = ResourceSet.from_raw(d["available"])
+        return True
+
+    async def h_get_all_nodes(self, conn, d):
+        return [_node_public(info) for info in self.nodes.values()]
+
+    async def h_drain_node(self, conn, d):
+        await self._remove_node(d["node_id"], reason="drained")
+        return True
+
+    async def _remove_node(self, node_id: bytes, reason: str):
+        info = self.nodes.pop(node_id, None)
+        self.available.pop(node_id, None)
+        self.last_heartbeat.pop(node_id, None)
+        self.node_conns.pop(node_id, None)
+        if info is None:
+            return
+        info["state"] = "DEAD"
+        await self.publish("nodes", {"event": "removed",
+                                     "node": _node_public(info),
+                                     "reason": reason})
+        # Fail or restart actors that lived on this node.
+        for actor_id, rec in list(self.actors.items()):
+            if rec.get("node_id") == node_id and rec["state"] in (ALIVE, PENDING_CREATION):
+                await self._on_actor_interrupted(actor_id, f"node died ({reason})")
+        for oid, nodes in list(self.object_locations.items()):
+            nodes.discard(node_id)
+
+    async def heartbeat_checker(self):
+        cfg = self.config
+        timeout = cfg.heartbeat_interval_s * cfg.num_heartbeats_timeout
+        while True:
+            await asyncio.sleep(cfg.heartbeat_interval_s)
+            now = time.monotonic()
+            for node_id, last in list(self.last_heartbeat.items()):
+                if now - last > timeout:
+                    logger.warning("node %s missed heartbeats; declaring dead",
+                                   node_id.hex()[:8])
+                    await self._remove_node(node_id, reason="heartbeat timeout")
+
+    # ---- jobs ----
+    async def h_register_job(self, conn, d):
+        job_id = self.next_job.to_bytes(4, "big")
+        self.next_job += 1
+        self.jobs[job_id] = {"job_id": job_id, "driver_addr": d.get("driver_addr", ""),
+                             "start_time": time.time(), "state": "RUNNING"}
+        return {"job_id": job_id}
+
+    # ---- actors ----
+    async def h_register_actor(self, conn, d):
+        """Register + schedule an actor creation.
+
+        Protocol parity (reference: gcs_actor_manager.h:125-127): caller
+        registers the actor; GCS owns scheduling + lifetime from then on.
+        Returns once the actor is scheduled (ALIVE) or queued.
+        """
+        spec = d["spec"]
+        actor_id = spec["actor_id"]
+        name = spec["actor_creation"].get("name") or ""
+        namespace = spec["actor_creation"].get("namespace") or "default"
+        if name:
+            key = (namespace, name)
+            if key in self.named_actors:
+                existing = self.named_actors[key]
+                if self.actors.get(existing, {}).get("state") != DEAD:
+                    raise ValueError(f"actor name {name!r} already taken")
+            self.named_actors[key] = actor_id
+        rec = {
+            "actor_id": actor_id,
+            "spec": spec,
+            "state": PENDING_CREATION,
+            "address": "",
+            "node_id": None,
+            "worker_id": None,
+            "name": name,
+            "namespace": namespace,
+            "num_restarts": 0,
+            "max_restarts": spec["actor_creation"].get("max_restarts", 0),
+            "death_cause": "",
+        }
+        self.actors[actor_id] = rec
+        await self._schedule_actor(actor_id)
+        return self._actor_public(rec)
+
+    async def _schedule_actor(self, actor_id: bytes):
+        rec = self.actors[actor_id]
+        spec = rec["spec"]
+        need = ResourceSet.from_raw(spec["resources"])
+        # Random-among-feasible policy (reference:
+        # gcs_actor_schedule_strategy.h:42 GcsRandomActorScheduleStrategy),
+        # honoring placement-group bundle location when present.
+        candidates = []
+        if spec.get("pg_id") is not None:
+            pg = self.placement_groups.get(spec["pg_id"])
+            if pg and pg["state"] == "CREATED":
+                idx = spec.get("bundle_index", -1)
+                bundle_nodes = {b["node_id"] for i, b in enumerate(pg["bundles"])
+                                if idx in (-1, i)}
+                candidates = [n for n in bundle_nodes if n in self.nodes]
+        if not candidates:
+            candidates = [
+                node_id for node_id, avail in self.available.items()
+                if need.is_subset_of(avail)
+            ]
+        if not candidates:
+            if actor_id not in self._pending_actor_queue:
+                self._pending_actor_queue.append(actor_id)
+            logger.info("actor %s pending: no feasible node", actor_id.hex()[:8])
+            return
+        node_id = random.choice(candidates)
+        conn = self.node_conns.get(node_id)
+        if conn is None or conn.closed:
+            await self._remove_node(node_id, "connection lost")
+            await self._schedule_actor(actor_id)
+            return
+        rec["node_id"] = node_id
+        try:
+            reply = await conn.call("create_actor", {"spec": spec})
+        except Exception as e:
+            logger.warning("actor creation on %s failed: %s", node_id.hex()[:8], e)
+            await self._on_actor_interrupted(actor_id, f"creation failed: {e}")
+            return
+        rec["state"] = ALIVE
+        rec["address"] = reply["worker_address"]
+        rec["worker_id"] = reply["worker_id"]
+        await self._publish_actor(rec)
+
+    async def _on_actor_interrupted(self, actor_id: bytes, reason: str):
+        rec = self.actors.get(actor_id)
+        if rec is None or rec["state"] == DEAD:
+            return
+        restarts_left = (rec["max_restarts"] == -1
+                         or rec["num_restarts"] < rec["max_restarts"])
+        if restarts_left:
+            rec["num_restarts"] += 1
+            rec["state"] = RESTARTING
+            rec["address"] = ""
+            await self._publish_actor(rec)
+            await self._schedule_actor(actor_id)
+        else:
+            rec["state"] = DEAD
+            rec["death_cause"] = reason
+            rec["address"] = ""
+            await self._publish_actor(rec)
+
+    async def _publish_actor(self, rec):
+        await self.publish(f"actor:{rec['actor_id'].hex()}", self._actor_public(rec))
+
+    def _actor_public(self, rec):
+        return {
+            "actor_id": rec["actor_id"],
+            "state": rec["state"],
+            "address": rec["address"],
+            "node_id": rec["node_id"],
+            "name": rec["name"],
+            "namespace": rec["namespace"],
+            "num_restarts": rec["num_restarts"],
+            "max_restarts": rec["max_restarts"],
+            "death_cause": rec["death_cause"],
+            "class_name": rec["spec"]["name"],
+        }
+
+    async def h_get_actor(self, conn, d):
+        rec = self.actors.get(d["actor_id"])
+        return self._actor_public(rec) if rec else None
+
+    async def h_get_named_actor(self, conn, d):
+        key = (d.get("namespace") or "default", d["name"])
+        actor_id = self.named_actors.get(key)
+        if actor_id is None:
+            return None
+        return self._actor_public(self.actors[actor_id])
+
+    async def h_list_actors(self, conn, d):
+        return [self._actor_public(r) for r in self.actors.values()]
+
+    async def h_actor_alive(self, conn, d):
+        """Raylet reports a restarted/relocated actor is up (unused in the
+        normal path — creation reply carries the address)."""
+        rec = self.actors.get(d["actor_id"])
+        if rec:
+            rec["state"] = ALIVE
+            rec["address"] = d["address"]
+            await self._publish_actor(rec)
+        return True
+
+    async def h_kill_actor(self, conn, d):
+        actor_id = d["actor_id"]
+        rec = self.actors.get(actor_id)
+        if rec is None:
+            return False
+        no_restart = d.get("no_restart", True)
+        if no_restart:
+            rec["max_restarts"] = rec["num_restarts"]
+        node_conn = self.node_conns.get(rec.get("node_id"))
+        if node_conn is not None and rec["state"] == ALIVE:
+            try:
+                await node_conn.call("kill_actor_worker",
+                                     {"worker_id": rec["worker_id"],
+                                      "actor_id": actor_id})
+            except Exception:
+                pass
+        if no_restart:
+            rec["state"] = DEAD
+            rec["death_cause"] = "killed via kill()"
+            rec["address"] = ""
+            await self._publish_actor(rec)
+        return True
+
+    async def h_report_worker_failure(self, conn, d):
+        """Raylet reports a dead worker, listing actors it hosted."""
+        for actor_id in d.get("actor_ids", []):
+            rec = self.actors.get(actor_id)
+            if rec is not None and rec["state"] in (ALIVE, RESTARTING):
+                if d.get("intended", False):
+                    rec["state"] = DEAD
+                    rec["death_cause"] = "actor exited"
+                    rec["address"] = ""
+                    await self._publish_actor(rec)
+                else:
+                    await self._on_actor_interrupted(actor_id, "worker died")
+        return True
+
+    async def _try_schedule_pending_actors(self):
+        queue, self._pending_actor_queue = self._pending_actor_queue, []
+        for actor_id in queue:
+            if self.actors.get(actor_id, {}).get("state") != DEAD:
+                await self._schedule_actor(actor_id)
+
+    # ---- object directory ----
+    async def h_add_object_location(self, conn, d):
+        locs = self.object_locations.setdefault(d["object_id"], set())
+        locs.add(d["node_id"])
+        return True
+
+    async def h_remove_object_location(self, conn, d):
+        locs = self.object_locations.get(d["object_id"])
+        if locs:
+            locs.discard(d["node_id"])
+            if not locs:
+                del self.object_locations[d["object_id"]]
+        return True
+
+    async def h_get_object_locations(self, conn, d):
+        return list(self.object_locations.get(d["object_id"], ()))
+
+    # ---- placement groups ----
+    async def h_create_placement_group(self, conn, d):
+        """2-phase bundle reservation across raylets (reference:
+        gcs_placement_group_scheduler.h:49; strategies :133-160)."""
+        pg_id = d["pg_id"]
+        bundles = [dict(b) for b in d["bundles"]]  # list of raw resource dicts
+        strategy = d.get("strategy", "PACK")
+        placement = self._place_bundles(bundles, strategy)
+        if placement is None:
+            self.placement_groups[pg_id] = {
+                "pg_id": pg_id, "bundles": bundles, "strategy": strategy,
+                "state": "PENDING", "name": d.get("name", ""),
+            }
+            return {"state": "PENDING"}
+        # prepare
+        prepared = []
+        ok = True
+        for idx, node_id in placement.items():
+            conn_n = self.node_conns.get(node_id)
+            try:
+                res = await conn_n.call("prepare_bundle", {
+                    "pg_id": pg_id, "bundle_index": idx,
+                    "resources": bundles[idx]["resources"],
+                })
+                if not res:
+                    ok = False
+                    break
+                prepared.append((idx, node_id))
+            except Exception:
+                ok = False
+                break
+        if not ok:
+            for idx, node_id in prepared:
+                conn_n = self.node_conns.get(node_id)
+                if conn_n:
+                    try:
+                        await conn_n.call("cancel_bundle",
+                                          {"pg_id": pg_id, "bundle_index": idx})
+                    except Exception:
+                        pass
+            return {"state": "PENDING"}
+        # commit
+        for idx, node_id in placement.items():
+            conn_n = self.node_conns.get(node_id)
+            await conn_n.call("commit_bundle",
+                              {"pg_id": pg_id, "bundle_index": idx})
+        rec = {
+            "pg_id": pg_id,
+            "strategy": strategy,
+            "state": "CREATED",
+            "name": d.get("name", ""),
+            "bundles": [
+                {"bundle_index": i, "resources": bundles[i]["resources"],
+                 "node_id": placement[i]}
+                for i in range(len(bundles))
+            ],
+        }
+        self.placement_groups[pg_id] = rec
+        return {"state": "CREATED"}
+
+    def _place_bundles(self, bundles, strategy):
+        """Map bundle_index -> node_id, or None if infeasible now."""
+        avail = {nid: r.copy() for nid, r in self.available.items()}
+        placement: dict[int, bytes] = {}
+        node_ids = list(avail.keys())
+        if not node_ids:
+            return None
+
+        def fits(node_id, res: ResourceSet):
+            return res.is_subset_of(avail[node_id])
+
+        def take(node_id, res: ResourceSet):
+            avail[node_id].subtract(res)
+
+        needs = [ResourceSet.from_raw(b["resources"]) for b in bundles]
+        if strategy in ("PACK", "STRICT_PACK"):
+            # try to fit all on one node first
+            for node_id in sorted(node_ids,
+                                  key=lambda n: -avail[n].get("CPU")):
+                trial = avail[node_id].copy()
+                ok = True
+                for n in needs:
+                    if not n.is_subset_of(trial):
+                        ok = False
+                        break
+                    trial.subtract(n)
+                if ok:
+                    for i in range(len(bundles)):
+                        placement[i] = node_id
+                    return placement
+            if strategy == "STRICT_PACK":
+                return None
+            # PACK falls back to spread-fit
+        if strategy == "STRICT_SPREAD":
+            if len(bundles) > len(node_ids):
+                return None
+            used: set[bytes] = set()
+            for i, need in enumerate(needs):
+                cands = [n for n in node_ids if n not in used and fits(n, need)]
+                if not cands:
+                    return None
+                node = random.choice(cands)
+                used.add(node)
+                take(node, need)
+                placement[i] = node
+            return placement
+        # PACK fallback / SPREAD: round-robin best-fit
+        order = node_ids if strategy != "SPREAD" else random.sample(
+            node_ids, len(node_ids))
+        for i, need in enumerate(needs):
+            cands = [n for n in order if fits(n, need)]
+            if not cands:
+                return None
+            if strategy == "SPREAD":
+                node = min(cands, key=lambda n: sum(
+                    1 for j, p in placement.items() if p == n))
+            else:
+                node = cands[0]
+            take(node, need)
+            placement[i] = node
+        return placement
+
+    async def h_remove_placement_group(self, conn, d):
+        rec = self.placement_groups.pop(d["pg_id"], None)
+        if rec and rec["state"] == "CREATED":
+            for b in rec["bundles"]:
+                conn_n = self.node_conns.get(b["node_id"])
+                if conn_n is not None and not conn_n.closed:
+                    try:
+                        await conn_n.call("return_bundle", {
+                            "pg_id": d["pg_id"],
+                            "bundle_index": b["bundle_index"]})
+                    except Exception:
+                        pass
+        return rec is not None
+
+    async def h_get_placement_group(self, conn, d):
+        return self.placement_groups.get(d["pg_id"])
+
+    # ---- lifecycle ----
+    async def _on_disconnect(self, conn):
+        for subs in self.subscriptions.values():
+            subs.discard(conn)
+        node_id = conn.context.get("node_id")
+        if node_id is not None and node_id in self.nodes:
+            # Keep the node until heartbeats actually time out? No: a closed
+            # raylet connection means the process died — remove immediately.
+            await self._remove_node(node_id, reason="raylet disconnected")
+
+    async def run(self, port: int, ready_file: str | None = None):
+        actual = await self.server.start_tcp(port=port)
+        asyncio.create_task(self.heartbeat_checker())
+        logger.info("GCS listening on 127.0.0.1:%d", actual)
+        if ready_file:
+            tmp = ready_file + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(str(actual))
+            os.rename(tmp, ready_file)
+        while True:
+            await asyncio.sleep(3600)
+
+
+def _node_public(info):
+    return {k: info[k] for k in ("node_id", "address", "object_manager_address",
+                                 "resources", "hostname", "is_head", "state",
+                                 "labels")}
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--ready-file", default=None)
+    parser.add_argument("--log-file", default=None)
+    args = parser.parse_args()
+    from ray_tpu._private.log_utils import setup_process_logging
+
+    setup_process_logging("gcs_server", args.log_file)
+    set_config(Config.load())
+    server = GcsServer(get_config())
+    asyncio.run(server.run(args.port, args.ready_file))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,746 @@
+"""Raylet — the per-node manager process.
+
+Capability parity with the reference raylet (reference: src/ray/raylet/
+node_manager.h:133): grants worker leases (HandleRequestWorkerLease,
+node_manager.cc:1318), runs the local scheduler with spillback
+(src/ray/raylet/scheduling/cluster_resource_scheduler.cc:217 hybrid policy),
+manages the pool of Python worker processes (worker_pool.h:92), tracks and
+transfers local objects (object_manager.h:107 + local_object_manager.h:38
+spilling), and executes the GCS's actor-creation and placement-group bundle
+requests (placement_group_resource_manager.h:51 2PC prepare/commit).
+
+Differences by design: task *data* never flows through the raylet — owners
+push tasks directly to leased workers over their own connections (same
+direct-call architecture as the reference's CoreWorkerDirectTaskSubmitter);
+the raylet is control-plane plus bulk object transfer only.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import os
+import subprocess
+import sys
+import time
+
+from ray_tpu._private import rpc
+from ray_tpu._private.common import ResourceSet
+from ray_tpu._private.config import Config, get_config, set_config
+from ray_tpu._private.ids import NodeID, ObjectID
+from ray_tpu._private.object_store import LocalObjectStore
+
+logger = logging.getLogger("ray_tpu.raylet")
+
+
+class WorkerHandle:
+    def __init__(self, worker_id: bytes, address: str, pid: int, conn):
+        self.worker_id = worker_id
+        self.address = address
+        self.pid = pid
+        self.conn = conn
+        self.actor_id: bytes | None = None
+        self.lease_id: bytes | None = None
+        self.lease_resources: ResourceSet | None = None
+        self.lease_pg: tuple[bytes, int] | None = None
+
+
+class Raylet:
+    def __init__(self, *, node_id: NodeID, session_dir: str, gcs_address: str,
+                 resources: dict[str, float], store_root: str,
+                 is_head: bool, labels: dict[str, str], config: Config):
+        self.node_id = node_id
+        self.session_dir = session_dir
+        self.gcs_address = gcs_address
+        self.config = config
+        self.is_head = is_head
+        self.labels = labels
+        self.total = ResourceSet(resources)
+        self.available = self.total.copy()
+        self.store = LocalObjectStore(store_root)
+        self.store_root = store_root
+
+        # worker pool
+        self.workers: dict[bytes, WorkerHandle] = {}  # registered, by worker_id
+        self.idle: list[WorkerHandle] = []
+        self.starting = 0
+        self._worker_waiters: list[asyncio.Future] = []
+        self.num_cpus = int(resources.get("CPU", os.cpu_count() or 1))
+
+        # scheduling
+        self._lease_seq = 0
+        self.pending_leases: list[tuple[dict, asyncio.Future]] = []
+
+        # placement group bundles: (pg_id, index) -> {"resources", "available",
+        # "state"}
+        self.bundles: dict[tuple[bytes, int], dict] = {}
+
+        # object manager
+        self.local_objects: dict[bytes, dict] = {}  # oid -> {size, pinned, spilled}
+        self.object_waiters: dict[bytes, list[asyncio.Future]] = {}
+        self.store_used = 0
+        self.spill_dir = os.path.join(session_dir, "spill")
+        self._pulls_inflight: set[bytes] = set()
+
+        # cluster view (from GCS pubsub)
+        self.cluster_nodes: dict[bytes, dict] = {}
+
+        self.gcs: rpc.Connection | None = None
+        self.server = rpc.Server(self._handlers(),
+                                 on_disconnect=self._on_disconnect,
+                                 name="raylet")
+        self.address = ""  # tcp address, set in run()
+        self._raylet_conns: dict[str, rpc.Connection] = {}
+        self._shutting_down = False
+
+    def _handlers(self):
+        return {
+            # worker/driver-facing
+            "register_client": self.h_register_client,
+            "request_worker_lease": self.h_request_worker_lease,
+            "return_worker": self.h_return_worker,
+            "notify_object_sealed": self.h_notify_object_sealed,
+            "wait_object_local": self.h_wait_object_local,
+            "free_objects": self.h_free_objects,
+            "pin_object": self.h_pin_object,
+            "cluster_info": self.h_cluster_info,
+            "actor_exiting": self.h_actor_exiting,
+            # gcs-facing
+            "create_actor": self.h_create_actor,
+            "kill_actor_worker": self.h_kill_actor_worker,
+            "prepare_bundle": self.h_prepare_bundle,
+            "commit_bundle": self.h_commit_bundle,
+            "cancel_bundle": self.h_cancel_bundle,
+            "return_bundle": self.h_return_bundle,
+            # raylet-to-raylet object transfer
+            "object_info": self.h_object_info,
+            "fetch_chunk": self.h_fetch_chunk,
+            "ping": lambda conn, d: "pong",
+        }
+
+    # ------------------------------------------------------------------
+    # worker pool (reference: src/ray/raylet/worker_pool.h)
+    # ------------------------------------------------------------------
+
+    def _start_worker_process(self):
+        self.starting += 1
+        log_file = os.path.join(
+            self.session_dir, "logs",
+            f"worker-{self.node_id.hex()[:8]}-{self.starting}-{time.time():.0f}.log")
+        env = dict(os.environ)
+        env.update(self.config.child_env())
+        # Workers must not grab the TPU: only tasks that declare TPU
+        # resources run on a TPU-visible worker (set at lease time via env
+        # in a future round; for now workers default to CPU JAX).
+        cmd = [
+            sys.executable, "-m", "ray_tpu.worker.main",
+            "--raylet-address", self.address,
+            "--gcs-address", self.gcs_address,
+            "--node-id", self.node_id.hex(),
+            "--session-dir", self.session_dir,
+            "--store-root", self.store_root,
+            "--log-file", log_file,
+        ]
+        proc = subprocess.Popen(
+            cmd, env=env,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            start_new_session=True)
+        logger.info("started worker process pid=%d", proc.pid)
+        return proc
+
+    async def _pop_worker(self) -> WorkerHandle:
+        while True:
+            if self.idle:
+                return self.idle.pop()
+            max_workers = (self.config.max_workers_per_node
+                           or max(self.num_cpus, 4))
+            active = len(self.workers) + self.starting
+            if active < max_workers or self.starting == 0:
+                self._start_worker_process()
+            fut = asyncio.get_running_loop().create_future()
+            self._worker_waiters.append(fut)
+            await fut
+
+    def _push_worker(self, worker: WorkerHandle):
+        worker.lease_id = None
+        worker.lease_resources = None
+        worker.lease_pg = None
+        if worker.conn.closed:
+            return
+        self.idle.append(worker)
+        self._wake_worker_waiters()
+
+    def _wake_worker_waiters(self):
+        while self._worker_waiters and self.idle:
+            fut = self._worker_waiters.pop(0)
+            if not fut.done():
+                fut.set_result(None)
+
+    async def h_register_client(self, conn, d):
+        kind = d["kind"]
+        if kind == "worker":
+            worker = WorkerHandle(d["worker_id"], d["address"], d["pid"], conn)
+            self.workers[d["worker_id"]] = worker
+            conn.context["worker"] = worker
+            self.starting = max(0, self.starting - 1)
+            self.idle.append(worker)
+            self._wake_worker_waiters()
+        else:  # driver
+            conn.context["driver"] = True
+        return {"node_id": self.node_id.binary(), "address": self.address}
+
+    async def _on_disconnect(self, conn):
+        worker: WorkerHandle | None = conn.context.get("worker")
+        if worker is None or self._shutting_down:
+            return
+        self.workers.pop(worker.worker_id, None)
+        if worker in self.idle:
+            self.idle.remove(worker)
+        # release lease resources
+        if worker.lease_resources is not None:
+            self._release(worker.lease_resources, worker.lease_pg)
+            await self._dispatch_pending()
+        if worker.actor_id is not None and self.gcs is not None:
+            intended = bool(conn.context.get("intended_exit"))
+            try:
+                await self.gcs.call("report_worker_failure", {
+                    "worker_id": worker.worker_id,
+                    "actor_ids": [worker.actor_id],
+                    "intended": intended,
+                })
+            except Exception:
+                pass
+
+    # ------------------------------------------------------------------
+    # scheduling (reference: cluster_task_manager.cc + hybrid policy)
+    # ------------------------------------------------------------------
+
+    def _bundle_key(self, spec) -> tuple[bytes, int] | None:
+        if spec.get("pg_id") is None:
+            return None
+        return (spec["pg_id"], spec.get("bundle_index", -1))
+
+    def _try_acquire(self, spec) -> tuple[ResourceSet, tuple | None] | None:
+        need = ResourceSet.from_raw(spec["resources"])
+        key = self._bundle_key(spec)
+        if key is not None:
+            bundle = self._find_bundle(key)
+            if bundle is None:
+                return None
+            if not need.is_subset_of(bundle["available"]):
+                return None
+            bundle["available"].subtract(need)
+            return need, key
+        if not need.is_subset_of(self.available):
+            return None
+        self.available.subtract(need)
+        return need, None
+
+    def _find_bundle(self, key):
+        if key[1] != -1:
+            b = self.bundles.get(key)
+            return b if b and b["state"] == "COMMITTED" else None
+        # wildcard bundle index: any committed bundle of this pg on this node
+        for (pg, _idx), b in self.bundles.items():
+            if pg == key[0] and b["state"] == "COMMITTED":
+                return b
+        return None
+
+    def _release(self, res: ResourceSet, pg_key):
+        if pg_key is not None:
+            bundle = self.bundles.get(pg_key) or self._find_bundle(pg_key)
+            if bundle is not None:
+                bundle["available"].add(res)
+                return
+        self.available.add(res)
+
+    def _feasible_ever(self, spec) -> bool:
+        need = ResourceSet.from_raw(spec["resources"])
+        if self._bundle_key(spec) is not None:
+            return True  # bundles are explicit placements; wait for them
+        return need.is_subset_of(self.total)
+
+    def _pick_spillback(self, spec) -> str | None:
+        """Hybrid policy fallback: a random remote node whose *total*
+        resources fit (reference: cluster_resource_scheduler.cc:320)."""
+        import random
+
+        need = ResourceSet.from_raw(spec["resources"])
+        cands = []
+        for node_id, info in self.cluster_nodes.items():
+            if node_id == self.node_id.binary():
+                continue
+            if need.is_subset_of(ResourceSet.from_raw(info["resources"])):
+                cands.append(info["address"])
+        return random.choice(cands) if cands else None
+
+    async def h_request_worker_lease(self, conn, d):
+        spec = d["spec"]
+        acquired = self._try_acquire(spec)
+        if acquired is not None:
+            return await self._grant_lease(spec, acquired)
+        if not self._feasible_ever(spec):
+            addr = self._pick_spillback(spec)
+            if addr is not None:
+                return {"spillback": addr}
+            # Infeasible everywhere: queue until the cluster changes.
+        fut = asyncio.get_running_loop().create_future()
+        self.pending_leases.append((spec, fut))
+        return await fut
+
+    async def _grant_lease(self, spec, acquired):
+        res, pg_key = acquired
+        try:
+            worker = await self._pop_worker()
+        except Exception:
+            self._release(res, pg_key)
+            raise
+        self._lease_seq += 1
+        lease_id = self._lease_seq.to_bytes(8, "big")
+        worker.lease_id = lease_id
+        worker.lease_resources = res
+        worker.lease_pg = pg_key
+        return {
+            "granted": True,
+            "lease_id": lease_id,
+            "worker_id": worker.worker_id,
+            "worker_address": worker.address,
+        }
+
+    async def h_return_worker(self, conn, d):
+        worker = None
+        for w in self.workers.values():
+            if w.lease_id == d["lease_id"]:
+                worker = w
+                break
+        if worker is None:
+            return False
+        self._release(worker.lease_resources, worker.lease_pg)
+        if d.get("worker_exiting") or worker.conn.closed:
+            self.workers.pop(worker.worker_id, None)
+        else:
+            self._push_worker(worker)
+        await self._dispatch_pending()
+        return True
+
+    async def _dispatch_pending(self):
+        remaining = []
+        for spec, fut in self.pending_leases:
+            if fut.done():
+                continue
+            acquired = self._try_acquire(spec)
+            if acquired is None:
+                remaining.append((spec, fut))
+                continue
+            try:
+                fut.set_result(await self._grant_lease(spec, acquired))
+            except Exception as e:  # pragma: no cover
+                if not fut.done():
+                    fut.set_exception(e)
+        self.pending_leases = remaining
+
+    # ------------------------------------------------------------------
+    # actors (GCS-driven)
+    # ------------------------------------------------------------------
+
+    async def h_create_actor(self, conn, d):
+        spec = d["spec"]
+        acquired = self._try_acquire(spec)
+        if acquired is None:
+            # GCS checked the resource snapshot, but we may have raced.
+            raise RuntimeError("insufficient resources for actor")
+        res, pg_key = acquired
+        try:
+            worker = await asyncio.wait_for(
+                self._pop_worker(), self.config.worker_register_timeout_s)
+        except Exception:
+            self._release(res, pg_key)
+            raise
+        worker.actor_id = spec["actor_id"]
+        worker.lease_resources = res
+        worker.lease_pg = pg_key
+        try:
+            await worker.conn.call("create_actor", {"spec": spec})
+        except Exception:
+            worker.actor_id = None
+            self._release(res, pg_key)
+            worker.lease_resources = None
+            worker.lease_pg = None
+            if not worker.conn.closed:
+                self._push_worker(worker)
+            raise
+        return {"worker_address": worker.address, "worker_id": worker.worker_id}
+
+    async def h_kill_actor_worker(self, conn, d):
+        worker = self.workers.get(d["worker_id"])
+        if worker is None:
+            return False
+        worker.conn.context["intended_exit"] = True
+        try:
+            await worker.conn.notify("exit", {"reason": "killed"})
+        except Exception:
+            pass
+
+        async def _force_kill():
+            await asyncio.sleep(2.0)
+            try:
+                os.kill(worker.pid, 9)
+            except ProcessLookupError:
+                pass
+
+        asyncio.create_task(_force_kill())
+        return True
+
+    async def h_actor_exiting(self, conn, d):
+        """Actor worker announces a clean exit (exit_actor())."""
+        conn.context["intended_exit"] = True
+        return True
+
+    # ------------------------------------------------------------------
+    # placement group bundles (2PC; reference:
+    # placement_group_resource_manager.h:51)
+    # ------------------------------------------------------------------
+
+    async def h_prepare_bundle(self, conn, d):
+        need = ResourceSet.from_raw(d["resources"])
+        if not need.is_subset_of(self.available):
+            return False
+        self.available.subtract(need)
+        self.bundles[(d["pg_id"], d["bundle_index"])] = {
+            "resources": need,
+            "available": need.copy(),
+            "state": "PREPARED",
+        }
+        return True
+
+    async def h_commit_bundle(self, conn, d):
+        bundle = self.bundles.get((d["pg_id"], d["bundle_index"]))
+        if bundle is None:
+            return False
+        bundle["state"] = "COMMITTED"
+        await self._dispatch_pending()
+        return True
+
+    async def h_cancel_bundle(self, conn, d):
+        bundle = self.bundles.pop((d["pg_id"], d["bundle_index"]), None)
+        if bundle is not None:
+            self.available.add(bundle["resources"])
+        return True
+
+    async def h_return_bundle(self, conn, d):
+        return await self.h_cancel_bundle(conn, d)
+
+    # ------------------------------------------------------------------
+    # object manager (reference: object_manager.h, local_object_manager.h)
+    # ------------------------------------------------------------------
+
+    async def h_notify_object_sealed(self, conn, d):
+        oid = d["object_id"]
+        size = d["size"]
+        self.local_objects[oid] = {"size": size, "pinned": True, "spilled": None}
+        self.store_used += size
+        await self._wake_object_waiters(oid)
+        if self.gcs is not None:
+            try:
+                await self.gcs.call("add_object_location", {
+                    "object_id": oid, "node_id": self.node_id.binary()})
+            except Exception:
+                pass
+        await self._maybe_spill()
+        return True
+
+    async def _wake_object_waiters(self, oid: bytes):
+        for fut in self.object_waiters.pop(oid, []):
+            if not fut.done():
+                fut.set_result(True)
+
+    async def h_wait_object_local(self, conn, d):
+        oid = d["object_id"]
+        timeout = d.get("timeout") or None
+        rec = self.local_objects.get(oid)
+        if rec is not None:
+            if rec["spilled"]:
+                await self._restore_spilled(oid)
+            return True
+        fut = asyncio.get_running_loop().create_future()
+        self.object_waiters.setdefault(oid, []).append(fut)
+        asyncio.create_task(self._pull_object(oid))
+        if timeout:
+            try:
+                await asyncio.wait_for(asyncio.shield(fut), timeout)
+            except asyncio.TimeoutError:
+                return False
+        else:
+            await fut
+        return True
+
+    async def _pull_object(self, oid: bytes):
+        """Pull one object from a remote node (reference: pull_manager.h:26 +
+        object_manager chunked Push). Retries while waiters exist."""
+        if oid in self._pulls_inflight:
+            return
+        self._pulls_inflight.add(oid)
+        try:
+            while oid in self.object_waiters and oid not in self.local_objects:
+                try:
+                    locations = await self.gcs.call(
+                        "get_object_locations", {"object_id": oid})
+                except Exception:
+                    locations = []
+                locations = [l for l in locations
+                             if l != self.node_id.binary()]
+                pulled = False
+                for node_id in locations:
+                    info = self.cluster_nodes.get(node_id)
+                    if info is None:
+                        continue
+                    try:
+                        await self._pull_from(oid, info["address"])
+                        pulled = True
+                        break
+                    except Exception as e:
+                        logger.warning("pull of %s from %s failed: %s",
+                                       oid[:6].hex(), info["address"], e)
+                if pulled:
+                    break
+                await asyncio.sleep(0.2)
+        finally:
+            self._pulls_inflight.discard(oid)
+
+    async def _raylet_conn(self, address: str) -> rpc.Connection:
+        conn = self._raylet_conns.get(address)
+        if conn is None or conn.closed:
+            conn = await rpc.connect(address, name=f"raylet->{address}")
+            self._raylet_conns[address] = conn
+        return conn
+
+    async def _pull_from(self, oid: bytes, address: str):
+        conn = await self._raylet_conn(address)
+        info = await conn.call("object_info", {"object_id": oid})
+        if info is None:
+            raise KeyError("remote no longer has object")
+        size = info["size"]
+        object_id = ObjectID(oid)
+        buf = self.store.create(object_id, size)
+        try:
+            offset = 0
+            chunk = self.config.object_transfer_chunk_size
+            while offset < size:
+                data = await conn.call("fetch_chunk", {
+                    "object_id": oid, "offset": offset,
+                    "size": min(chunk, size - offset)})
+                buf.view[offset : offset + len(data)] = data
+                offset += len(data)
+            buf.close()
+            self.store.seal(object_id)
+        except BaseException:
+            buf.close()
+            self.store.abort(object_id)
+            raise
+        self.local_objects[oid] = {"size": size, "pinned": False, "spilled": None}
+        self.store_used += size
+        await self._wake_object_waiters(oid)
+
+    async def h_object_info(self, conn, d):
+        rec = self.local_objects.get(d["object_id"])
+        if rec is None:
+            return None
+        if rec["spilled"]:
+            await self._restore_spilled(d["object_id"])
+        return {"size": rec["size"]}
+
+    async def h_fetch_chunk(self, conn, d):
+        object_id = ObjectID(d["object_id"])
+        buf = self.store.get(object_id)
+        if buf is None:
+            raise KeyError(f"object {object_id.hex()[:12]} not local")
+        try:
+            return bytes(buf.view[d["offset"] : d["offset"] + d["size"]])
+        finally:
+            buf.close()
+
+    async def h_pin_object(self, conn, d):
+        rec = self.local_objects.get(d["object_id"])
+        if rec is not None:
+            rec["pinned"] = bool(d.get("pinned", True))
+        return True
+
+    async def h_free_objects(self, conn, d):
+        freed = 0
+        for oid in d["object_ids"]:
+            rec = self.local_objects.pop(oid, None)
+            if rec is None:
+                continue
+            if rec["spilled"]:
+                try:
+                    os.unlink(rec["spilled"])
+                except FileNotFoundError:
+                    pass
+            else:
+                freed += self.store.delete(ObjectID(oid))
+            if self.gcs is not None:
+                try:
+                    await self.gcs.call("remove_object_location", {
+                        "object_id": oid, "node_id": self.node_id.binary()})
+                except Exception:
+                    pass
+        self.store_used = max(0, self.store_used - freed)
+        return True
+
+    async def _maybe_spill(self):
+        """Spill cold unpinned objects to disk above the usage threshold
+        (reference: local_object_manager.h SpillObjects)."""
+        limit = int(self.config.object_store_memory
+                    * self.config.object_spilling_threshold)
+        if self.store_used <= limit:
+            return
+        os.makedirs(self.spill_dir, exist_ok=True)
+        for oid, rec in list(self.local_objects.items()):
+            if self.store_used <= limit:
+                break
+            if rec["pinned"] or rec["spilled"]:
+                continue
+            object_id = ObjectID(oid)
+            buf = self.store.get(object_id)
+            if buf is None:
+                continue
+            path = os.path.join(self.spill_dir, object_id.hex())
+            with open(path, "wb") as f:
+                f.write(buf.view)
+            buf.close()
+            self.store.delete(object_id)
+            rec["spilled"] = path
+            self.store_used -= rec["size"]
+            logger.info("spilled %s (%d bytes)", object_id.hex()[:12],
+                        rec["size"])
+
+    async def _restore_spilled(self, oid: bytes):
+        rec = self.local_objects.get(oid)
+        if rec is None or not rec["spilled"]:
+            return
+        object_id = ObjectID(oid)
+        with open(rec["spilled"], "rb") as f:
+            data = f.read()
+        self.store.put_bytes(object_id, data)
+        os.unlink(rec["spilled"])
+        rec["spilled"] = None
+        self.store_used += rec["size"]
+
+    # ------------------------------------------------------------------
+    # cluster info
+    # ------------------------------------------------------------------
+
+    async def h_cluster_info(self, conn, d):
+        return {
+            "node_id": self.node_id.binary(),
+            "nodes": list(self.cluster_nodes.values()),
+            "total": self.total.raw(),
+            "available": self.available.raw(),
+            "num_workers": len(self.workers),
+            "store_used": self.store_used,
+            "num_local_objects": len(self.local_objects),
+        }
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+
+    async def _handle_gcs_push(self, channel, data):
+        if channel == "nodes":
+            node = data["node"]
+            if data["event"] == "added":
+                self.cluster_nodes[node["node_id"]] = node
+            else:
+                self.cluster_nodes.pop(node["node_id"], None)
+                await self._dispatch_pending()
+
+    async def heartbeat_loop(self):
+        while True:
+            await asyncio.sleep(self.config.heartbeat_interval_s)
+            try:
+                await self.gcs.call("heartbeat", {
+                    "node_id": self.node_id.binary(),
+                    "available": self.available.raw(),
+                })
+            except Exception:
+                logger.warning("heartbeat to GCS failed")
+
+    async def run(self, port: int = 0, ready_file: str | None = None):
+        actual = await self.server.start_tcp(port=port)
+        self.address = f"127.0.0.1:{actual}"
+        self.gcs = await rpc.connect(self.gcs_address, name="raylet->gcs")
+        self.gcs.set_push_handler(self._handle_gcs_push)
+        await self.gcs.call("subscribe", {"channel": "nodes"})
+        nodes = await self.gcs.call("get_all_nodes", {})
+        for node in nodes:
+            self.cluster_nodes[node["node_id"]] = node
+        await self.gcs.call("register_node", {
+            "node_id": self.node_id.binary(),
+            "address": self.address,
+            "resources": self.total.raw(),
+            "hostname": os.uname().nodename,
+            "is_head": self.is_head,
+            "labels": self.labels,
+        })
+        asyncio.create_task(self.heartbeat_loop())
+        prestart = self.config.num_initial_workers
+        if prestart < 0:
+            prestart = min(int(self.num_cpus), 8)
+        for _ in range(prestart):
+            self._start_worker_process()
+        logger.info("raylet up at %s (node %s)", self.address,
+                    self.node_id.hex()[:8])
+        if ready_file:
+            tmp = ready_file + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(self.address)
+            os.rename(tmp, ready_file)
+        while True:
+            await asyncio.sleep(3600)
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--gcs-address", required=True)
+    parser.add_argument("--session-dir", required=True)
+    parser.add_argument("--store-root", required=True)
+    parser.add_argument("--node-id", default=None)
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--num-cpus", type=float, default=None)
+    parser.add_argument("--num-tpus", type=float, default=0)
+    parser.add_argument("--resources", default="{}")
+    parser.add_argument("--labels", default="{}")
+    parser.add_argument("--is-head", action="store_true")
+    parser.add_argument("--ready-file", default=None)
+    parser.add_argument("--log-file", default=None)
+    args = parser.parse_args()
+
+    import json
+
+    from ray_tpu._private.log_utils import setup_process_logging
+
+    setup_process_logging("raylet", args.log_file)
+    set_config(Config.load())
+    resources = dict(json.loads(args.resources))
+    resources.setdefault("CPU", args.num_cpus
+                         if args.num_cpus is not None else (os.cpu_count() or 1))
+    if args.num_tpus:
+        resources.setdefault("TPU", args.num_tpus)
+    node_id = (NodeID.from_hex(args.node_id) if args.node_id
+               else NodeID.from_random())
+    raylet = Raylet(
+        node_id=node_id,
+        session_dir=args.session_dir,
+        gcs_address=args.gcs_address,
+        resources=resources,
+        store_root=args.store_root,
+        is_head=args.is_head,
+        labels=json.loads(args.labels),
+        config=get_config(),
+    )
+    asyncio.run(raylet.run(args.port, args.ready_file))
+
+
+if __name__ == "__main__":
+    main()

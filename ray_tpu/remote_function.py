@@ -1,0 +1,78 @@
+"""RemoteFunction — the `@ray_tpu.remote` wrapper for plain functions
+(reference: python/ray/remote_function.py:27, _remote :169)."""
+
+from __future__ import annotations
+
+import cloudpickle
+
+from ray_tpu._private import global_state
+
+
+class RemoteFunction:
+    def __init__(self, fn, *, num_returns=1, num_cpus=None, num_tpus=None,
+                 resources=None, max_retries=None):
+        self._function = fn
+        self._name = getattr(fn, "__qualname__", str(fn))
+        self._num_returns = num_returns
+        self._num_cpus = num_cpus
+        self._num_tpus = num_tpus
+        self._resources = resources or {}
+        self._max_retries = max_retries
+        self._pickled = None
+        self._fn_id = None
+        self.__doc__ = fn.__doc__
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Remote function {self._name} cannot be called directly; use "
+            f"{self._name}.remote()."
+        )
+
+    def options(self, **opts):
+        parent = self
+
+        class _Wrapped:
+            def remote(self, *args, **kwargs):
+                return parent._remote(args, kwargs, opts)
+
+        return _Wrapped()
+
+    def remote(self, *args, **kwargs):
+        return self._remote(args, kwargs, {})
+
+    def _resources_dict(self, opts) -> dict:
+        resources = dict(self._resources)
+        resources.update(opts.get("resources") or {})
+        num_cpus = opts.get("num_cpus", self._num_cpus)
+        num_tpus = opts.get("num_tpus", self._num_tpus)
+        resources["CPU"] = 1 if num_cpus is None else num_cpus
+        if num_tpus:
+            resources["TPU"] = num_tpus
+        return resources
+
+    def _remote(self, args, kwargs, opts):
+        cw = global_state.require_core_worker()
+        if self._fn_id is None:
+            self._pickled = cloudpickle.dumps(self._function)
+        fn_id = cw.export_function(self._pickled)
+        self._fn_id = fn_id
+        num_returns = opts.get("num_returns", self._num_returns)
+        pg = opts.get("placement_group")
+        pg_id = None
+        bundle_index = opts.get("placement_group_bundle_index", -1)
+        if pg is not None:
+            pg_id = pg.id.binary()
+        refs = cw.submit_task(
+            fn_id=fn_id,
+            name=opts.get("name", self._name),
+            args=args,
+            kwargs=kwargs,
+            num_returns=num_returns,
+            resources=self._resources_dict(opts),
+            max_retries=opts.get("max_retries", self._max_retries),
+            placement_group=pg_id,
+            bundle_index=bundle_index,
+        )
+        if num_returns == 1:
+            return refs[0]
+        return refs

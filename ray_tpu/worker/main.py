@@ -1,0 +1,51 @@
+"""Worker process entrypoint (reference:
+python/ray/workers/default_worker.py): connect to the local raylet, register
+into its pool, and run the task execution loop."""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--raylet-address", required=True)
+    parser.add_argument("--gcs-address", required=True)
+    parser.add_argument("--node-id", required=True)
+    parser.add_argument("--session-dir", required=True)
+    parser.add_argument("--store-root", required=True)
+    parser.add_argument("--log-file", default=None)
+    args = parser.parse_args()
+
+    from ray_tpu._private.config import Config, get_config, set_config
+    from ray_tpu._private.core_worker import WORKER, CoreWorker
+    from ray_tpu._private.log_utils import setup_process_logging
+
+    setup_process_logging("worker", args.log_file)
+    set_config(Config.load())
+
+    # Workers default to CPU JAX so they never fight the driver for the TPU;
+    # tasks that declare TPU resources run in a worker the raylet started
+    # with TPU visibility (round-1: inherit node env when RAY_TPU_WORKER_TPU
+    # is set).
+    if not os.environ.get("RAY_TPU_WORKER_TPU"):
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    cw = CoreWorker(
+        mode=WORKER,
+        raylet_address=args.raylet_address,
+        gcs_address=args.gcs_address,
+        session_dir=args.session_dir,
+        store_root=args.store_root,
+        config=get_config(),
+    )
+    logging.getLogger("ray_tpu.worker").info(
+        "worker %s registered with raylet %s",
+        cw.worker_id.hex()[:8], args.raylet_address)
+    cw.run_task_execution_loop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,74 @@
+"""Test fixtures (modeled on the reference's conftest: ray_start_regular /
+ray_start_cluster, reference: python/ray/tests/conftest.py:70-156).
+
+All tests run with JAX on a virtual 8-device CPU mesh so sharding logic is
+exercised without TPU hardware and without fighting over the one real chip.
+"""
+
+import os
+
+# Must be set before jax (or anything importing jax) loads in this process
+# and in every subprocess the runtime spawns.
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=8"
+).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def ray_start_regular():
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=4)
+    try:
+        yield
+    finally:
+        ray_tpu.shutdown()
+
+
+@pytest.fixture(scope="module")
+def ray_start_shared():
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=8)
+    try:
+        yield
+    finally:
+        ray_tpu.shutdown()
+
+
+@pytest.fixture
+def ray_start_cluster():
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(initialize_head=False)
+    try:
+        yield cluster
+    finally:
+        from ray_tpu._private import global_state
+
+        cw = global_state.get_core_worker()
+        if cw is not None:
+            cw.shutdown()
+        cluster.shutdown()
+
+
+@pytest.fixture
+def ray_start_cluster_2_nodes():
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(initialize_head=True,
+                      head_node_args={"num_cpus": 2})
+    cluster.add_node(num_cpus=2)
+    try:
+        yield cluster
+    finally:
+        from ray_tpu._private import global_state
+
+        cw = global_state.get_core_worker()
+        if cw is not None:
+            cw.shutdown()
+        cluster.shutdown()

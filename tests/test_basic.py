@@ -1,0 +1,240 @@
+"""Core task/object API tests (semantics ported from the reference's
+python/ray/tests/test_basic.py — behavior, not code)."""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import exceptions as exc
+
+
+def test_put_get(ray_start_shared):
+    for value in [0, 1.5, "hello", b"bytes", None, True,
+                  [1, 2, 3], {"a": [1, 2]}, (1, "x")]:
+        ref = ray_tpu.put(value)
+        assert ray_tpu.get(ref) == value
+
+
+def test_put_get_numpy_roundtrip(ray_start_shared):
+    arr = np.random.rand(64, 64).astype(np.float32)
+    out = ray_tpu.get(ray_tpu.put(arr))
+    np.testing.assert_array_equal(arr, out)
+
+
+def test_put_get_large_object_plasma(ray_start_shared):
+    # > max_direct_call_object_size -> shared-memory store path
+    arr = np.arange(1_000_000, dtype=np.float64)
+    ref = ray_tpu.put(arr)
+    assert ref.is_plasma()
+    out = ray_tpu.get(ref)
+    np.testing.assert_array_equal(arr, out)
+
+
+def test_simple_task(ray_start_shared):
+    @ray_tpu.remote
+    def f(x):
+        return x + 1
+
+    assert ray_tpu.get(f.remote(1)) == 2
+
+
+def test_task_kwargs_and_defaults(ray_start_shared):
+    @ray_tpu.remote
+    def f(a, b=10, *, c=100):
+        return a + b + c
+
+    assert ray_tpu.get(f.remote(1)) == 111
+    assert ray_tpu.get(f.remote(1, 2)) == 103
+    assert ray_tpu.get(f.remote(1, b=2, c=3)) == 6
+
+
+def test_many_tasks(ray_start_shared):
+    @ray_tpu.remote
+    def f(i):
+        return i * i
+
+    refs = [f.remote(i) for i in range(50)]
+    assert ray_tpu.get(refs) == [i * i for i in range(50)]
+
+
+def test_task_dependencies(ray_start_shared):
+    @ray_tpu.remote
+    def f(x):
+        return x + 1
+
+    ref = f.remote(0)
+    for _ in range(5):
+        ref = f.remote(ref)
+    assert ray_tpu.get(ref) == 6
+
+
+def test_ref_as_arg_plasma(ray_start_shared):
+    @ray_tpu.remote
+    def norm(x):
+        return float(np.sum(x))
+
+    arr = np.ones(500_000)
+    ref = ray_tpu.put(arr)
+    assert ray_tpu.get(norm.remote(ref)) == 500_000.0
+
+
+def test_large_task_return(ray_start_shared):
+    @ray_tpu.remote
+    def big():
+        return np.ones((1000, 1000))
+
+    out = ray_tpu.get(big.remote())
+    assert out.shape == (1000, 1000)
+
+
+def test_num_returns(ray_start_shared):
+    @ray_tpu.remote(num_returns=3)
+    def three():
+        return 1, 2, 3
+
+    r1, r2, r3 = three.remote()
+    assert ray_tpu.get([r1, r2, r3]) == [1, 2, 3]
+
+
+def test_options_num_returns(ray_start_shared):
+    @ray_tpu.remote
+    def two():
+        return "a", "b"
+
+    r1, r2 = two.options(num_returns=2).remote()
+    assert ray_tpu.get(r1) == "a"
+    assert ray_tpu.get(r2) == "b"
+
+
+def test_task_error_propagation(ray_start_shared):
+    @ray_tpu.remote
+    def fail():
+        raise ValueError("boom")
+
+    with pytest.raises(exc.TaskError, match="boom"):
+        ray_tpu.get(fail.remote())
+
+
+def test_error_propagates_through_dependency(ray_start_shared):
+    @ray_tpu.remote
+    def fail():
+        raise ValueError("inner")
+
+    @ray_tpu.remote
+    def consume(x):
+        return x
+
+    with pytest.raises(exc.TaskError):
+        ray_tpu.get(consume.remote(fail.remote()))
+
+
+def test_get_timeout(ray_start_shared):
+    @ray_tpu.remote
+    def slow():
+        time.sleep(5)
+        return 1
+
+    ref = slow.remote()
+    with pytest.raises(exc.GetTimeoutError):
+        ray_tpu.get(ref, timeout=0.2)
+
+
+def test_wait(ray_start_shared):
+    @ray_tpu.remote
+    def sleep_then(i, t):
+        time.sleep(t)
+        return i
+
+    fast = sleep_then.remote(1, 0.0)
+    slow = sleep_then.remote(2, 5.0)
+    ready, not_ready = ray_tpu.wait([fast, slow], num_returns=1, timeout=3.0)
+    assert ready == [fast]
+    assert not_ready == [slow]
+
+
+def test_wait_timeout_none_ready(ray_start_shared):
+    @ray_tpu.remote
+    def slow():
+        time.sleep(5)
+
+    ready, not_ready = ray_tpu.wait([slow.remote()], timeout=0.2)
+    assert ready == []
+    assert len(not_ready) == 1
+
+
+def test_wait_all(ray_start_shared):
+    @ray_tpu.remote
+    def quick(i):
+        return i
+
+    refs = [quick.remote(i) for i in range(5)]
+    ready, not_ready = ray_tpu.wait(refs, num_returns=5, timeout=10)
+    assert len(ready) == 5 and not not_ready
+
+
+def test_nested_object_refs(ray_start_shared):
+    @ray_tpu.remote
+    def make():
+        return 7
+
+    @ray_tpu.remote
+    def deref(wrapped):
+        inner = wrapped["ref"]
+        return ray_tpu.get(inner) + 1
+
+    inner = make.remote()
+    assert ray_tpu.get(deref.remote({"ref": inner})) == 8
+
+
+def test_remote_inside_task(ray_start_shared):
+    @ray_tpu.remote
+    def child(x):
+        return x * 2
+
+    @ray_tpu.remote
+    def parent(x):
+        return ray_tpu.get(child.remote(x)) + 1
+
+    assert ray_tpu.get(parent.remote(10)) == 21
+
+
+def test_closure_capture(ray_start_shared):
+    factor = 3
+
+    @ray_tpu.remote
+    def times(x):
+        return x * factor
+
+    assert ray_tpu.get(times.remote(5)) == 15
+
+
+def test_cluster_resources(ray_start_shared):
+    total = ray_tpu.cluster_resources()
+    assert total.get("CPU", 0) >= 1
+    avail = ray_tpu.available_resources()
+    assert avail.get("CPU", 0) <= total["CPU"]
+
+
+def test_nodes(ray_start_shared):
+    ns = ray_tpu.nodes()
+    assert len(ns) == 1
+    assert ns[0]["Alive"]
+
+
+def test_cancel_queued_tasks(ray_start_shared):
+    # Runs last in this module: its blockers occupy workers until they
+    # finish sleeping.
+    @ray_tpu.remote
+    def busy():
+        time.sleep(5)
+        return "done"
+
+    blockers = [busy.remote() for _ in range(8)]
+    victim = busy.remote()
+    time.sleep(0.5)
+    ray_tpu.cancel(victim)
+    with pytest.raises((exc.TaskCancelledError, exc.WorkerCrashedError)):
+        ray_tpu.get(victim, timeout=10)
+    del blockers
