@@ -165,7 +165,11 @@ class CoreWorker:
             self.address = f"127.0.0.1:{port}"
             self.gcs = await rpc.connect(gcs_address, name="cw->gcs")
             self.gcs.set_push_handler(self._on_gcs_push)
-            self.raylet = await rpc.connect(raylet_address, name="cw->raylet")
+            # Duplex: the raylet sends actor-creation/kill requests back
+            # over this same connection.
+            self.raylet = await rpc.connect(raylet_address,
+                                            handlers=self._handlers(),
+                                            name="cw->raylet")
             reply = await self.raylet.call("register_client", {
                 "kind": self.mode,
                 "worker_id": self.worker_id.binary(),
@@ -806,6 +810,7 @@ class CoreWorker:
             if client.address != info["address"]:
                 client.address = info["address"]
                 client.conn = None
+                client.seq = 0  # fresh incarnation expects seq 0
         else:
             client.address = info.get("address", "") or ""
             client.conn = None
@@ -842,8 +847,10 @@ class CoreWorker:
             "spec": spec, "pinned": pinned, "retries": 0, "cancelled": False}
 
         async def _submit():
-            spec["seq_no"] = client.seq
-            client.seq += 1
+            # seq_no is assigned at push time (not here) so a restarted
+            # actor — whose reorder buffer starts from 0 again — sees a
+            # contiguous sequence (reference: direct_actor_transport
+            # resend/reset semantics).
             client.queued.append((spec, pinned))
             await self._ensure_actor_ready(client)
             await self._flush_actor_queue(client)
@@ -877,6 +884,8 @@ class CoreWorker:
                 return
         while client.queued:
             spec, pinned = client.queued.pop(0)
+            spec["seq_no"] = client.seq
+            client.seq += 1
             asyncio.ensure_future(self._push_actor_task(client, spec))
 
     async def _push_actor_task(self, client: _ActorClient, spec):
@@ -888,19 +897,19 @@ class CoreWorker:
                     e.exc, exc.TaskCancelledError):
                 self._fail_task(spec, e.exc, release=True)
                 return
-            # Connection lost: actor may be restarting. Requeue and wait for
-            # a state update from the GCS.
+            # Connection lost mid-flight: the task may or may not have run —
+            # fail it (reference default: max_task_retries=0; in-flight
+            # tasks get RayActorError on actor death). Tasks still queued
+            # owner-side are preserved for the next incarnation.
             info = await self.gcs.call("get_actor",
                                        {"actor_id": client.actor_id})
             if info is not None:
                 self._apply_actor_update(info)
-            if client.state == "DEAD":
-                self._fail_task(spec, exc.ActorDiedError(
-                    client.actor_id.hex(), client.death_cause or str(e)),
-                    release=True)
-            else:
-                client.queued.insert(0, (spec, []))
-                await self._flush_actor_queue(client)
+            self._fail_task(spec, exc.ActorDiedError(
+                client.actor_id.hex(),
+                client.death_cause or f"task in flight when actor died ({e})"),
+                release=True)
+            await self._flush_actor_queue(client)
 
     def kill_actor(self, actor_id: bytes, no_restart=True):
         self._io.run(self.gcs.call("kill_actor", {

@@ -66,8 +66,17 @@ class ServiceProcess:
             pass
 
 
+def strip_tpu_plugin_env(env: dict) -> dict:
+    """Remove TPU-plugin activation vars so pure control-plane processes
+    skip the expensive jax/PJRT import their sitecustomize would trigger
+    (observed ~2s per process; catastrophic on few-core hosts)."""
+    for key in ("PALLAS_AXON_POOL_IPS",):
+        env.pop(key, None)
+    return env
+
+
 def _spawn(cmd: list[str], config: Config, name: str) -> ServiceProcess:
-    env = dict(os.environ)
+    env = strip_tpu_plugin_env(dict(os.environ))
     env.update(config.child_env())
     proc = subprocess.Popen(
         cmd, env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,

@@ -135,7 +135,10 @@ class ActorClass:
         resources.update(opts.get("resources") or {})
         num_cpus = opts.get("num_cpus", self._num_cpus)
         num_tpus = opts.get("num_tpus", self._num_tpus)
-        resources["CPU"] = 1 if num_cpus is None else num_cpus
+        # Reference semantics: actors without an explicit request hold no
+        # CPU while alive (so long-lived actors don't starve task
+        # scheduling); explicit num_cpus is held for the actor's lifetime.
+        resources["CPU"] = 0 if num_cpus is None else num_cpus
         if num_tpus:
             resources["TPU"] = num_tpus
         pg = opts.get("placement_group")

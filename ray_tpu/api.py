@@ -104,13 +104,16 @@ def init(address: str | None = None, *, num_cpus: float | None = None,
 
 
 def _detect_tpu_chips() -> float:
-    try:
-        import jax
+    """Detect TPU chips WITHOUT initializing jax (a backend claim in init
+    would grab the chip for the driver and can block). Env-based only;
+    pass num_tpus explicitly for precise control."""
+    import os
 
-        return float(len([d for d in jax.devices()
-                          if d.platform not in ("cpu",)]))
-    except Exception:
-        return 0.0
+    if os.environ.get("RAY_TPU_NUM_CHIPS"):
+        return float(os.environ["RAY_TPU_NUM_CHIPS"])
+    if os.environ.get("PALLAS_AXON_POOL_IPS") or os.environ.get("TPU_NAME"):
+        return 1.0
+    return 0.0
 
 
 def connection_info() -> dict:

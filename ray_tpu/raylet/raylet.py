@@ -131,8 +131,13 @@ class Raylet:
         env = dict(os.environ)
         env.update(self.config.child_env())
         # Workers must not grab the TPU: only tasks that declare TPU
-        # resources run on a TPU-visible worker (set at lease time via env
-        # in a future round; for now workers default to CPU JAX).
+        # resources run on a TPU-visible worker. Stripping the TPU-plugin
+        # env also skips the ~2s jax import the plugin's sitecustomize
+        # forces on every python start.
+        if not os.environ.get("RAY_TPU_WORKER_TPU"):
+            from ray_tpu._private.node import strip_tpu_plugin_env
+
+            strip_tpu_plugin_env(env)
         cmd = [
             sys.executable, "-m", "ray_tpu.worker.main",
             "--raylet-address", self.address,
@@ -149,14 +154,14 @@ class Raylet:
         logger.info("started worker process pid=%d", proc.pid)
         return proc
 
-    async def _pop_worker(self) -> WorkerHandle:
+    async def _pop_worker(self, ignore_cap: bool = False) -> WorkerHandle:
         while True:
             if self.idle:
                 return self.idle.pop()
             max_workers = (self.config.max_workers_per_node
                            or max(self.num_cpus, 4))
             active = len(self.workers) + self.starting
-            if active < max_workers or self.starting == 0:
+            if ignore_cap or active < max_workers or self.starting == 0:
                 self._start_worker_process()
             fut = asyncio.get_running_loop().create_future()
             self._worker_waiters.append(fut)
@@ -353,7 +358,8 @@ class Raylet:
         res, pg_key = acquired
         try:
             worker = await asyncio.wait_for(
-                self._pop_worker(), self.config.worker_register_timeout_s)
+                self._pop_worker(ignore_cap=True),
+                self.config.worker_register_timeout_s)
         except Exception:
             self._release(res, pg_key)
             raise
@@ -669,7 +675,11 @@ class Raylet:
     async def run(self, port: int = 0, ready_file: str | None = None):
         actual = await self.server.start_tcp(port=port)
         self.address = f"127.0.0.1:{actual}"
-        self.gcs = await rpc.connect(self.gcs_address, name="raylet->gcs")
+        # Duplex: the GCS drives actor creation and bundle 2PC back over
+        # this connection.
+        self.gcs = await rpc.connect(self.gcs_address,
+                                     handlers=self._handlers(),
+                                     name="raylet->gcs")
         self.gcs.set_push_handler(self._handle_gcs_push)
         await self.gcs.call("subscribe", {"channel": "nodes"})
         nodes = await self.gcs.call("get_all_nodes", {})

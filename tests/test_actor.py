@@ -1,0 +1,214 @@
+"""Actor API tests (semantics ported from the reference's
+python/ray/tests/test_actor.py / test_actor_failures.py)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import exceptions as exc
+
+
+@ray_tpu.remote
+class Counter:
+    def __init__(self, start=0):
+        self.value = start
+
+    def increment(self, by=1):
+        self.value += by
+        return self.value
+
+    def read(self):
+        return self.value
+
+
+def test_actor_basic(ray_start_shared):
+    counter = Counter.remote()
+    assert ray_tpu.get(counter.increment.remote()) == 1
+    assert ray_tpu.get(counter.increment.remote()) == 2
+    assert ray_tpu.get(counter.read.remote()) == 2
+
+
+def test_actor_constructor_args(ray_start_shared):
+    counter = Counter.remote(start=10)
+    assert ray_tpu.get(counter.read.remote()) == 10
+
+
+def test_actor_method_ordering(ray_start_shared):
+    counter = Counter.remote()
+    refs = [counter.increment.remote() for _ in range(20)]
+    assert ray_tpu.get(refs) == list(range(1, 21))
+
+
+def test_two_actors_isolated(ray_start_shared):
+    a = Counter.remote()
+    b = Counter.remote()
+    ray_tpu.get(a.increment.remote())
+    assert ray_tpu.get(b.read.remote()) == 0
+
+
+def test_actor_error(ray_start_shared):
+    @ray_tpu.remote
+    class Bad:
+        def boom(self):
+            raise RuntimeError("actor-boom")
+
+        def fine(self):
+            return "ok"
+
+    bad = Bad.remote()
+    with pytest.raises(exc.TaskError, match="actor-boom"):
+        ray_tpu.get(bad.boom.remote())
+    # actor survives method errors
+    assert ray_tpu.get(bad.fine.remote()) == "ok"
+
+
+def test_actor_constructor_error(ray_start_shared):
+    @ray_tpu.remote
+    class Broken:
+        def __init__(self):
+            raise ValueError("ctor-fail")
+
+        def ping(self):
+            return 1
+
+    broken = Broken.remote()
+    with pytest.raises((exc.TaskError, exc.ActorDiedError)):
+        ray_tpu.get(broken.ping.remote(), timeout=20)
+
+
+def test_pass_actor_handle(ray_start_shared):
+    counter = Counter.remote()
+
+    @ray_tpu.remote
+    def bump(c):
+        return ray_tpu.get(c.increment.remote())
+
+    assert ray_tpu.get(bump.remote(counter)) == 1
+    assert ray_tpu.get(counter.read.remote()) == 1
+
+
+def test_named_actor(ray_start_shared):
+    counter = Counter.options(name="named_counter").remote()
+    ray_tpu.get(counter.increment.remote())
+    again = ray_tpu.get_actor("named_counter")
+    assert ray_tpu.get(again.read.remote()) == 1
+
+
+def test_named_actor_duplicate_rejected(ray_start_shared):
+    Counter.options(name="dup_counter").remote()
+    time.sleep(0.5)
+    c2 = Counter.options(name="dup_counter").remote()
+    with pytest.raises(Exception):
+        ray_tpu.get(c2.read.remote(), timeout=10)
+
+
+def test_get_actor_missing(ray_start_shared):
+    with pytest.raises(ValueError):
+        ray_tpu.get_actor("no_such_actor")
+
+
+def test_kill_actor(ray_start_shared):
+    counter = Counter.remote()
+    ray_tpu.get(counter.increment.remote())
+    ray_tpu.kill(counter)
+    with pytest.raises(exc.ActorDiedError):
+        ray_tpu.get(counter.read.remote(), timeout=15)
+
+
+def test_actor_restart(ray_start_shared):
+    @ray_tpu.remote(max_restarts=2)
+    class Flaky:
+        def __init__(self):
+            self.count = 0
+
+        def bump(self):
+            self.count += 1
+            return self.count
+
+        def die(self):
+            import os
+
+            os._exit(1)
+
+    flaky = Flaky.options(max_restarts=2).remote()
+    assert ray_tpu.get(flaky.bump.remote()) == 1
+    flaky.die.remote()
+    time.sleep(1.5)
+    # restarted with fresh state
+    value = ray_tpu.get(flaky.bump.remote(), timeout=30)
+    assert value == 1
+
+
+def test_actor_no_restart_dies(ray_start_shared):
+    @ray_tpu.remote
+    class Mortal:
+        def die(self):
+            import os
+
+            os._exit(1)
+
+        def ping(self):
+            return "pong"
+
+    mortal = Mortal.remote()
+    assert ray_tpu.get(mortal.ping.remote()) == "pong"
+    mortal.die.remote()
+    with pytest.raises(exc.ActorDiedError):
+        ray_tpu.get(mortal.ping.remote(), timeout=15)
+
+
+def test_async_actor(ray_start_shared):
+    @ray_tpu.remote
+    class AsyncActor:
+        async def work(self, x):
+            import asyncio
+
+            await asyncio.sleep(0.01)
+            return x * 2
+
+    actor = AsyncActor.remote()
+    assert ray_tpu.get(actor.work.remote(21)) == 42
+
+
+def test_exit_actor(ray_start_shared):
+    @ray_tpu.remote
+    class Quitter:
+        def quit(self):
+            ray_tpu.exit_actor()
+
+        def ping(self):
+            return 1
+
+    quitter = Quitter.remote()
+    assert ray_tpu.get(quitter.ping.remote()) == 1
+    quitter.quit.remote()
+    with pytest.raises(exc.ActorDiedError):
+        ray_tpu.get(quitter.ping.remote(), timeout=15)
+
+
+def test_actor_large_return(ray_start_shared):
+    import numpy as np
+
+    @ray_tpu.remote
+    class Big:
+        def make(self, n):
+            return np.ones(n)
+
+    big = Big.remote()
+    out = ray_tpu.get(big.make.remote(500_000))
+    assert out.shape == (500_000,)
+
+
+def test_actor_handle_in_actor(ray_start_shared):
+    @ray_tpu.remote
+    class Holder:
+        def __init__(self, counter):
+            self.counter = counter
+
+        def bump_remote(self):
+            return ray_tpu.get(self.counter.increment.remote())
+
+    counter = Counter.remote()
+    holder = Holder.remote(counter)
+    assert ray_tpu.get(holder.bump_remote.remote()) == 1
