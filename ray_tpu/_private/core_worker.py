@@ -166,9 +166,17 @@ class CoreWorker:
             self.gcs = await rpc.connect(gcs_address, name="cw->gcs")
             self.gcs.set_push_handler(self._on_gcs_push)
             # Duplex: the raylet sends actor-creation/kill requests back
-            # over this same connection.
+            # over this same connection. A worker cannot function without
+            # its raylet — it dies with it (reference: worker exits when
+            # the raylet socket closes).
+            async def _raylet_lost(conn):
+                if self.mode == WORKER and not self._shutdown:
+                    logger.warning("raylet connection lost; worker exiting")
+                    os._exit(1)
+
             self.raylet = await rpc.connect(raylet_address,
                                             handlers=self._handlers(),
+                                            on_disconnect=_raylet_lost,
                                             name="cw->raylet")
             reply = await self.raylet.call("register_client", {
                 "kind": self.mode,

@@ -1,0 +1,144 @@
+"""Multi-node semantics on one machine via cluster_utils.Cluster
+(reference idiom: python/ray/tests/test_multi_node*.py, test_failure.py,
+test_object_manager.py — real process boundaries, local host)."""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import exceptions as exc
+from ray_tpu._private import global_state
+
+
+def _connect(cluster):
+    cluster.connect_driver()
+    return global_state.require_core_worker()
+
+
+def test_two_node_scheduling(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.gcs_svc, cluster.gcs_address = (
+        __import__("ray_tpu._private.node", fromlist=["start_gcs"])
+        .start_gcs(cluster.session_dir, cluster.config))
+    cluster.add_node(num_cpus=1, is_head=True)
+    cluster.add_node(num_cpus=1, resources={"special": 1})
+    _connect(cluster)
+
+    @ray_tpu.remote(resources={"special": 1}, num_cpus=1)
+    def where():
+        import os
+
+        return os.getpid()
+
+    # must spill to the second node (head has no "special" resource)
+    pid = ray_tpu.get(where.remote(), timeout=60)
+    assert isinstance(pid, int)
+
+
+def test_cross_node_object_transfer(ray_start_cluster_2_nodes):
+    _connect(ray_start_cluster_2_nodes)
+
+    @ray_tpu.remote(resources={"CPU": 2})
+    def produce():
+        return np.ones(300_000)  # > inline threshold -> plasma
+
+    @ray_tpu.remote(resources={"CPU": 2})
+    def consume(arr):
+        return float(arr.sum())
+
+    # Force produce and consume onto (potentially) different nodes by
+    # saturating: each task needs 2 CPUs and each node has exactly 2.
+    ref = produce.remote()
+    out = ray_tpu.get(consume.remote(ref), timeout=60)
+    assert out == 300_000.0
+
+
+def test_actor_on_remote_node(ray_start_cluster):
+    cluster = ray_start_cluster
+    from ray_tpu._private.node import start_gcs
+
+    cluster.gcs_svc, cluster.gcs_address = start_gcs(
+        cluster.session_dir, cluster.config)
+    cluster.add_node(num_cpus=1, is_head=True)
+    cluster.add_node(num_cpus=1, resources={"gpuish": 1})
+    _connect(cluster)
+
+    @ray_tpu.remote(resources={"gpuish": 1})
+    class Remote:
+        def whoami(self):
+            import os
+
+            return os.getpid()
+
+    actor = Remote.remote()
+    assert isinstance(ray_tpu.get(actor.whoami.remote(), timeout=60), int)
+
+
+def test_node_death_kills_actor(ray_start_cluster):
+    cluster = ray_start_cluster
+    from ray_tpu._private.node import start_gcs
+
+    cluster.gcs_svc, cluster.gcs_address = start_gcs(
+        cluster.session_dir, cluster.config)
+    cluster.add_node(num_cpus=1, is_head=True)
+    victim_node = cluster.add_node(num_cpus=1, resources={"victim": 1})
+    _connect(cluster)
+
+    @ray_tpu.remote(resources={"victim": 1})
+    class Doomed:
+        def ping(self):
+            return "pong"
+
+    doomed = Doomed.remote()
+    assert ray_tpu.get(doomed.ping.remote(), timeout=60) == "pong"
+    cluster.remove_node(victim_node)
+    with pytest.raises(exc.ActorDiedError):
+        ray_tpu.get(doomed.ping.remote(), timeout=60)
+
+
+def test_actor_restart_on_other_node(ray_start_cluster):
+    cluster = ray_start_cluster
+    from ray_tpu._private.node import start_gcs
+
+    cluster.gcs_svc, cluster.gcs_address = start_gcs(
+        cluster.session_dir, cluster.config)
+    cluster.add_node(num_cpus=1, is_head=True)
+    victim_node = cluster.add_node(num_cpus=1)
+    _connect(cluster)
+
+    @ray_tpu.remote(max_restarts=3)
+    class Phoenix:
+        def node(self):
+            import os
+
+            return os.getpid()
+
+    # Actors hold 0 CPU so placement is random; just verify it survives a
+    # node removal via restart elsewhere.
+    phoenix = Phoenix.remote()
+    pid1 = ray_tpu.get(phoenix.node.remote(), timeout=60)
+    cluster.remove_node(victim_node)
+    time.sleep(2.0)
+    pid2 = ray_tpu.get(phoenix.node.remote(), timeout=60)
+    assert isinstance(pid1, int) and isinstance(pid2, int)
+
+
+def test_heartbeat_death_detection(ray_start_cluster):
+    cluster = ray_start_cluster
+    from ray_tpu._private.node import start_gcs
+
+    cluster.gcs_svc, cluster.gcs_address = start_gcs(
+        cluster.session_dir, cluster.config)
+    cluster.add_node(num_cpus=1, is_head=True)
+    other = cluster.add_node(num_cpus=1)
+    _connect(cluster)
+    assert len(ray_tpu.nodes()) == 2
+    cluster.remove_node(other)
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if len(ray_tpu.nodes()) == 1:
+            break
+        time.sleep(0.5)
+    assert len(ray_tpu.nodes()) == 1
