@@ -1131,6 +1131,24 @@ class CoreWorker:
     def cluster_info(self) -> dict:
         return self._io.run(self.raylet.call("cluster_info", {}))
 
+    # internal kv (reference: python/ray/experimental/internal_kv.py —
+    # GCS-backed KV used by libraries for rendezvous/config)
+    def kv_put(self, key: str, value: bytes, overwrite=True) -> bool:
+        return self._io.run(self.gcs.call("kv_put", {
+            "key": key, "value": value, "overwrite": overwrite}))
+
+    def kv_get(self, key: str) -> bytes | None:
+        return self._io.run(self.gcs.call("kv_get", {"key": key}))
+
+    def kv_del(self, key: str) -> bool:
+        return self._io.run(self.gcs.call("kv_del", {"key": key}))
+
+    def kv_exists(self, key: str) -> bool:
+        return self._io.run(self.gcs.call("kv_exists", {"key": key}))
+
+    def kv_keys(self, prefix: str) -> list[str]:
+        return self._io.run(self.gcs.call("kv_keys", {"prefix": prefix}))
+
     def notify_actor_exiting(self):
         try:
             self._io.run(self.raylet.call("actor_exiting", {}))

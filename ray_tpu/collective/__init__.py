@@ -1,0 +1,27 @@
+from ray_tpu.collective.collective import (
+    CollectiveActorMixin,
+    allgather,
+    allreduce,
+    barrier,
+    broadcast,
+    create_collective_group,
+    declare_collective_group,
+    destroy_collective_group,
+    get_collective_group_size,
+    get_rank,
+    init_collective_group,
+    is_group_initialized,
+    recv,
+    reduce,
+    reducescatter,
+    send,
+)
+from ray_tpu.collective.types import Backend, ReduceOp
+
+__all__ = [
+    "Backend", "CollectiveActorMixin", "ReduceOp", "allgather", "allreduce",
+    "barrier", "broadcast", "create_collective_group",
+    "declare_collective_group", "destroy_collective_group",
+    "get_collective_group_size", "get_rank", "init_collective_group",
+    "is_group_initialized", "recv", "reduce", "reducescatter", "send",
+]

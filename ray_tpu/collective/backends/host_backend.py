@@ -1,0 +1,366 @@
+"""HOST backend: cross-process CPU collectives over TCP.
+
+The gloo-equivalent of the reference's collective backends (reference:
+python/ray/util/collective/collective_group/ — NCCLGroup :115 and the MPI
+stub). Rendezvous goes through the GCS KV (the reference used a named
+"Info" actor, util.py) — rank 0 binds a TCP hub, publishes its address
+under `collective/<group>`, and every other rank connects.
+
+Topology: star (hub at rank 0). Every collective is served by a shared
+contribution table guarded by a condition variable: the last arriving rank
+computes the reduction, everyone picks up their slice of the result. P2P
+send/recv routes through per-destination mailboxes on the hub. This favors
+correctness and portability; the ICI-bandwidth path on TPU is the XLA
+backend, not this one — HOST carries control-plane-sized tensors (metrics,
+broadcast configs, rendezvous barriers) and stands in for DCN in tests.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+import time
+from typing import Any
+
+import msgpack
+import numpy as np
+
+from ray_tpu.collective.types import _NUMPY_REDUCE, ReduceOp
+
+_HDR = struct.Struct(">I")
+
+
+def _send_msg(sock: socket.socket, header: dict, payload: bytes = b""):
+    h = msgpack.packb(header, use_bin_type=True)
+    sock.sendall(_HDR.pack(len(h)) + h + _HDR.pack(len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("collective peer disconnected")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _recv_msg(sock: socket.socket):
+    (hlen,) = _HDR.unpack(_recv_exact(sock, 4))
+    header = msgpack.unpackb(_recv_exact(sock, hlen), raw=False)
+    (plen,) = _HDR.unpack(_recv_exact(sock, 4))
+    payload = _recv_exact(sock, plen) if plen else b""
+    return header, payload
+
+
+def _arr_meta(arr: np.ndarray) -> dict:
+    return {"dtype": arr.dtype.str, "shape": list(arr.shape)}
+
+
+def _arr_from(meta: dict, payload: bytes) -> np.ndarray:
+    return np.frombuffer(payload, dtype=np.dtype(meta["dtype"])).reshape(
+        meta["shape"]).copy()
+
+
+def _reduce(arrays: list[np.ndarray], op: ReduceOp) -> np.ndarray:
+    if op == ReduceOp.MEAN:
+        return np.mean(np.stack(arrays), axis=0)
+    ufunc = getattr(np, _NUMPY_REDUCE[ReduceOp(op)])
+    out = arrays[0].copy()
+    for arr in arrays[1:]:
+        out = ufunc(out, arr)
+    return out
+
+
+class _CollectiveState:
+    """Hub-side shared op table. contribute() blocks until the op's result
+    is ready; the last contributor computes it."""
+
+    def __init__(self, world_size: int):
+        self.world_size = world_size
+        self.lock = threading.Lock()
+        self.cv = threading.Condition(self.lock)
+        self.ops: dict[int, dict] = {}
+        self.mailboxes: dict[tuple[int, int, int], tuple[dict, bytes]] = {}
+
+    def contribute(self, op_id: int, kind: str, rank: int, meta: dict,
+                   payload: bytes, timeout: float = 300.0):
+        with self.cv:
+            op = self.ops.setdefault(op_id, {"arrivals": {}, "result": None,
+                                             "done": False})
+            op["arrivals"][rank] = (kind, meta, payload)
+            if len(op["arrivals"]) == self.world_size:
+                op["result"] = self._compute(kind, op["arrivals"])
+                op["done"] = True
+                self.cv.notify_all()
+            else:
+                deadline = time.monotonic() + timeout
+                while not op["done"]:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise TimeoutError(
+                            f"collective op {op_id} ({kind}) timed out: "
+                            f"{len(op['arrivals'])}/{self.world_size} arrived")
+                    self.cv.wait(remaining)
+            result = op["result"]
+            # last reader cleans up
+            op.setdefault("readers", set()).add(rank)
+            if len(op["readers"]) == self.world_size:
+                del self.ops[op_id]
+        return result
+
+    def _compute(self, kind: str, arrivals: dict):
+        ranks = sorted(arrivals)
+        kinds = {arrivals[r][0] for r in ranks}
+        assert len(kinds) == 1, f"mismatched collective kinds: {kinds}"
+        metas = {r: arrivals[r][1] for r in ranks}
+        payloads = {r: arrivals[r][2] for r in ranks}
+        if kind == "barrier":
+            return {"kind": "barrier"}
+        if kind == "broadcast":
+            src = metas[ranks[0]]["src"]
+            return {"kind": "bcast", "meta": metas[src],
+                    "payload": payloads[src]}
+        if kind in ("allreduce", "reduce"):
+            op = ReduceOp(metas[ranks[0]]["op"])
+            arrays = [_arr_from(metas[r], payloads[r]) for r in ranks]
+            out = _reduce(arrays, op)
+            return {"kind": kind, "meta": _arr_meta(out),
+                    "payload": out.tobytes(),
+                    "dst": metas[ranks[0]].get("dst", -1)}
+        if kind == "allgather":
+            return {"kind": "allgather",
+                    "metas": [metas[r] for r in ranks],
+                    "payloads": [payloads[r] for r in ranks]}
+        if kind == "reducescatter":
+            op = ReduceOp(metas[ranks[0]]["op"])
+            arrays = [_arr_from(metas[r], payloads[r]) for r in ranks]
+            out = _reduce(arrays, op)
+            chunks = np.array_split(out, len(ranks), axis=0)
+            return {"kind": "reducescatter",
+                    "metas": [_arr_meta(c) for c in chunks],
+                    "payloads": [np.ascontiguousarray(c).tobytes()
+                                 for c in chunks]}
+        raise ValueError(f"unknown collective kind {kind!r}")
+
+    # p2p
+    def post(self, src: int, dst: int, tag: int, meta: dict, payload: bytes):
+        with self.cv:
+            self.mailboxes[(src, dst, tag)] = (meta, payload)
+            self.cv.notify_all()
+
+    def take(self, src: int, dst: int, tag: int, timeout: float = 300.0):
+        deadline = time.monotonic() + timeout
+        with self.cv:
+            while (src, dst, tag) not in self.mailboxes:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(f"recv from {src} tag {tag} timed out")
+                self.cv.wait(remaining)
+            return self.mailboxes.pop((src, dst, tag))
+
+
+class HostGroup:
+    def __init__(self, group_name: str, world_size: int, rank: int,
+                 timeout: float = 60.0):
+        from ray_tpu.experimental import internal_kv
+
+        self.group_name = group_name
+        self.world_size = world_size
+        self.rank = rank
+        self._op_id = 0
+        self._key = f"collective/{group_name}"
+        self._sock: socket.socket | None = None
+        self._destroyed = False
+        if world_size == 1:
+            self._state = _CollectiveState(1)
+            return
+        if rank == 0:
+            self._state = _CollectiveState(world_size)
+            self._listener = socket.socket()
+            self._listener.bind(("127.0.0.1", 0))
+            self._listener.listen(world_size)
+            port = self._listener.getsockname()[1]
+            internal_kv._kv_put(
+                self._key,
+                msgpack.packb({"addr": f"127.0.0.1:{port}",
+                               "world_size": world_size}))
+            self._conn_threads = []
+            accept_thread = threading.Thread(target=self._accept_loop,
+                                             daemon=True)
+            accept_thread.start()
+        else:
+            deadline = time.monotonic() + timeout
+            info = None
+            while time.monotonic() < deadline:
+                data = internal_kv._kv_get(self._key)
+                if data:
+                    info = msgpack.unpackb(data, raw=False)
+                    break
+                time.sleep(0.05)
+            if info is None:
+                raise TimeoutError(
+                    f"rendezvous for group {group_name!r} timed out")
+            if info["world_size"] != world_size:
+                raise ValueError("world_size mismatch at rendezvous")
+            host, port = info["addr"].rsplit(":", 1)
+            self._sock = socket.create_connection((host, int(port)),
+                                                  timeout=timeout)
+            self._sock.settimeout(None)
+            _send_msg(self._sock, {"hello": rank})
+
+    # ---- hub side ----
+    def _accept_loop(self):
+        joined = 0
+        while joined < self.world_size - 1:
+            conn, _ = self._listener.accept()
+            hello, _ = _recv_msg(conn)
+            t = threading.Thread(target=self._serve_conn,
+                                 args=(conn, hello["hello"]), daemon=True)
+            t.start()
+            self._conn_threads.append(t)
+            joined += 1
+
+    def _serve_conn(self, conn: socket.socket, peer_rank: int):
+        try:
+            while True:
+                header, payload = _recv_msg(conn)
+                kind = header["kind"]
+                if kind == "p2p_send":
+                    self._state.post(peer_rank, header["dst"], header["tag"],
+                                     header["meta"], payload)
+                    _send_msg(conn, {"ok": True})
+                elif kind == "p2p_recv":
+                    meta, data = self._state.take(header["src"], peer_rank,
+                                                  header["tag"])
+                    _send_msg(conn, {"meta": meta}, data)
+                else:
+                    result = self._state.contribute(
+                        header["op_id"], kind, peer_rank, header["meta"],
+                        payload)
+                    reply, data = self._slice_result(result, peer_rank, kind)
+                    _send_msg(conn, reply, data)
+        except (ConnectionError, OSError):
+            pass
+
+    @staticmethod
+    def _slice_result(result: dict, rank: int, kind: str):
+        if result["kind"] == "barrier":
+            return {"barrier": True}, b""
+        if result["kind"] in ("bcast", "allreduce"):
+            return {"meta": result["meta"]}, result["payload"]
+        if result["kind"] == "reduce":
+            if rank == result["dst"]:
+                return {"meta": result["meta"]}, result["payload"]
+            return {"meta": None}, b""
+        if result["kind"] == "allgather":
+            return ({"metas": result["metas"],
+                     "sizes": [len(p) for p in result["payloads"]]},
+                    b"".join(result["payloads"]))
+        if result["kind"] == "reducescatter":
+            return {"meta": result["metas"][rank]}, result["payloads"][rank]
+        raise ValueError(result["kind"])
+
+    # ---- participant ----
+    def _next_op(self) -> int:
+        self._op_id += 1
+        return self._op_id
+
+    def _collective(self, kind: str, meta: dict, payload: bytes):
+        op_id = self._next_op()
+        if self.world_size == 1:
+            result = self._state.contribute(op_id, kind, 0, meta, payload)
+            return self._slice_result(result, 0, kind)
+        if self.rank == 0:
+            result = self._state.contribute(op_id, kind, 0, meta, payload)
+            return self._slice_result(result, 0, kind)
+        _send_msg(self._sock, {"kind": kind, "op_id": op_id, "meta": meta},
+                  payload)
+        return _recv_msg(self._sock)
+
+    def allreduce(self, arr: np.ndarray, op: ReduceOp = ReduceOp.SUM):
+        arr = np.ascontiguousarray(arr)
+        reply, data = self._collective(
+            "allreduce", {**_arr_meta(arr), "op": op.value}, arr.tobytes())
+        return _arr_from(reply["meta"], data)
+
+    def reduce(self, arr: np.ndarray, dst_rank: int = 0,
+               op: ReduceOp = ReduceOp.SUM):
+        arr = np.ascontiguousarray(arr)
+        reply, data = self._collective(
+            "reduce", {**_arr_meta(arr), "op": op.value, "dst": dst_rank},
+            arr.tobytes())
+        if self.rank == dst_rank:
+            return _arr_from(reply["meta"], data)
+        return arr
+
+    def broadcast(self, arr: np.ndarray, src_rank: int = 0):
+        arr = np.ascontiguousarray(arr)
+        payload = arr.tobytes() if self.rank == src_rank else b""
+        meta = {**_arr_meta(arr), "src": src_rank}
+        reply, data = self._collective("broadcast", meta, payload)
+        return _arr_from(reply["meta"], data)
+
+    def allgather(self, arr: np.ndarray) -> list[np.ndarray]:
+        arr = np.ascontiguousarray(arr)
+        reply, data = self._collective("allgather", _arr_meta(arr),
+                                       arr.tobytes())
+        if "payloads" in reply:  # rank-0 local path
+            return [_arr_from(m, p)
+                    for m, p in zip(reply["metas"], reply["payloads"])]
+        out, offset = [], 0
+        for m, size in zip(reply["metas"], reply["sizes"]):
+            out.append(_arr_from(m, data[offset:offset + size]))
+            offset += size
+        return out
+
+    def reducescatter(self, arr: np.ndarray, op: ReduceOp = ReduceOp.SUM):
+        arr = np.ascontiguousarray(arr)
+        reply, data = self._collective(
+            "reducescatter", {**_arr_meta(arr), "op": op.value},
+            arr.tobytes())
+        return _arr_from(reply["meta"], data)
+
+    def barrier(self):
+        self._collective("barrier", {}, b"")
+
+    def send(self, arr: np.ndarray, dst_rank: int, tag: int = 0):
+        arr = np.ascontiguousarray(arr)
+        if self.rank == 0:
+            self._state.post(0, dst_rank, tag, _arr_meta(arr), arr.tobytes())
+            return
+        _send_msg(self._sock, {"kind": "p2p_send", "dst": dst_rank,
+                               "tag": tag, "meta": _arr_meta(arr)},
+                  arr.tobytes())
+        _recv_msg(self._sock)  # ack
+
+    def recv(self, src_rank: int, tag: int = 0) -> np.ndarray:
+        if self.rank == 0:
+            meta, data = self._state.take(src_rank, 0, tag)
+            return _arr_from(meta, data)
+        _send_msg(self._sock, {"kind": "p2p_recv", "src": src_rank,
+                               "tag": tag})
+        reply, data = _recv_msg(self._sock)
+        return _arr_from(reply["meta"], data)
+
+    def destroy(self):
+        if self._destroyed:
+            return
+        self._destroyed = True
+        if self.rank == 0 and self.world_size > 1:
+            try:
+                self._listener.close()
+            except Exception:
+                pass
+            from ray_tpu.experimental import internal_kv
+
+            try:
+                internal_kv._kv_del(self._key)
+            except Exception:
+                pass
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except Exception:
+                pass

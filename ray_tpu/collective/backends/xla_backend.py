@@ -1,0 +1,127 @@
+"""XLA backend: device-mesh collectives — the TPU ICI data plane.
+
+This replaces the reference's NCCL groups (reference:
+collective_group/nccl_collective_group.py:115) with XLA collectives over a
+jax Mesh: every op is a cached jitted shard_map whose body is the
+corresponding lax collective (psum / all_gather / psum_scatter / ppermute),
+so on TPU the transfer rides ICI links and fuses with surrounding
+computation when called under jit.
+
+Single-controller model: one process drives all devices in the group
+("ranks" = devices, not processes). The caller holds a stacked array whose
+leading axis is the rank axis; each op returns the per-rank results stacked
+the same way. For multi-host pods the same code runs under
+jax.distributed with a global mesh (see ray_tpu.parallel.multihost).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ray_tpu.collective.types import ReduceOp
+
+AXIS = "ranks"
+
+
+def _shard_map(fn, mesh, in_specs, out_specs):
+    return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_vma=False)
+
+
+class XlaGroup:
+    def __init__(self, group_name: str, devices=None):
+        self.group_name = group_name
+        self.devices = list(devices) if devices is not None else jax.devices()
+        self.world_size = len(self.devices)
+        self.mesh = Mesh(self.devices, (AXIS,))
+
+    # Each op: stacked input of shape [world_size, ...] -> stacked output.
+
+    @functools.cached_property
+    def _allreduce_sum(self):
+        return jax.jit(_shard_map(
+            lambda x: jax.lax.psum(x, AXIS), self.mesh, P(AXIS), P(AXIS)))
+
+    @functools.cached_property
+    def _allreduce_max(self):
+        return jax.jit(_shard_map(
+            lambda x: jax.lax.pmax(x, AXIS), self.mesh, P(AXIS), P(AXIS)))
+
+    @functools.cached_property
+    def _allreduce_min(self):
+        return jax.jit(_shard_map(
+            lambda x: jax.lax.pmin(x, AXIS), self.mesh, P(AXIS), P(AXIS)))
+
+    @functools.cached_property
+    def _allreduce_mean(self):
+        return jax.jit(_shard_map(
+            lambda x: jax.lax.pmean(x, AXIS), self.mesh, P(AXIS), P(AXIS)))
+
+    def allreduce(self, stacked, op: ReduceOp = ReduceOp.SUM):
+        """stacked: [world, ...]; returns [world, ...] where every slice is
+        the reduction across the leading axis."""
+        fn = {
+            ReduceOp.SUM: self._allreduce_sum,
+            ReduceOp.MAX: self._allreduce_max,
+            ReduceOp.MIN: self._allreduce_min,
+            ReduceOp.MEAN: self._allreduce_mean,
+        }[ReduceOp(op)]
+        return fn(stacked)
+
+    @functools.cached_property
+    def _allgather(self):
+        # per-rank shard [1, ...] -> full copy on every rank
+        def body(x):
+            return jax.lax.all_gather(x[0], AXIS)[None]
+
+        return jax.jit(_shard_map(body, self.mesh, P(AXIS), P(AXIS)))
+
+    def allgather(self, stacked):
+        """[world, ...] -> [world, world, ...]: every rank sees all slices."""
+        return self._allgather(stacked)
+
+    @functools.cached_property
+    def _reducescatter(self):
+        def body(x):
+            # x: [1, world*chunk, ...] per rank; scatter the sum along axis 1
+            return jax.lax.psum_scatter(x[0], AXIS, scatter_dimension=0,
+                                        tiled=False)
+
+        return jax.jit(_shard_map(body, self.mesh, P(AXIS), P(AXIS)))
+
+    def reducescatter(self, stacked):
+        """[world, world, ...] -> [world, ...]: rank r holds sum of
+        stacked[:, r]."""
+        out = self._reducescatter(stacked)
+        return out
+
+    @functools.cached_property
+    def _ppermute_right(self):
+        perm = [(i, (i + 1) % self.world_size)
+                for i in range(self.world_size)]
+
+        def body(x):
+            return jax.lax.ppermute(x, AXIS, perm)
+
+        return jax.jit(_shard_map(body, self.mesh, P(AXIS), P(AXIS)))
+
+    def shift_right(self, stacked):
+        """Ring permute: rank r's slice moves to rank (r+1) % world."""
+        return self._ppermute_right(stacked)
+
+    def broadcast(self, value, src_rank: int = 0):
+        src = value[src_rank] if value.ndim and value.shape[0] == \
+            self.world_size else value
+        return jnp.broadcast_to(src, (self.world_size,) + src.shape)
+
+    def barrier(self):
+        # Device-level barrier: a trivial psum forces all ranks to sync.
+        x = jnp.zeros((self.world_size, 1), jnp.float32)
+        jax.block_until_ready(self.allreduce(x))
+
+    def destroy(self):
+        pass

@@ -1,0 +1,171 @@
+"""Declarative collective groups across actors/tasks (API parity with the
+reference: python/ray/util/collective/collective.py — GroupManager :29,
+init_collective_group :93, create_collective_group :126, allreduce :226,
+barrier :266, reduce :279, broadcast :340, allgather :391, reducescatter,
+send :496, recv :550)."""
+
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+import numpy as np
+
+from ray_tpu.collective.types import Backend, ReduceOp
+
+
+class GroupManager:
+    """Per-process registry of collective groups (reference:
+    collective.py:29)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._groups: dict[str, Any] = {}
+
+    def create_group(self, group_name: str, world_size: int, rank: int,
+                     backend: Backend):
+        backend = Backend(backend)
+        if backend == Backend.AUTO:
+            backend = Backend.XLA if world_size == 1 else Backend.HOST
+        with self._lock:
+            if group_name in self._groups:
+                raise RuntimeError(f"group {group_name!r} already exists")
+        if backend == Backend.HOST:
+            from ray_tpu.collective.backends.host_backend import HostGroup
+
+            group = HostGroup(group_name, world_size, rank)
+        else:
+            from ray_tpu.collective.backends.xla_backend import XlaGroup
+
+            group = XlaGroup(group_name)
+        with self._lock:
+            self._groups[group_name] = group
+        return group
+
+    def get_group(self, group_name: str):
+        with self._lock:
+            group = self._groups.get(group_name)
+        if group is None:
+            raise ValueError(
+                f"collective group {group_name!r} is not initialized in "
+                f"this process; call init_collective_group first")
+        return group
+
+    def destroy_group(self, group_name: str):
+        with self._lock:
+            group = self._groups.pop(group_name, None)
+        if group is not None:
+            group.destroy()
+
+
+_manager = GroupManager()
+
+
+def init_collective_group(world_size: int, rank: int,
+                          backend: str = "host",
+                          group_name: str = "default"):
+    """Initialize this process's membership in a collective group
+    (reference: collective.py:93). Call from inside each participating
+    actor/task with its rank."""
+    return _manager.create_group(group_name, world_size, rank,
+                                 Backend(backend))
+
+
+def create_collective_group(actors, world_size: int, ranks: list[int],
+                            backend: str = "host",
+                            group_name: str = "default"):
+    """Driver-side declarative setup (reference: collective.py:126): tells
+    every actor in `actors` to init the group with its rank."""
+    import ray_tpu
+
+    if len(actors) != len(ranks) or len(actors) != world_size:
+        raise ValueError("actors/ranks/world_size mismatch")
+    refs = [
+        actor.__ray_collective_init__.remote(world_size, rank, backend,
+                                             group_name)
+        for actor, rank in zip(actors, ranks)
+    ]
+    return ray_tpu.get(refs, timeout=120)
+
+
+def declare_collective_group(actors, world_size: int, ranks: list[int],
+                             backend: str = "host",
+                             group_name: str = "default"):
+    return create_collective_group(actors, world_size, ranks, backend,
+                                   group_name)
+
+
+def is_group_initialized(group_name: str = "default") -> bool:
+    try:
+        _manager.get_group(group_name)
+        return True
+    except ValueError:
+        return False
+
+
+def destroy_collective_group(group_name: str = "default"):
+    _manager.destroy_group(group_name)
+
+
+def get_rank(group_name: str = "default") -> int:
+    group = _manager.get_group(group_name)
+    return getattr(group, "rank", 0)
+
+
+def get_collective_group_size(group_name: str = "default") -> int:
+    return _manager.get_group(group_name).world_size
+
+
+def _as_numpy(tensor) -> np.ndarray:
+    if isinstance(tensor, np.ndarray):
+        return tensor
+    return np.asarray(tensor)
+
+
+def allreduce(tensor, group_name: str = "default",
+              op: ReduceOp = ReduceOp.SUM):
+    group = _manager.get_group(group_name)
+    return group.allreduce(_as_numpy(tensor), op)
+
+
+def reduce(tensor, dst_rank: int = 0, group_name: str = "default",
+           op: ReduceOp = ReduceOp.SUM):
+    group = _manager.get_group(group_name)
+    return group.reduce(_as_numpy(tensor), dst_rank, op)
+
+
+def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
+    group = _manager.get_group(group_name)
+    return group.broadcast(_as_numpy(tensor), src_rank)
+
+
+def allgather(tensor, group_name: str = "default"):
+    group = _manager.get_group(group_name)
+    return group.allgather(_as_numpy(tensor))
+
+
+def reducescatter(tensor, group_name: str = "default",
+                  op: ReduceOp = ReduceOp.SUM):
+    group = _manager.get_group(group_name)
+    return group.reducescatter(_as_numpy(tensor), op)
+
+
+def barrier(group_name: str = "default"):
+    _manager.get_group(group_name).barrier()
+
+
+def send(tensor, dst_rank: int, group_name: str = "default", tag: int = 0):
+    _manager.get_group(group_name).send(_as_numpy(tensor), dst_rank, tag)
+
+
+def recv(src_rank: int, group_name: str = "default", tag: int = 0):
+    return _manager.get_group(group_name).recv(src_rank, tag)
+
+
+class CollectiveActorMixin:
+    """Mixin giving an actor class the __ray_collective_init__ hook used by
+    create_collective_group."""
+
+    def __ray_collective_init__(self, world_size, rank, backend, group_name):
+        init_collective_group(world_size, rank, backend, group_name)
+        return rank

@@ -1,0 +1,38 @@
+"""Collective types (reference: python/ray/util/collective/types.py)."""
+
+from __future__ import annotations
+
+import enum
+
+
+class Backend(str, enum.Enum):
+    """Collective backends.
+
+    XLA — in-process device-mesh collectives (the ICI path): ops compile to
+          XLA collectives (psum/all_gather/...) over a jax Mesh; this is the
+          TPU-native replacement for the reference's NCCL backend
+          (reference: collective_group/nccl_collective_group.py:115).
+    HOST — cross-process CPU collectives over TCP with GCS rendezvous (the
+          gloo-equivalent; also the DCN stand-in between TPU hosts).
+    AUTO — XLA when the group is a single process with >1 device, else HOST.
+    """
+
+    XLA = "xla"
+    HOST = "host"
+    AUTO = "auto"
+
+
+class ReduceOp(str, enum.Enum):
+    SUM = "sum"
+    PRODUCT = "product"
+    MIN = "min"
+    MAX = "max"
+    MEAN = "mean"  # TPU-native addition: fused mean avoids a divide pass
+
+
+_NUMPY_REDUCE = {
+    ReduceOp.SUM: "add",
+    ReduceOp.PRODUCT: "multiply",
+    ReduceOp.MIN: "minimum",
+    ReduceOp.MAX: "maximum",
+}

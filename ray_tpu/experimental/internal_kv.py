@@ -1,0 +1,27 @@
+"""GCS-backed internal KV (reference:
+python/ray/experimental/internal_kv.py) — used by libraries (collective
+rendezvous, tune, serve) for small control-plane state."""
+
+from __future__ import annotations
+
+from ray_tpu._private import global_state
+
+
+def _kv_put(key: str, value: bytes, overwrite: bool = True) -> bool:
+    return global_state.require_core_worker().kv_put(key, value, overwrite)
+
+
+def _kv_get(key: str) -> bytes | None:
+    return global_state.require_core_worker().kv_get(key)
+
+
+def _kv_del(key: str) -> bool:
+    return global_state.require_core_worker().kv_del(key)
+
+
+def _kv_exists(key: str) -> bool:
+    return global_state.require_core_worker().kv_exists(key)
+
+
+def _kv_list(prefix: str) -> list[str]:
+    return global_state.require_core_worker().kv_keys(prefix)

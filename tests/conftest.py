@@ -14,6 +14,18 @@ os.environ["XLA_FLAGS"] = (
     + " --xla_force_host_platform_device_count=8"
 ).strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Deactivate the TPU PJRT plugin for the whole test tree: its backend init
+# claims the (single) real chip and can block; tests exercise sharding on
+# the virtual CPU mesh instead. This must happen before jax's first
+# backend use and propagates to all spawned runtime processes.
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+# The plugin may already be registered in THIS interpreter (sitecustomize
+# runs before conftest); forcing the config keeps jax from ever
+# initializing it.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

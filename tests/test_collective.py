@@ -1,0 +1,150 @@
+"""Collective layer tests (reference test layout:
+python/ray/util/collective/tests/single_node + distributed_tests)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.collective.types import ReduceOp
+
+
+@ray_tpu.remote
+class Member:
+    def __init__(self):
+        self.data = None
+
+    def init_group(self, world_size, rank, backend, group_name):
+        from ray_tpu import collective as col
+
+        col.init_collective_group(world_size, rank, backend=backend,
+                                  group_name=group_name)
+        self.rank = rank
+        return rank
+
+    def do_allreduce(self, value, group_name):
+        from ray_tpu import collective as col
+
+        return col.allreduce(np.asarray(value, np.float32),
+                             group_name=group_name)
+
+    def do_broadcast(self, value, src, group_name):
+        from ray_tpu import collective as col
+
+        return col.broadcast(np.asarray(value, np.float32), src_rank=src,
+                             group_name=group_name)
+
+    def do_allgather(self, value, group_name):
+        from ray_tpu import collective as col
+
+        out = col.allgather(np.asarray(value, np.float32),
+                            group_name=group_name)
+        return [np.asarray(o) for o in out]
+
+    def do_reducescatter(self, value, group_name):
+        from ray_tpu import collective as col
+
+        return col.reducescatter(np.asarray(value, np.float32),
+                                 group_name=group_name)
+
+    def do_sendrecv(self, value, peer, is_sender, group_name):
+        from ray_tpu import collective as col
+
+        if is_sender:
+            col.send(np.asarray(value, np.float32), peer,
+                     group_name=group_name)
+            return None
+        return col.recv(peer, group_name=group_name)
+
+    def do_barrier(self, group_name):
+        from ray_tpu import collective as col
+
+        col.barrier(group_name=group_name)
+        return True
+
+
+def _make_group(n, group_name):
+    members = [Member.remote() for _ in range(n)]
+    ray_tpu.get([m.init_group.remote(n, i, "host", group_name)
+                 for i, m in enumerate(members)], timeout=60)
+    return members
+
+
+def test_host_allreduce(ray_start_shared):
+    members = _make_group(3, "g_allreduce")
+    outs = ray_tpu.get([
+        m.do_allreduce.remote([float(i + 1)] * 4, "g_allreduce")
+        for i, m in enumerate(members)
+    ], timeout=60)
+    for out in outs:
+        np.testing.assert_allclose(out, np.full(4, 6.0, np.float32))
+
+
+def test_host_broadcast(ray_start_shared):
+    members = _make_group(3, "g_bcast")
+    outs = ray_tpu.get([
+        m.do_broadcast.remote([float(i)] * 2, 1, "g_bcast")
+        for i, m in enumerate(members)
+    ], timeout=60)
+    for out in outs:
+        np.testing.assert_allclose(out, np.full(2, 1.0, np.float32))
+
+
+def test_host_allgather(ray_start_shared):
+    members = _make_group(2, "g_gather")
+    outs = ray_tpu.get([
+        m.do_allgather.remote([float(i)], "g_gather")
+        for i, m in enumerate(members)
+    ], timeout=60)
+    for out in outs:
+        assert [o.tolist() for o in out] == [[0.0], [1.0]]
+
+
+def test_host_reducescatter(ray_start_shared):
+    members = _make_group(2, "g_rs")
+    outs = ray_tpu.get([
+        m.do_reducescatter.remote([1.0, 2.0, 3.0, 4.0], "g_rs")
+        for m in members
+    ], timeout=60)
+    np.testing.assert_allclose(outs[0], [2.0, 4.0])
+    np.testing.assert_allclose(outs[1], [6.0, 8.0])
+
+
+def test_host_send_recv(ray_start_shared):
+    members = _make_group(2, "g_p2p")
+    send_ref = members[1].do_sendrecv.remote([9.0, 9.0], 0, True, "g_p2p")
+    recv_ref = members[0].do_sendrecv.remote(None, 1, False, "g_p2p")
+    out = ray_tpu.get(recv_ref, timeout=60)
+    ray_tpu.get(send_ref, timeout=60)
+    np.testing.assert_allclose(out, [9.0, 9.0])
+
+
+def test_host_barrier(ray_start_shared):
+    members = _make_group(3, "g_barrier")
+    assert all(ray_tpu.get(
+        [m.do_barrier.remote("g_barrier") for m in members], timeout=60))
+
+
+def test_xla_group_ops():
+    """In-process device-mesh collectives over the 8 virtual CPU devices."""
+    from ray_tpu.collective.backends.xla_backend import XlaGroup
+
+    group = XlaGroup("xla_test")
+    n = group.world_size
+    assert n == 8
+
+    stacked = np.arange(n * 3, dtype=np.float32).reshape(n, 3)
+    out = np.asarray(group.allreduce(stacked))
+    np.testing.assert_allclose(out, np.tile(stacked.sum(0), (n, 1)))
+
+    mean = np.asarray(group.allreduce(stacked, ReduceOp.MEAN))
+    np.testing.assert_allclose(mean, np.tile(stacked.mean(0), (n, 1)),
+                               rtol=1e-6)
+
+    gathered = np.asarray(group.allgather(stacked))
+    assert gathered.shape == (n, n, 3)
+    for r in range(n):
+        np.testing.assert_allclose(gathered[r], stacked)
+
+    shifted = np.asarray(group.shift_right(stacked))
+    np.testing.assert_allclose(shifted[1], stacked[0])
+    np.testing.assert_allclose(shifted[0], stacked[n - 1])
