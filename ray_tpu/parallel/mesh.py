@@ -1,0 +1,75 @@
+"""Device mesh construction for 5-axis parallelism.
+
+The TPU-native resource model the reference lacks (SURVEY §2.4: TP/PP/SP/EP
+absent upstream): one jax Mesh with named axes
+
+    dp — data parallel (gradient allreduce; DCN-friendly outer axis)
+    pp — pipeline stages (ppermute microbatch schedule)
+    sp — sequence/context parallel (ring attention)
+    tp — tensor parallel (heads/mlp sharding; highest-bandwidth ICI axis)
+    ep — expert parallel (MoE all_to_all)
+
+Axis order puts dp outermost and tp innermost so tp collectives ride the
+fastest ICI links on real slices (the "How to Scale Your Model" recipe:
+mesh axes ordered by communication intensity).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXES = ("dp", "pp", "sp", "tp", "ep")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    dp: int = 1
+    pp: int = 1
+    sp: int = 1
+    tp: int = 1
+    ep: int = 1
+
+    @property
+    def size(self) -> int:
+        return self.dp * self.pp * self.sp * self.tp * self.ep
+
+    def axis_sizes(self) -> tuple[int, ...]:
+        return (self.dp, self.pp, self.sp, self.tp, self.ep)
+
+    @classmethod
+    def auto(cls, n_devices: int, *, tp: int = 1, pp: int = 1, sp: int = 1,
+             ep: int = 1) -> "MeshSpec":
+        """Fill dp with whatever devices remain after the model axes."""
+        model = tp * pp * sp * ep
+        if n_devices % model:
+            raise ValueError(
+                f"{n_devices} devices not divisible by tp*pp*sp*ep={model}")
+        return cls(dp=n_devices // model, pp=pp, sp=sp, tp=tp, ep=ep)
+
+    def build(self, devices=None) -> Mesh:
+        devices = list(devices) if devices is not None else jax.devices()
+        if len(devices) < self.size:
+            raise ValueError(
+                f"mesh needs {self.size} devices, have {len(devices)}")
+        devices = devices[: self.size]
+        arr = np.array(devices).reshape(self.axis_sizes())
+        return Mesh(arr, AXES)
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Inputs: batch over dp, sequence over sp."""
+    return NamedSharding(mesh, P("dp", "sp"))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def local_mesh_spec(*, tp: int = 1, pp: int = 1, sp: int = 1,
+                    ep: int = 1) -> MeshSpec:
+    return MeshSpec.auto(len(jax.devices()), tp=tp, pp=pp, sp=sp, ep=ep)

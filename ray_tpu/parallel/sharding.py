@@ -1,0 +1,81 @@
+"""Logical-axis sharding rules (the flax.linen.spmd idea, self-contained).
+
+Model code annotates parameters with *logical* axis names ("embed",
+"heads", "mlp", "vocab", ...); a rule table maps logical names to mesh
+axes. Changing the parallelism layout = changing the table, not the model.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# Default rule table for transformer-family models.
+DEFAULT_RULES: dict[str, str | None] = {
+    "batch": "dp",
+    "seq": "sp",
+    "embed": None,          # replicated across tp (activations gather)
+    "heads": "tp",
+    "kv": None,
+    "mlp": "tp",
+    "vocab": "tp",
+    "expert": "ep",
+    "stage": "pp",          # stacked pipeline-stage leading axis
+    "norm": None,
+}
+
+
+def spec_for(logical_axes: tuple[str | None, ...],
+             rules: dict[str, str | None] | None = None) -> P:
+    rules = {**DEFAULT_RULES, **(rules or {})}
+    mesh_axes = []
+    for name in logical_axes:
+        if name is None:
+            mesh_axes.append(None)
+        else:
+            mesh_axes.append(rules.get(name))
+    return P(*mesh_axes)
+
+
+class WithLogicalAxes:
+    """Wrapper marking an initializer's output with logical axes; used by
+    models to attach metadata without depending on flax internals."""
+
+    def __init__(self, init_fn, logical_axes: tuple[str | None, ...]):
+        self.init_fn = init_fn
+        self.logical_axes = logical_axes
+
+    def __call__(self, *args, **kwargs):
+        return self.init_fn(*args, **kwargs)
+
+
+def tree_shardings(mesh: Mesh, logical_tree: Any,
+                   rules: dict[str, str | None] | None = None):
+    """Map a pytree of logical-axis tuples to NamedShardings."""
+    return jax.tree.map(
+        lambda axes: NamedSharding(mesh, spec_for(axes, rules)),
+        logical_tree,
+        is_leaf=lambda x: isinstance(x, tuple) and all(
+            a is None or isinstance(a, str) for a in x),
+    )
+
+
+def infer_param_logical_axes(params: Any) -> Any:
+    """Heuristic logical axes for unannotated param trees: last axis of a
+    kernel is its output features. Used when a model doesn't carry
+    annotations — everything replicated except obvious tensor-parallel
+    candidates is a safe default."""
+
+    def leaf_axes(path, leaf):
+        name = "/".join(str(p.key) if hasattr(p, "key") else str(p)
+                        for p in path).lower()
+        rank = getattr(leaf, "ndim", 0)
+        if rank == 0:
+            return ()
+        if "embedding" in name and rank == 2:
+            return ("vocab", "embed")
+        return tuple([None] * rank)
+
+    return jax.tree_util.tree_map_with_path(leaf_axes, params)
