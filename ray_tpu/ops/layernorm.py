@@ -1,0 +1,85 @@
+"""Fused LayerNorm / RMSNorm Pallas kernels.
+
+One pass through VMEM: moments + normalize + affine in a single kernel so
+the activation never round-trips to HBM between the reduction and the
+scale (XLA usually fuses this too — the kernel guarantees it and is the
+template for fancier fusions like norm+residual+quant).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _layernorm_kernel(x_ref, w_ref, b_ref, o_ref, *, eps: float):
+    x = x_ref[...].astype(jnp.float32)
+    mean = x.mean(-1, keepdims=True)
+    var = ((x - mean) ** 2).mean(-1, keepdims=True)
+    y = (x - mean) * jax.lax.rsqrt(var + eps)
+    o_ref[...] = (y * w_ref[...] + b_ref[...]).astype(o_ref.dtype)
+
+
+def _rmsnorm_kernel(x_ref, w_ref, o_ref, *, eps: float):
+    x = x_ref[...].astype(jnp.float32)
+    ms = (x * x).mean(-1, keepdims=True)
+    y = x * jax.lax.rsqrt(ms + eps)
+    o_ref[...] = (y * w_ref[...]).astype(o_ref.dtype)
+
+
+def _is_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform not in ("cpu",)
+    except Exception:
+        return False
+
+
+def layernorm(x, weight, bias, *, eps: float = 1e-5, block_rows: int = 256):
+    """x: [..., D]; weight/bias: [D]."""
+    orig_shape = x.shape
+    d = orig_shape[-1]
+    n = int(jnp.prod(jnp.asarray(orig_shape[:-1]))) if len(orig_shape) > 1 else 1
+    xf = x.reshape(n, d)
+    block = min(block_rows, n)
+    if n % block:
+        block = n  # fall back to one block
+    out = pl.pallas_call(
+        functools.partial(_layernorm_kernel, eps=eps),
+        grid=(n // block,),
+        in_specs=[
+            pl.BlockSpec((block, d), lambda i: (i, 0)),
+            pl.BlockSpec((d,), lambda i: (0,)),
+            pl.BlockSpec((d,), lambda i: (0,)),
+        ],
+        out_specs=pl.BlockSpec((block, d), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, d), x.dtype),
+        interpret=not _is_tpu(),
+    )(xf, weight, bias)
+    return out.reshape(orig_shape)
+
+
+def rmsnorm(x, weight, *, eps: float = 1e-6, block_rows: int = 256):
+    orig_shape = x.shape
+    d = orig_shape[-1]
+    n = 1
+    for s in orig_shape[:-1]:
+        n *= s
+    xf = x.reshape(n, d)
+    block = min(block_rows, n)
+    if n % block:
+        block = n
+    out = pl.pallas_call(
+        functools.partial(_rmsnorm_kernel, eps=eps),
+        grid=(n // block,),
+        in_specs=[
+            pl.BlockSpec((block, d), lambda i: (i, 0)),
+            pl.BlockSpec((d,), lambda i: (0,)),
+        ],
+        out_specs=pl.BlockSpec((block, d), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, d), x.dtype),
+        interpret=not _is_tpu(),
+    )(xf, weight)
+    return out.reshape(orig_shape)
