@@ -1,0 +1,96 @@
+"""BERT-style bidirectional encoder for classification fine-tuning.
+
+Target of BASELINE.json configs[1] ("BERT-base GLUE fine-tune"). Reuses the
+transformer blocks with causal=False; adds segment embeddings and a pooled
+[CLS] classification head (the GLUE fine-tune shape).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.models import transformer as tfm
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    n_layers: int = 12
+    n_heads: int = 12
+    d_model: int = 768
+    d_ff: int = 3072
+    max_seq: int = 512
+    type_vocab: int = 2
+    num_classes: int = 2
+    dtype: Any = jnp.bfloat16
+
+    def encoder_config(self) -> tfm.TransformerConfig:
+        return tfm.TransformerConfig(
+            vocab_size=self.vocab_size, n_layers=self.n_layers,
+            n_heads=self.n_heads, d_model=self.d_model, d_ff=self.d_ff,
+            max_seq=self.max_seq, dtype=self.dtype, causal=False)
+
+
+def bert_base(num_classes=2) -> BertConfig:
+    return BertConfig(num_classes=num_classes)
+
+
+TINY = BertConfig(vocab_size=256, n_layers=2, n_heads=4, d_model=64,
+                  d_ff=256, max_seq=128)
+
+
+def init(key, cfg: BertConfig):
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+    d = cfg.d_model
+    enc = tfm.init(k1, cfg.encoder_config())
+    return {
+        "encoder": enc,
+        "wtt": jax.random.normal(k2, (cfg.type_vocab, d),
+                                 jnp.float32) * 0.02,
+        "pool_w": jax.random.normal(k3, (d, d), jnp.float32) * 0.02,
+        "pool_b": jnp.zeros((d,)),
+        "cls_w": jax.random.normal(k4, (d, cfg.num_classes),
+                                   jnp.float32) * 0.02,
+        "cls_b": jnp.zeros((cfg.num_classes,)),
+    }
+
+
+def logical_axes(cfg: BertConfig):
+    return {
+        "encoder": tfm.logical_axes(cfg.encoder_config()),
+        "wtt": (None, "embed"),
+        "pool_w": ("embed", "embed"),
+        "pool_b": ("embed",),
+        "cls_w": ("embed", "vocab"),
+        "cls_b": ("vocab",),
+    }
+
+
+def apply(params, tokens, cfg: BertConfig, token_types=None, pad_mask=None):
+    """tokens: [B, T] int32; pad_mask: [B, T] bool (True = real token) —
+    required for padded GLUE batches so [CLS] never attends to padding.
+    Returns (logits [B, classes], sequence [B, T, D])."""
+    b, t = tokens.shape
+    enc = params["encoder"]
+    x = enc["wte"][tokens].astype(cfg.dtype)
+    x = x + enc["wpe"][:t].astype(cfg.dtype)[None]
+    if token_types is not None:
+        x = x + params["wtt"][token_types].astype(cfg.dtype)
+
+    x = tfm.encode(enc, x, cfg.encoder_config(), pad_mask)
+
+    pooled = jnp.tanh(x[:, 0].astype(jnp.float32) @ params["pool_w"]
+                      + params["pool_b"])
+    logits = pooled @ params["cls_w"] + params["cls_b"]
+    return logits, x
+
+
+def loss_fn(params, tokens, labels, cfg: BertConfig, token_types=None,
+            pad_mask=None):
+    logits, _ = apply(params, tokens, cfg, token_types, pad_mask)
+    logp = jax.nn.log_softmax(logits)
+    return -jnp.take_along_axis(logp, labels[:, None], axis=-1).mean()

@@ -1,0 +1,173 @@
+"""ResNet in pure functional JAX (NHWC, bfloat16 compute).
+
+The north-star DP workload (BASELINE.md: RaySGD ResNet-50 352.5 img/s per
+V100; reference benchmark
+python/ray/util/sgd/torch/examples/benchmarks/README.rst:146-153), built
+TPU-first: NHWC layout (XLA's native conv layout on TPU), bfloat16 conv
+compute on the MXU, batchnorm as a functional (params, state) pair so the
+whole train step jits, and a V2-style single-pass residual stack expressed
+with static Python loops (unrolled at trace time — shapes differ per stage,
+so scan doesn't apply).
+
+resnet18/resnet50 match the torchvision layer plan the reference trains.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@dataclasses.dataclass(frozen=True)
+class ResNetConfig:
+    stage_sizes: Sequence[int] = (2, 2, 2, 2)
+    bottleneck: bool = False
+    num_classes: int = 1000
+    width: int = 64
+    dtype: Any = jnp.bfloat16
+    small_images: bool = False   # CIFAR stem: 3x3/1 conv, no maxpool
+
+
+def resnet18(num_classes=1000, **kw) -> ResNetConfig:
+    return ResNetConfig((2, 2, 2, 2), False, num_classes, **kw)
+
+
+def resnet34(num_classes=1000, **kw) -> ResNetConfig:
+    return ResNetConfig((3, 4, 6, 3), False, num_classes, **kw)
+
+
+def resnet50(num_classes=1000, **kw) -> ResNetConfig:
+    return ResNetConfig((3, 4, 6, 3), True, num_classes, **kw)
+
+
+def _conv_init(key, kh, kw_, cin, cout):
+    fan = kh * kw_ * cin
+    return jax.random.normal(key, (kh, kw_, cin, cout),
+                             jnp.float32) * math.sqrt(2.0 / fan)
+
+
+def _conv(x, w, stride=1):
+    return lax.conv_general_dilated(
+        x, w.astype(x.dtype), (stride, stride), "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def _bn_init(c):
+    return ({"scale": jnp.ones((c,)), "bias": jnp.zeros((c,))},
+            {"mean": jnp.zeros((c,)), "var": jnp.ones((c,))})
+
+
+def _bn(x, p, s, train: bool, momentum=0.9, eps=1e-5):
+    if train:
+        mean = jnp.mean(x.astype(jnp.float32), axis=(0, 1, 2))
+        var = jnp.var(x.astype(jnp.float32), axis=(0, 1, 2))
+        new_s = {"mean": momentum * s["mean"] + (1 - momentum) * mean,
+                 "var": momentum * s["var"] + (1 - momentum) * var}
+    else:
+        mean, var, new_s = s["mean"], s["var"], s
+    inv = lax.rsqrt(var + eps) * p["scale"]
+    y = (x.astype(jnp.float32) - mean) * inv + p["bias"]
+    return y.astype(x.dtype), new_s
+
+
+def _block_channels(cfg: ResNetConfig, stage: int) -> tuple[int, int]:
+    """(inner, out) channels for a block in `stage`."""
+    inner = cfg.width * (2 ** stage)
+    out = inner * (4 if cfg.bottleneck else 1)
+    return inner, out
+
+
+def init(key, cfg: ResNetConfig):
+    """Returns (params, state) pytrees. Blocks keyed 's{stage}b{block}'."""
+    keys = iter(jax.random.split(key, 256))
+    params: dict = {}
+    state: dict = {}
+
+    stem_k = 3 if cfg.small_images else 7
+    params["stem_conv"] = _conv_init(next(keys), stem_k, stem_k, 3, cfg.width)
+    params["stem_bn"], state["stem_bn"] = _bn_init(cfg.width)
+
+    cin = cfg.width
+    for s, n_blocks in enumerate(cfg.stage_sizes):
+        inner, cout = _block_channels(cfg, s)
+        for b in range(n_blocks):
+            name = f"s{s}b{b}"
+            blk: dict = {}
+            bst: dict = {}
+            if cfg.bottleneck:
+                blk["conv1"] = _conv_init(next(keys), 1, 1, cin, inner)
+                blk["conv2"] = _conv_init(next(keys), 3, 3, inner, inner)
+                blk["conv3"] = _conv_init(next(keys), 1, 1, inner, cout)
+                for i, c in enumerate((inner, inner, cout), 1):
+                    blk[f"bn{i}"], bst[f"bn{i}"] = _bn_init(c)
+            else:
+                blk["conv1"] = _conv_init(next(keys), 3, 3, cin, inner)
+                blk["conv2"] = _conv_init(next(keys), 3, 3, inner, cout)
+                for i, c in enumerate((inner, cout), 1):
+                    blk[f"bn{i}"], bst[f"bn{i}"] = _bn_init(c)
+            if b == 0 and (cin != cout or s > 0):
+                blk["proj"] = _conv_init(next(keys), 1, 1, cin, cout)
+                blk["proj_bn"], bst["proj_bn"] = _bn_init(cout)
+            params[name] = blk
+            state[name] = bst
+            cin = cout
+
+    params["fc_w"] = jax.random.normal(
+        next(keys), (cin, cfg.num_classes), jnp.float32) / math.sqrt(cin)
+    params["fc_b"] = jnp.zeros((cfg.num_classes,))
+    return params, state
+
+
+def _apply_block(x, p, s, stride, bottleneck, train):
+    new_s = {}
+    residual = x
+    if "proj" in p:
+        residual = _conv(x, p["proj"], stride)
+        residual, new_s["proj_bn"] = _bn(residual, p["proj_bn"],
+                                         s["proj_bn"], train)
+    y = _conv(x, p["conv1"], stride if not bottleneck else 1)
+    y, new_s["bn1"] = _bn(y, p["bn1"], s["bn1"], train)
+    y = jax.nn.relu(y)
+    y = _conv(y, p["conv2"], stride if bottleneck else 1)
+    y, new_s["bn2"] = _bn(y, p["bn2"], s["bn2"], train)
+    if bottleneck:
+        y = jax.nn.relu(y)
+        y = _conv(y, p["conv3"])
+        y, new_s["bn3"] = _bn(y, p["bn3"], s["bn3"], train)
+    return jax.nn.relu(residual + y), new_s
+
+
+def apply(params, state, x, cfg: ResNetConfig, train: bool = True):
+    """x: [N, H, W, 3] float → (logits [N, classes] fp32, new_state)."""
+    x = x.astype(cfg.dtype)
+    new_state: dict = {}
+    y = _conv(x, params["stem_conv"], 1 if cfg.small_images else 2)
+    y, new_state["stem_bn"] = _bn(y, params["stem_bn"], state["stem_bn"],
+                                  train)
+    y = jax.nn.relu(y)
+    if not cfg.small_images:
+        y = lax.reduce_window(y, -jnp.inf, lax.max, (1, 3, 3, 1),
+                              (1, 2, 2, 1), "SAME")
+
+    for s, n_blocks in enumerate(cfg.stage_sizes):
+        for b in range(n_blocks):
+            name = f"s{s}b{b}"
+            stride = 2 if (b == 0 and s > 0) else 1
+            y, new_state[name] = _apply_block(
+                y, params[name], state[name], stride, cfg.bottleneck, train)
+
+    y = jnp.mean(y.astype(jnp.float32), axis=(1, 2))
+    logits = y @ params["fc_w"] + params["fc_b"]
+    return logits, new_state
+
+
+def loss_fn(params, state, images, labels, cfg: ResNetConfig):
+    logits, new_state = apply(params, state, images, cfg, train=True)
+    logp = jax.nn.log_softmax(logits)
+    nll = -jnp.take_along_axis(logp, labels[:, None], axis=-1).mean()
+    return nll, new_state

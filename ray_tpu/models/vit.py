@@ -1,0 +1,106 @@
+"""ViT-B/16 in pure JAX, reusing the transformer encoder blocks.
+
+Target of BASELINE.json configs[3] ("Tune ASHA sweep of ViT-B/16 trials").
+Patch embedding is a single strided conv → [B, N, D] tokens; the encoder is
+models.transformer with causal=False (flash attention handles both).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.models import transformer as tfm
+
+
+@dataclasses.dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    n_layers: int = 12
+    n_heads: int = 12
+    d_model: int = 768
+    d_ff: int = 3072
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def n_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    def encoder_config(self) -> tfm.TransformerConfig:
+        return tfm.TransformerConfig(
+            vocab_size=1, n_layers=self.n_layers, n_heads=self.n_heads,
+            d_model=self.d_model, d_ff=self.d_ff,
+            max_seq=self.n_patches + 1, dtype=self.dtype, causal=False)
+
+
+def vit_b16(num_classes=1000, image_size=224) -> ViTConfig:
+    return ViTConfig(image_size=image_size, num_classes=num_classes)
+
+
+TINY = ViTConfig(image_size=32, patch_size=8, n_layers=2, n_heads=4,
+                 d_model=64, d_ff=256, num_classes=10)
+
+
+def init(key, cfg: ViTConfig):
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+    d = cfg.d_model
+    p = cfg.patch_size
+    enc = tfm.init(k1, cfg.encoder_config())
+    # the encoder's token/positional embeddings are unused for ViT
+    del enc["wte"], enc["wpe"]
+    params = {
+        "patch_w": jax.random.normal(k2, (p, p, 3, d),
+                                     jnp.float32) / math.sqrt(p * p * 3),
+        "patch_b": jnp.zeros((d,)),
+        "cls": jax.random.normal(k3, (1, 1, d), jnp.float32) * 0.02,
+        "pos": jax.random.normal(k4, (cfg.n_patches + 1, d),
+                                 jnp.float32) * 0.02,
+        "encoder": enc,
+        "head_w": jnp.zeros((d, cfg.num_classes)),
+        "head_b": jnp.zeros((cfg.num_classes,)),
+    }
+    return params
+
+
+def logical_axes(cfg: ViTConfig):
+    enc = tfm.logical_axes(cfg.encoder_config())
+    del enc["wte"], enc["wpe"]
+    return {
+        "patch_w": (None, None, None, "embed"),
+        "patch_b": ("embed",),
+        "cls": (None, None, "embed"),
+        "pos": (None, "embed"),
+        "encoder": enc,
+        "head_w": ("embed", "vocab"),
+        "head_b": ("vocab",),
+    }
+
+
+def apply(params, images, cfg: ViTConfig):
+    """images: [B, H, W, 3] → logits [B, classes] fp32."""
+    b = images.shape[0]
+    x = jax.lax.conv_general_dilated(
+        images.astype(cfg.dtype), params["patch_w"].astype(cfg.dtype),
+        (cfg.patch_size, cfg.patch_size), "VALID",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    x = x.reshape(b, -1, cfg.d_model) + params["patch_b"].astype(cfg.dtype)
+    cls = jnp.broadcast_to(params["cls"].astype(cfg.dtype),
+                           (b, 1, cfg.d_model))
+    x = jnp.concatenate([cls, x], axis=1)
+    x = x + params["pos"].astype(cfg.dtype)[None]
+
+    x = tfm.encode(params["encoder"], x, cfg.encoder_config())
+    cls_out = x[:, 0].astype(jnp.float32)
+    return cls_out @ params["head_w"] + params["head_b"]
+
+
+def loss_fn(params, images, labels, cfg: ViTConfig):
+    logits = apply(params, images, cfg)
+    logp = jax.nn.log_softmax(logits)
+    return -jnp.take_along_axis(logp, labels[:, None], axis=-1).mean()

@@ -22,7 +22,7 @@ NEG_INF = -1e30
 
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
-                  scale: float, q_block: int):
+                  scale: float):
     qi = pl.program_id(1)
     q = q_ref[...]  # [block_q, d]
     t = k_ref.shape[0]
@@ -67,20 +67,37 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
     o_ref[...] = (o / denom[:, None]).astype(o_ref.dtype)
 
 
+def _flash_aligned(t: int, d: int, block_q: int, block_k: int) -> bool:
+    """Mosaic constraints: K/V dynamic-slice starts must be provably
+    8-aligned (sublane) and the lane dim 128-padded; unaligned shapes go
+    through the dense path (short sequences — dense is fine there)."""
+    block_q = min(block_q, t)
+    block_k = min(block_k, t)
+    return (t % block_q == 0 and t % block_k == 0
+            and block_q % 8 == 0 and block_k % 8 == 0 and d % 8 == 0)
+
+
 def _flash_fwd_impl(q, k, v, *, causal: bool, scale: float, block_q: int,
                     block_k: int, interpret: bool):
     b, t, h, d = q.shape
+    if not _flash_aligned(t, d, block_q, block_k):
+        if t >= 512:
+            import warnings
+
+            warnings.warn(
+                f"flash_attention: seq {t} / head_dim {d} not tile-aligned;"
+                " falling back to dense O(T^2) attention — pad the sequence"
+                " to a multiple of 8 for the pallas kernel", stacklevel=2)
+        return _dense_attention(q, k, v, causal, scale)
     block_q = min(block_q, t)
     block_k = min(block_k, t)
-    if t % block_q or t % block_k:
-        raise ValueError(f"sequence length {t} must divide block sizes")
     # fold batch and heads; layout [B*H, T, D]
     qf = q.transpose(0, 2, 1, 3).reshape(b * h, t, d)
     kf = k.transpose(0, 2, 1, 3).reshape(b * h, t, d)
     vf = v.transpose(0, 2, 1, 3).reshape(b * h, t, d)
 
     kernel = functools.partial(_flash_kernel, block_k=block_k,
-                               causal=causal, scale=scale, q_block=block_q)
+                               causal=causal, scale=scale)
     out = pl.pallas_call(
         kernel,
         grid=(b * h, t // block_q),
@@ -96,15 +113,26 @@ def _flash_fwd_impl(q, k, v, *, causal: bool, scale: float, block_q: int,
     return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
 
 
-def _dense_attention(q, k, v, causal, scale):
+def _dense_attention(q, k, v, causal, scale, q_offset=0, pad_mask=None):
+    """Reference/fallback path. q_offset shifts the causal mask (used by
+    the blockwise backward); pad_mask: [B, Tk] bool, True = real token."""
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                         preferred_element_type=jnp.float32) * scale
     if causal:
         tq, tk = q.shape[1], k.shape[1]
-        mask = jnp.arange(tq)[:, None] >= jnp.arange(tk)[None, :]
+        mask = (q_offset + jnp.arange(tq))[:, None] >= jnp.arange(tk)[None, :]
         scores = jnp.where(mask[None, None], scores, NEG_INF)
+    if pad_mask is not None:
+        scores = jnp.where(pad_mask[:, None, None, :], scores, NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v).astype(q.dtype)
+
+
+def masked_attention(q, k, v, pad_mask, causal=False, scale=None):
+    """Attention with key padding mask (BERT-style batches). pad_mask:
+    [B, T] bool. Dense path — padded fine-tune batches are short."""
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    return _dense_attention(q, k, v, causal, scale, pad_mask=pad_mask)
 
 
 def _is_tpu() -> bool:
@@ -130,15 +158,44 @@ def _fwd(q, k, v, causal, scale, block_q, block_k):
 
 
 def _bwd(causal, scale, block_q, block_k, residuals, g):
+    """Blockwise-remat backward: scan over Q blocks, each recomputing its
+    attention against full K/V and accumulating dk/dv. Peak extra memory is
+    one [B, H, block_q, T] score block (linear in T), not the full T×T
+    matrix — flash-style memory from only (q, k, v) residuals."""
     q, k, v = residuals
     actual_scale = scale if scale is not None else q.shape[-1] ** -0.5
+    b, t, h, d = q.shape
+    bq = min(block_q, t)
 
-    # Rematerialized dense backward (flash-style memory: only q,k,v saved).
-    def f(q, k, v):
-        return _dense_attention(q, k, v, causal, actual_scale)
+    if t % bq:
+        # unaligned fallback: single checkpointed dense block
+        def f(q, k, v):
+            return _dense_attention(q, k, v, causal, actual_scale)
 
-    _, vjp = jax.vjp(jax.checkpoint(f), q, k, v)
-    return vjp(g)
+        _, vjp = jax.vjp(jax.checkpoint(f), q, k, v)
+        return vjp(g)
+
+    n = t // bq
+    qb = jnp.moveaxis(q.reshape(b, n, bq, h, d), 1, 0)   # [n, B, bq, H, D]
+    gb = jnp.moveaxis(g.reshape(b, n, bq, h, d), 1, 0)
+
+    def body(carry, inp):
+        dk, dv = carry
+        i, q_blk, g_blk = inp
+
+        def f(q_blk, k, v):
+            return _dense_attention(q_blk, k, v, causal, actual_scale,
+                                    q_offset=i * bq)
+
+        _, vjp = jax.vjp(f, q_blk, k, v)
+        dq_blk, dk_i, dv_i = vjp(g_blk)
+        return (dk + dk_i, dv + dv_i), dq_blk
+
+    (dk, dv), dq = jax.lax.scan(
+        body, (jnp.zeros_like(k, jnp.float32), jnp.zeros_like(v, jnp.float32)),
+        (jnp.arange(n), qb, gb))
+    dq = jnp.moveaxis(dq, 0, 1).reshape(b, t, h, d)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
 flash_attention.defvjp(_fwd, _bwd)

@@ -37,11 +37,20 @@ def _is_tpu() -> bool:
         return False
 
 
-def layernorm(x, weight, bias, *, eps: float = 1e-5, block_rows: int = 256):
-    """x: [..., D]; weight/bias: [D]."""
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def layernorm(x, weight, bias, eps: float = 1e-5):
+    """x: [..., D]; weight/bias: [D]. Fused pallas forward; analytic
+    backward in plain JAX (XLA fuses it into adjacent matmul epilogues)."""
+    return _layernorm_fwd_impl(x, weight, bias, eps=eps)
+
+
+def _layernorm_fwd_impl(x, weight, bias, *, eps: float,
+                        block_rows: int = 256):
     orig_shape = x.shape
     d = orig_shape[-1]
-    n = int(jnp.prod(jnp.asarray(orig_shape[:-1]))) if len(orig_shape) > 1 else 1
+    n = 1
+    for s in orig_shape[:-1]:
+        n *= s
     xf = x.reshape(n, d)
     block = min(block_rows, n)
     if n % block:
@@ -61,7 +70,37 @@ def layernorm(x, weight, bias, *, eps: float = 1e-5, block_rows: int = 256):
     return out.reshape(orig_shape)
 
 
-def rmsnorm(x, weight, *, eps: float = 1e-6, block_rows: int = 256):
+def _layernorm_fwd(x, weight, bias, eps):
+    return layernorm(x, weight, bias, eps), (x, weight)
+
+
+def _layernorm_bwd(eps, res, g):
+    x, weight = res
+    xf = x.astype(jnp.float32)
+    gf = g.astype(jnp.float32)
+    mean = xf.mean(-1, keepdims=True)
+    var = ((xf - mean) ** 2).mean(-1, keepdims=True)
+    inv = jax.lax.rsqrt(var + eps)
+    xhat = (xf - mean) * inv
+    gw = gf * weight.astype(jnp.float32)
+    dx = inv * (gw - gw.mean(-1, keepdims=True)
+                - xhat * (gw * xhat).mean(-1, keepdims=True))
+    red = tuple(range(x.ndim - 1))
+    dw = (gf * xhat).sum(red)
+    db = gf.sum(red)
+    return (dx.astype(x.dtype), dw.astype(weight.dtype),
+            db.astype(weight.dtype))
+
+
+layernorm.defvjp(_layernorm_fwd, _layernorm_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def rmsnorm(x, weight, eps: float = 1e-6):
+    return _rmsnorm_fwd_impl(x, weight, eps=eps)
+
+
+def _rmsnorm_fwd_impl(x, weight, *, eps: float, block_rows: int = 256):
     orig_shape = x.shape
     d = orig_shape[-1]
     n = 1
@@ -83,3 +122,24 @@ def rmsnorm(x, weight, *, eps: float = 1e-6, block_rows: int = 256):
         interpret=not _is_tpu(),
     )(xf, weight)
     return out.reshape(orig_shape)
+
+
+def _rmsnorm_fwd(x, weight, eps):
+    return rmsnorm(x, weight, eps), (x, weight)
+
+
+def _rmsnorm_bwd(eps, res, g):
+    x, weight = res
+    xf = x.astype(jnp.float32)
+    gf = g.astype(jnp.float32)
+    ms = (xf * xf).mean(-1, keepdims=True)
+    inv = jax.lax.rsqrt(ms + eps)
+    gw = gf * weight.astype(jnp.float32)
+    d = x.shape[-1]
+    dx = inv * gw - xf * (inv ** 3) * (gw * xf).sum(-1, keepdims=True) / d
+    red = tuple(range(x.ndim - 1))
+    dw = (gf * xf * inv).sum(red)
+    return dx.astype(x.dtype), dw.astype(weight.dtype)
+
+
+rmsnorm.defvjp(_rmsnorm_fwd, _rmsnorm_bwd)
