@@ -909,10 +909,15 @@ class CoreWorker:
             # fail it (reference default: max_task_retries=0; in-flight
             # tasks get RayActorError on actor death). Tasks still queued
             # owner-side are preserved for the next incarnation.
-            info = await self.gcs.call("get_actor",
-                                       {"actor_id": client.actor_id})
-            if info is not None:
-                self._apply_actor_update(info)
+            try:
+                info = await self.gcs.call("get_actor",
+                                           {"actor_id": client.actor_id})
+                if info is not None:
+                    self._apply_actor_update(info)
+            except Exception:
+                # GCS itself unreachable (shutdown teardown) — nothing to
+                # learn; fall through and fail the task locally.
+                pass
             self._fail_task(spec, exc.ActorDiedError(
                 client.actor_id.hex(),
                 client.death_cause or f"task in flight when actor died ({e})"),
@@ -929,6 +934,36 @@ class CoreWorker:
     def get_named_actor(self, name: str, namespace: str = ""):
         return self._io.run(self.gcs.call("get_named_actor", {
             "name": name, "namespace": namespace or "default"}))
+
+    # ------------------------------------------------------------------
+    # placement groups (reference: core_worker.cc:1524 CreatePlacementGroup)
+    # ------------------------------------------------------------------
+
+    def create_placement_group(self, pg_id: bytes, bundles, strategy, name=""):
+        # Quantize at the boundary: everything on the wire is FixedPoint
+        # ints, same as task-spec resources (reference: fixed_point.h).
+        return self._io.run(self.gcs.call("create_placement_group", {
+            "pg_id": pg_id,
+            "bundles": [{"resources": common.ResourceSet(dict(b)).raw()}
+                        for b in bundles],
+            "strategy": strategy,
+            "name": name,
+        }))
+
+    def remove_placement_group(self, pg_id: bytes):
+        return self._io.run(self.gcs.call("remove_placement_group",
+                                          {"pg_id": pg_id}))
+
+    def get_placement_group(self, pg_id: bytes):
+        return self._io.run(self.gcs.call("get_placement_group",
+                                          {"pg_id": pg_id}))
+
+    def get_named_placement_group(self, name: str):
+        return self._io.run(self.gcs.call("get_named_placement_group",
+                                          {"name": name}))
+
+    def list_placement_groups(self):
+        return self._io.run(self.gcs.call("list_placement_groups", {}))
 
     # ------------------------------------------------------------------
     # execution side (worker mode; reference: core_worker.cc ExecuteTask +
@@ -1068,7 +1103,7 @@ class CoreWorker:
         return {"returns": [
             {"kind": "inline", "data": payload, "err": True}
             for _ in range(max(spec["num_returns"], 1))
-        ]}
+        ], "error_repr": str(error)}
 
     async def h_exit(self, conn, d):
         self._exiting = True

@@ -72,6 +72,11 @@ def strip_tpu_plugin_env(env: dict) -> dict:
     (observed ~2s per process; catastrophic on few-core hosts)."""
     for key in ("PALLAS_AXON_POOL_IPS",):
         env.pop(key, None)
+    # If the ambient env pins jax to the stripped plugin's platform, the
+    # child would fail backend init ("axon not in known backends") — let
+    # jax pick from what's actually registered there.
+    if env.get("JAX_PLATFORMS", "").lower() not in ("", "cpu"):
+        env["JAX_PLATFORMS"] = ""
     return env
 
 

@@ -63,7 +63,9 @@ class ActorHandle:
         self._method_num_returns = method_num_returns or {}
 
     def __getattr__(self, name):
-        if name.startswith("_"):
+        # __ray_*__ system methods (terminate, collective init) are callable
+        # remotely; other underscore names are not exposed as actor methods.
+        if name.startswith("_") and not name.startswith("__ray_"):
             raise AttributeError(name)
         return ActorMethod(self, name, self._method_num_returns.get(name, 1))
 

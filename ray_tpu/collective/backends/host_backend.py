@@ -99,6 +99,13 @@ class _CollectiveState:
                 while not op["done"]:
                     remaining = deadline - time.monotonic()
                     if remaining <= 0:
+                        # Withdraw this rank's contribution so a late
+                        # straggler can't complete the op with data the
+                        # timed-out ranks already abandoned (silent
+                        # divergence); last withdrawer frees the op.
+                        op["arrivals"].pop(rank, None)
+                        if not op["arrivals"]:
+                            self.ops.pop(op_id, None)
                         raise TimeoutError(
                             f"collective op {op_id} ({kind}) timed out: "
                             f"{len(op['arrivals'])}/{self.world_size} arrived")
@@ -169,6 +176,9 @@ class HostGroup:
         self.group_name = group_name
         self.world_size = world_size
         self.rank = rank
+        # Rendezvous AND per-op timeout: ops abort (not hang) when a peer
+        # dies mid-collective, so the SGD layer can resize the group.
+        self._timeout = timeout
         self._op_id = 0
         self._key = f"collective/{group_name}"
         self._sock: socket.socket | None = None
@@ -236,9 +246,13 @@ class HostGroup:
                                                   header["tag"])
                     _send_msg(conn, {"meta": meta}, data)
                 else:
-                    result = self._state.contribute(
-                        header["op_id"], kind, peer_rank, header["meta"],
-                        payload)
+                    try:
+                        result = self._state.contribute(
+                            header["op_id"], kind, peer_rank, header["meta"],
+                            payload, timeout=self._timeout)
+                    except TimeoutError as e:
+                        _send_msg(conn, {"error": str(e)})
+                        continue
                     reply, data = self._slice_result(result, peer_rank, kind)
                     _send_msg(conn, reply, data)
         except (ConnectionError, OSError):
@@ -269,15 +283,16 @@ class HostGroup:
 
     def _collective(self, kind: str, meta: dict, payload: bytes):
         op_id = self._next_op()
-        if self.world_size == 1:
-            result = self._state.contribute(op_id, kind, 0, meta, payload)
-            return self._slice_result(result, 0, kind)
-        if self.rank == 0:
-            result = self._state.contribute(op_id, kind, 0, meta, payload)
+        if self.rank == 0 or self.world_size == 1:
+            result = self._state.contribute(op_id, kind, 0, meta, payload,
+                                            timeout=self._timeout)
             return self._slice_result(result, 0, kind)
         _send_msg(self._sock, {"kind": kind, "op_id": op_id, "meta": meta},
                   payload)
-        return _recv_msg(self._sock)
+        reply, data = _recv_msg(self._sock)
+        if "error" in reply:
+            raise TimeoutError(reply["error"])
+        return reply, data
 
     def allreduce(self, arr: np.ndarray, op: ReduceOp = ReduceOp.SUM):
         arr = np.ascontiguousarray(arr)

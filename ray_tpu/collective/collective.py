@@ -23,7 +23,7 @@ class GroupManager:
         self._groups: dict[str, Any] = {}
 
     def create_group(self, group_name: str, world_size: int, rank: int,
-                     backend: Backend):
+                     backend: Backend, timeout: float = 60.0):
         backend = Backend(backend)
         if backend == Backend.AUTO:
             backend = Backend.XLA if world_size == 1 else Backend.HOST
@@ -33,7 +33,7 @@ class GroupManager:
         if backend == Backend.HOST:
             from ray_tpu.collective.backends.host_backend import HostGroup
 
-            group = HostGroup(group_name, world_size, rank)
+            group = HostGroup(group_name, world_size, rank, timeout=timeout)
         else:
             from ray_tpu.collective.backends.xla_backend import XlaGroup
 
@@ -63,17 +63,19 @@ _manager = GroupManager()
 
 def init_collective_group(world_size: int, rank: int,
                           backend: str = "host",
-                          group_name: str = "default"):
+                          group_name: str = "default",
+                          timeout: float = 60.0):
     """Initialize this process's membership in a collective group
     (reference: collective.py:93). Call from inside each participating
     actor/task with its rank."""
     return _manager.create_group(group_name, world_size, rank,
-                                 Backend(backend))
+                                 Backend(backend), timeout=timeout)
 
 
 def create_collective_group(actors, world_size: int, ranks: list[int],
                             backend: str = "host",
-                            group_name: str = "default"):
+                            group_name: str = "default",
+                            timeout: float = 60.0):
     """Driver-side declarative setup (reference: collective.py:126): tells
     every actor in `actors` to init the group with its rank."""
     import ray_tpu
@@ -82,7 +84,7 @@ def create_collective_group(actors, world_size: int, ranks: list[int],
         raise ValueError("actors/ranks/world_size mismatch")
     refs = [
         actor.__ray_collective_init__.remote(world_size, rank, backend,
-                                             group_name)
+                                             group_name, timeout)
         for actor, rank in zip(actors, ranks)
     ]
     return ray_tpu.get(refs, timeout=120)
@@ -166,6 +168,8 @@ class CollectiveActorMixin:
     """Mixin giving an actor class the __ray_collective_init__ hook used by
     create_collective_group."""
 
-    def __ray_collective_init__(self, world_size, rank, backend, group_name):
-        init_collective_group(world_size, rank, backend, group_name)
+    def __ray_collective_init__(self, world_size, rank, backend, group_name,
+                                timeout=60.0):
+        init_collective_group(world_size, rank, backend, group_name,
+                              timeout=timeout)
         return rank

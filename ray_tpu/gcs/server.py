@@ -87,6 +87,8 @@ class GcsServer:
             "create_placement_group": self.h_create_placement_group,
             "remove_placement_group": self.h_remove_placement_group,
             "get_placement_group": self.h_get_placement_group,
+            "get_named_placement_group": self.h_get_named_placement_group,
+            "list_placement_groups": self.h_list_placement_groups,
             "ping": lambda conn, data: "pong",
         }
 
@@ -156,6 +158,7 @@ class GcsServer:
         await self.publish("nodes", {"event": "added", "node": _node_public(info)})
         logger.info("node registered: %s @ %s", node_id.hex()[:8], d["address"])
         await self._try_schedule_pending_actors()
+        await self._retry_pending_pgs()
         return True
 
     async def h_heartbeat(self, conn, d):
@@ -163,6 +166,9 @@ class GcsServer:
         self.last_heartbeat[node_id] = time.monotonic()
         if "available" in d and node_id in self.nodes:
             self.available[node_id] = ResourceSet.from_raw(d["available"])
+            if any(r["state"] == "PENDING"
+                   for r in self.placement_groups.values()):
+                await self._retry_pending_pgs()
         return True
 
     async def h_get_all_nodes(self, conn, d):
@@ -411,17 +417,44 @@ class GcsServer:
     # ---- placement groups ----
     async def h_create_placement_group(self, conn, d):
         """2-phase bundle reservation across raylets (reference:
-        gcs_placement_group_scheduler.h:49; strategies :133-160)."""
+        gcs_placement_group_scheduler.h:49; strategies :133-160). Infeasible
+        groups stay PENDING and are retried as nodes join / resources free."""
         pg_id = d["pg_id"]
-        bundles = [dict(b) for b in d["bundles"]]  # list of raw resource dicts
-        strategy = d.get("strategy", "PACK")
+        self.placement_groups[pg_id] = {
+            "pg_id": pg_id, "bundles": [dict(b) for b in d["bundles"]],
+            "strategy": d.get("strategy", "PACK"), "state": "PENDING",
+            "name": d.get("name", ""),
+        }
+        return {"state": await self._try_create_pg(pg_id)}
+
+    async def _retry_pending_pgs(self):
+        for pg_id, rec in list(self.placement_groups.items()):
+            if rec["state"] == "PENDING":
+                await self._try_create_pg(pg_id)
+
+    async def _try_create_pg(self, pg_id) -> str:
+        rec = self.placement_groups.get(pg_id)
+        if rec is None:
+            return "REMOVED"
+        if rec["state"] == "CREATED":
+            return "CREATED"
+        # In-flight guard: while one 2PC attempt awaits raylet RPCs, a
+        # concurrent retry (heartbeat/node-join) must not start a second
+        # one — double prepare_bundle would double-reserve node resources.
+        if rec.get("creating"):
+            return "PENDING"
+        rec["creating"] = True
+        try:
+            return await self._do_create_pg(pg_id, rec)
+        finally:
+            rec["creating"] = False
+
+    async def _do_create_pg(self, pg_id, rec) -> str:
+        bundles = rec["bundles"]
+        strategy = rec["strategy"]
         placement = self._place_bundles(bundles, strategy)
         if placement is None:
-            self.placement_groups[pg_id] = {
-                "pg_id": pg_id, "bundles": bundles, "strategy": strategy,
-                "state": "PENDING", "name": d.get("name", ""),
-            }
-            return {"state": "PENDING"}
+            return "PENDING"
         # prepare
         prepared = []
         ok = True
@@ -448,25 +481,51 @@ class GcsServer:
                                           {"pg_id": pg_id, "bundle_index": idx})
                     except Exception:
                         pass
-            return {"state": "PENDING"}
+            return "PENDING"
         # commit
+        committed = []
         for idx, node_id in placement.items():
             conn_n = self.node_conns.get(node_id)
-            await conn_n.call("commit_bundle",
-                              {"pg_id": pg_id, "bundle_index": idx})
-        rec = {
-            "pg_id": pg_id,
-            "strategy": strategy,
-            "state": "CREATED",
-            "name": d.get("name", ""),
-            "bundles": [
-                {"bundle_index": i, "resources": bundles[i]["resources"],
-                 "node_id": placement[i]}
-                for i in range(len(bundles))
-            ],
-        }
-        self.placement_groups[pg_id] = rec
-        return {"state": "CREATED"}
+            try:
+                if conn_n is None or conn_n.closed:
+                    raise ConnectionError("node connection lost")
+                await conn_n.call("commit_bundle",
+                                  {"pg_id": pg_id, "bundle_index": idx})
+                committed.append(idx)
+            except Exception:
+                # A node died between prepare and commit: unwind everything
+                # (committed bundles returned, prepared ones cancelled) and
+                # stay PENDING for the next retry.
+                for jdx, jnode in placement.items():
+                    conn_j = self.node_conns.get(jnode)
+                    if conn_j is None or conn_j.closed:
+                        continue
+                    method = ("return_bundle" if jdx in committed
+                              else "cancel_bundle")
+                    try:
+                        await conn_j.call(method, {"pg_id": pg_id,
+                                                   "bundle_index": jdx})
+                    except Exception:
+                        pass
+                return "PENDING"
+        if self.placement_groups.get(pg_id) is not rec:
+            # Removed while the 2PC was in flight: give the bundles back.
+            for idx, node_id in placement.items():
+                conn_n = self.node_conns.get(node_id)
+                if conn_n is not None and not conn_n.closed:
+                    try:
+                        await conn_n.call("return_bundle", {
+                            "pg_id": pg_id, "bundle_index": idx})
+                    except Exception:
+                        pass
+            return "REMOVED"
+        rec["state"] = "CREATED"
+        rec["bundles"] = [
+            {"bundle_index": i, "resources": bundles[i]["resources"],
+             "node_id": placement[i]}
+            for i in range(len(bundles))
+        ]
+        return "CREATED"
 
     def _place_bundles(self, bundles, strategy):
         """Map bundle_index -> node_id, or None if infeasible now."""
@@ -546,6 +605,15 @@ class GcsServer:
 
     async def h_get_placement_group(self, conn, d):
         return self.placement_groups.get(d["pg_id"])
+
+    async def h_get_named_placement_group(self, conn, d):
+        for rec in self.placement_groups.values():
+            if rec.get("name") and rec["name"] == d["name"]:
+                return rec
+        return None
+
+    async def h_list_placement_groups(self, conn, d):
+        return list(self.placement_groups.values())
 
     # ---- lifecycle ----
     async def _on_disconnect(self, conn):

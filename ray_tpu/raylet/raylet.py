@@ -257,7 +257,10 @@ class Raylet:
             bundle = self.bundles.get(pg_key) or self._find_bundle(pg_key)
             if bundle is not None:
                 bundle["available"].add(res)
-                return
+            # Bundle already cancelled/returned: its whole reservation went
+            # back to self.available then — adding res again would mint
+            # resources out of thin air.
+            return
         self.available.add(res)
 
     def _feasible_ever(self, spec) -> bool:
@@ -280,11 +283,37 @@ class Raylet:
                 cands.append(info["address"])
         return random.choice(cands) if cands else None
 
+    async def _pg_spillback(self, key) -> str | None:
+        """A lease targeting a bundle this node doesn't host: redirect to
+        the raylet that committed it (the GCS holds bundle→node placement;
+        reference: lease_policy.h locality-aware lease target)."""
+        if self.gcs is None:
+            return None
+        try:
+            rec = await self.gcs.call("get_placement_group",
+                                      {"pg_id": key[0]})
+        except Exception:
+            return None
+        if rec is None or rec.get("state") != "CREATED":
+            return None
+        me = self.node_id.binary()
+        for b in rec["bundles"]:
+            if key[1] in (-1, b["bundle_index"]) and b["node_id"] != me:
+                info = self.cluster_nodes.get(b["node_id"])
+                if info is not None:
+                    return info["address"]
+        return None
+
     async def h_request_worker_lease(self, conn, d):
         spec = d["spec"]
         acquired = self._try_acquire(spec)
         if acquired is not None:
             return await self._grant_lease(spec, acquired)
+        key = self._bundle_key(spec)
+        if key is not None and self._find_bundle(key) is None:
+            addr = await self._pg_spillback(key)
+            if addr is not None:
+                return {"spillback": addr}
         if not self._feasible_ever(spec):
             addr = self._pick_spillback(spec)
             if addr is not None:
@@ -367,7 +396,15 @@ class Raylet:
         worker.lease_resources = res
         worker.lease_pg = pg_key
         try:
-            await worker.conn.call("create_actor", {"spec": spec})
+            reply = await worker.conn.call("create_actor", {"spec": spec})
+            # The worker packs constructor exceptions as an error result
+            # instead of raising over RPC — surface them so the GCS records
+            # a real death cause (reference: creation failures publish the
+            # actor as DEAD with the error, gcs_actor_manager.h:125-127).
+            if any(r.get("err") for r in (reply or {}).get("returns", [])):
+                raise RuntimeError(
+                    f"actor constructor failed: "
+                    f"{(reply or {}).get('error_repr', 'unknown error')}")
         except Exception:
             worker.actor_id = None
             self._release(res, pg_key)
@@ -432,6 +469,7 @@ class Raylet:
         bundle = self.bundles.pop((d["pg_id"], d["bundle_index"]), None)
         if bundle is not None:
             self.available.add(bundle["resources"])
+            await self._dispatch_pending()
         return True
 
     async def h_return_bundle(self, conn, d):

@@ -1,0 +1,7 @@
+"""ray_tpu.train — distributed SGD training (the RaySGD equivalent;
+reference: python/ray/util/sgd/)."""
+
+from ray_tpu.train.operator import TrainingOperator
+from ray_tpu.train.trainer import Trainer, TrainWorker
+
+__all__ = ["Trainer", "TrainWorker", "TrainingOperator"]

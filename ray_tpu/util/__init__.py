@@ -1,0 +1,18 @@
+"""ray_tpu.util — distributed utilities layered on the task/actor API
+(reference: python/ray/util/__init__.py)."""
+
+from ray_tpu.util.placement_group import (
+    PlacementGroup,
+    get_placement_group,
+    placement_group,
+    placement_group_table,
+    remove_placement_group,
+)
+
+__all__ = [
+    "PlacementGroup",
+    "get_placement_group",
+    "placement_group",
+    "placement_group_table",
+    "remove_placement_group",
+]

@@ -1,0 +1,129 @@
+"""Placement groups — gang-scheduled resource bundles (reference:
+python/ray/util/placement_group.py:29 PlacementGroup, :147 placement_group;
+2PC reservation in the GCS: gcs_placement_group_scheduler.h:49, strategies
+:133-160 — here the GCS server's h_create_placement_group +
+prepare/commit_bundle on each raylet).
+
+On TPU, a STRICT_PACK bundle maps to one ICI-connected host and SPREAD
+lays data-parallel replicas across hosts; tasks/actors scheduled into a
+bundle inherit its reserved resources.
+"""
+
+from __future__ import annotations
+
+import time
+
+from ray_tpu._private import global_state
+from ray_tpu._private.ids import PlacementGroupID
+
+VALID_STRATEGIES = ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD")
+
+
+class PlacementGroup:
+    """Handle to a placement group (reference: util/placement_group.py:29)."""
+
+    def __init__(self, pg_id: PlacementGroupID,
+                 bundles: list[dict] | None = None):
+        self.id = pg_id
+        self._bundles = bundles
+
+    def ready(self, timeout: float | None = None) -> bool:
+        """Block until all bundles are reserved (reference's pg.ready() is an
+        ObjectRef; here a blocking call — pair with wait(timeout=0) for a
+        non-blocking probe)."""
+        cw = global_state.require_core_worker()
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            info = cw.get_placement_group(self.id.binary())
+            if info is None:
+                raise ValueError(
+                    f"placement group {self.id.hex()} was removed")
+            if info["state"] == "CREATED":
+                self._bundles = info["bundles"]
+                return True
+            if deadline is not None and time.monotonic() >= deadline:
+                return False
+            time.sleep(0.02)
+
+    def wait(self, timeout_seconds: float = 30) -> bool:
+        return self.ready(timeout=timeout_seconds)
+
+    @property
+    def bundle_specs(self) -> list[dict]:
+        from ray_tpu._private.common import ResourceSet
+
+        info = global_state.require_core_worker().get_placement_group(
+            self.id.binary())
+        if info is None:
+            return []
+        return [ResourceSet.from_raw(b["resources"]).to_dict()
+                for b in info["bundles"]]
+
+    @property
+    def bundle_count(self) -> int:
+        return len(self.bundle_specs)
+
+    def __repr__(self):
+        return f"PlacementGroup({self.id.hex()[:12]})"
+
+
+def placement_group(bundles: list[dict], strategy: str = "PACK",
+                    name: str = "") -> PlacementGroup:
+    """Reserve `bundles` (list of resource dicts, e.g. [{"CPU": 1}]) across
+    the cluster atomically (reference: util/placement_group.py:147)."""
+    if strategy not in VALID_STRATEGIES:
+        raise ValueError(
+            f"Invalid strategy {strategy!r}; must be one of "
+            f"{VALID_STRATEGIES}")
+    if not bundles:
+        raise ValueError("placement group needs at least one bundle")
+    for b in bundles:
+        if not isinstance(b, dict) or not b:
+            raise ValueError(f"invalid bundle {b!r}")
+        if any(v < 0 for v in b.values()):
+            raise ValueError(f"negative resource in bundle {b!r}")
+    cw = global_state.require_core_worker()
+    pg_id = PlacementGroupID.from_random()
+    cw.create_placement_group(pg_id.binary(), bundles, strategy, name)
+    return PlacementGroup(pg_id)
+
+
+def remove_placement_group(pg: PlacementGroup) -> None:
+    """Release all bundles; queued tasks targeting the group fail
+    (reference: util/placement_group.py remove_placement_group)."""
+    global_state.require_core_worker().remove_placement_group(pg.id.binary())
+
+
+def get_placement_group(name: str) -> PlacementGroup:
+    """Look up a named placement group (reference:
+    util/placement_group.py:215)."""
+    cw = global_state.require_core_worker()
+    info = cw.get_named_placement_group(name)
+    if info is None:
+        raise ValueError(f"no placement group named {name!r}")
+    return PlacementGroup(PlacementGroupID(info["pg_id"]),
+                          info.get("bundles"))
+
+
+def placement_group_table() -> dict:
+    """All placement groups keyed by hex id (reference: state.py
+    placement_group_table)."""
+    from ray_tpu._private.common import ResourceSet
+
+    cw = global_state.require_core_worker()
+
+    def _bundle(b):
+        if "resources" in b:
+            b = dict(b)
+            b["resources"] = ResourceSet.from_raw(b["resources"]).to_dict()
+        return b
+
+    return {
+        PlacementGroupID(rec["pg_id"]).hex(): {
+            "state": rec["state"],
+            "name": rec.get("name", ""),
+            "strategy": rec["strategy"],
+            "bundles": [_bundle(b) for b in rec["bundles"]],
+        }
+        for rec in cw.list_placement_groups()
+    }

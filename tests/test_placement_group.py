@@ -1,0 +1,141 @@
+"""Placement group semantics (reference: python/ray/tests/
+test_placement_group.py — creation, strategies, scheduling into bundles,
+removal, pending groups becoming ready when resources appear)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import (
+    get_placement_group,
+    placement_group,
+    placement_group_table,
+    remove_placement_group,
+)
+
+
+def test_create_ready_remove(ray_start_regular):
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="PACK")
+    assert pg.ready(timeout=10)
+    assert pg.bundle_count == 2
+    assert pg.bundle_specs[0] == {"CPU": 1}
+    table = placement_group_table()
+    assert table[pg.id.hex()]["state"] == "CREATED"
+    remove_placement_group(pg)
+    with pytest.raises(ValueError):
+        pg.ready(timeout=1)
+
+
+def test_named_placement_group(ray_start_regular):
+    pg = placement_group([{"CPU": 1}], name="my_pg")
+    assert pg.ready(timeout=10)
+    found = get_placement_group("my_pg")
+    assert found.id == pg.id
+    with pytest.raises(ValueError):
+        get_placement_group("nope")
+
+
+def test_invalid_args(ray_start_regular):
+    with pytest.raises(ValueError):
+        placement_group([{"CPU": 1}], strategy="DIAGONAL")
+    with pytest.raises(ValueError):
+        placement_group([])
+    with pytest.raises(ValueError):
+        placement_group([{"CPU": -1}])
+
+
+def test_task_scheduled_into_bundle(ray_start_regular):
+    # init(num_cpus=4): reserve 3 CPUs; a 3-CPU task only fits via the PG.
+    pg = placement_group([{"CPU": 3}])
+    assert pg.ready(timeout=10)
+
+    @ray_tpu.remote(num_cpus=3)
+    def f():
+        return "in-bundle"
+
+    out = ray_tpu.get(
+        f.options(placement_group=pg,
+                  placement_group_bundle_index=0).remote(),
+        timeout=30)
+    assert out == "in-bundle"
+    remove_placement_group(pg)
+
+
+def test_actor_in_placement_group(ray_start_regular):
+    pg = placement_group([{"CPU": 2}])
+    assert pg.ready(timeout=10)
+
+    @ray_tpu.remote(num_cpus=2)
+    class A:
+        def ping(self):
+            return "pong"
+
+    a = A.options(placement_group=pg).remote()
+    assert ray_tpu.get(a.ping.remote(), timeout=30) == "pong"
+    ray_tpu.kill(a)
+    remove_placement_group(pg)
+
+
+def test_strict_pack_infeasible_stays_pending(ray_start_cluster):
+    from ray_tpu._private.node import start_gcs
+
+    cluster = ray_start_cluster
+    cluster.gcs_svc, cluster.gcs_address = start_gcs(
+        cluster.session_dir, cluster.config)
+    cluster.add_node(num_cpus=1, is_head=True)
+    cluster.add_node(num_cpus=1)
+    cluster.connect_driver()
+
+    # 2 CPUs exist but not on one node: STRICT_PACK can't be placed.
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="STRICT_PACK")
+    assert not pg.ready(timeout=1.5)
+    # A third node with 2 CPUs makes it feasible; GCS retries on join.
+    cluster.add_node(num_cpus=2)
+    assert pg.ready(timeout=15)
+    bundles = placement_group_table()[pg.id.hex()]["bundles"]
+    assert bundles[0]["node_id"] == bundles[1]["node_id"]
+
+
+def test_strict_spread_across_nodes(ray_start_cluster):
+    from ray_tpu._private.node import start_gcs
+
+    cluster = ray_start_cluster
+    cluster.gcs_svc, cluster.gcs_address = start_gcs(
+        cluster.session_dir, cluster.config)
+    cluster.add_node(num_cpus=2, is_head=True)
+    cluster.add_node(num_cpus=2)
+    cluster.connect_driver()
+
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="STRICT_SPREAD")
+    assert pg.ready(timeout=15)
+    bundles = placement_group_table()[pg.id.hex()]["bundles"]
+    assert bundles[0]["node_id"] != bundles[1]["node_id"]
+
+    # Tasks land on each bundle's node — run one per bundle.
+    @ray_tpu.remote(num_cpus=1)
+    def where():
+        import os
+
+        return os.getpid()
+
+    pids = ray_tpu.get([
+        where.options(placement_group=pg,
+                      placement_group_bundle_index=i).remote()
+        for i in range(2)
+    ], timeout=60)
+    assert len(set(pids)) == 2
+
+
+def test_removed_pg_frees_resources(ray_start_regular):
+    pg = placement_group([{"CPU": 4}])
+    assert pg.ready(timeout=10)
+
+    @ray_tpu.remote(num_cpus=4)
+    def f():
+        return 1
+
+    # All CPUs are reserved: a plain 4-CPU task can't run until removal.
+    ref = f.remote()
+    _, not_done = ray_tpu.wait([ref], timeout=1)
+    assert not_done
+    remove_placement_group(pg)
+    assert ray_tpu.get(ref, timeout=30) == 1
