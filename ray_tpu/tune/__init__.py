@@ -1,0 +1,30 @@
+"""ray_tpu.tune — hyperparameter sweep orchestration (the Tune equivalent;
+reference: python/ray/tune/)."""
+
+from ray_tpu.tune.sample import (
+    choice,
+    grid_search,
+    loguniform,
+    qrandint,
+    randint,
+    randn,
+    sample_from,
+    uniform,
+)
+from ray_tpu.tune.trainable import Trainable, report
+from ray_tpu.tune.tune import ExperimentAnalysis, run
+
+__all__ = [
+    "ExperimentAnalysis",
+    "Trainable",
+    "choice",
+    "grid_search",
+    "loguniform",
+    "qrandint",
+    "randint",
+    "randn",
+    "report",
+    "run",
+    "sample_from",
+    "uniform",
+]

@@ -1,0 +1,120 @@
+"""Search-space primitives (reference: python/ray/tune/sample.py —
+uniform/loguniform/choice/randint/qrandint/grid_search plus .sample()).
+
+A config dict may contain Domain objects and {"grid_search": [...]} markers;
+the basic-variant searcher resolves them into concrete configs.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Sequence
+
+
+class Domain:
+    def sample(self, rng: random.Random) -> Any:
+        raise NotImplementedError
+
+
+class Uniform(Domain):
+    def __init__(self, lower: float, upper: float):
+        self.lower, self.upper = lower, upper
+
+    def sample(self, rng):
+        return rng.uniform(self.lower, self.upper)
+
+
+class LogUniform(Domain):
+    def __init__(self, lower: float, upper: float, base: float = 10):
+        import math
+
+        if lower <= 0:
+            raise ValueError("loguniform requires lower > 0")
+        self.lower, self.upper, self.base = lower, upper, base
+        self._log = (math.log(lower, base), math.log(upper, base))
+
+    def sample(self, rng):
+        return self.base ** rng.uniform(*self._log)
+
+
+class Randint(Domain):
+    """Uniform integer in [lower, upper) (reference semantics)."""
+
+    def __init__(self, lower: int, upper: int):
+        self.lower, self.upper = lower, upper
+
+    def sample(self, rng):
+        return rng.randrange(self.lower, self.upper)
+
+
+class QRandint(Domain):
+    def __init__(self, lower: int, upper: int, q: int = 1):
+        self.lower, self.upper, self.q = lower, upper, q
+
+    def sample(self, rng):
+        v = round(rng.randrange(self.lower, self.upper + 1) / self.q) * self.q
+        lo = -(-self.lower // self.q) * self.q   # ceil to a q multiple
+        hi = (self.upper // self.q) * self.q
+        return max(lo, min(hi, v))
+
+
+class Choice(Domain):
+    def __init__(self, categories: Sequence):
+        self.categories = list(categories)
+
+    def sample(self, rng):
+        return rng.choice(self.categories)
+
+
+class Normal(Domain):
+    def __init__(self, mean: float = 0.0, sd: float = 1.0):
+        self.mean, self.sd = mean, sd
+
+    def sample(self, rng):
+        return rng.gauss(self.mean, self.sd)
+
+
+def uniform(lower: float, upper: float) -> Uniform:
+    return Uniform(lower, upper)
+
+
+def loguniform(lower: float, upper: float, base: float = 10) -> LogUniform:
+    return LogUniform(lower, upper, base)
+
+
+def randint(lower: int, upper: int) -> Randint:
+    return Randint(lower, upper)
+
+
+def qrandint(lower: int, upper: int, q: int = 1) -> QRandint:
+    return QRandint(lower, upper, q)
+
+
+def choice(categories: Sequence) -> Choice:
+    return Choice(categories)
+
+
+def randn(mean: float = 0.0, sd: float = 1.0) -> Normal:
+    return Normal(mean, sd)
+
+
+def sample_from(fn) -> "Function":
+    return Function(fn)
+
+
+class Function(Domain):
+    """Lazy config-dependent sample (reference: tune.sample_from)."""
+
+    def __init__(self, fn):
+        self.fn = fn
+
+    def sample(self, rng):
+        raise TypeError("Function domains resolve against a spec")
+
+
+def grid_search(values: Sequence) -> dict:
+    return {"grid_search": list(values)}
+
+
+def is_grid(value) -> bool:
+    return isinstance(value, dict) and "grid_search" in value

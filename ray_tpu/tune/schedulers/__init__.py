@@ -1,0 +1,15 @@
+"""Trial schedulers (reference: python/ray/tune/schedulers/)."""
+
+from ray_tpu.tune.schedulers.asha import ASHAScheduler, AsyncHyperBandScheduler
+from ray_tpu.tune.schedulers.median_stopping import MedianStoppingRule
+from ray_tpu.tune.schedulers.pbt import PopulationBasedTraining
+from ray_tpu.tune.schedulers.scheduler import FIFOScheduler, TrialScheduler
+
+__all__ = [
+    "ASHAScheduler",
+    "AsyncHyperBandScheduler",
+    "FIFOScheduler",
+    "MedianStoppingRule",
+    "PopulationBasedTraining",
+    "TrialScheduler",
+]

@@ -1,0 +1,96 @@
+"""ASHA — Asynchronous Successive Halving (reference:
+python/ray/tune/schedulers/async_hyperband.py AsyncHyperBandScheduler:
+brackets of rungs at r, r*η, r*η², ...; a trial reaching a rung continues
+only if its metric is in the top 1/η of completions at that rung)."""
+
+from __future__ import annotations
+
+from ray_tpu.tune.schedulers.scheduler import TrialScheduler
+
+
+class _Bracket:
+    def __init__(self, min_t: int, max_t: int, reduction_factor: float,
+                 stop_last_trials: bool = True):
+        self.rf = reduction_factor
+        self._rungs = []  # [(milestone, {trial_id: metric})], descending
+        milestone = min_t
+        while milestone < max_t:
+            self._rungs.append((milestone, {}))
+            milestone = int(milestone * reduction_factor)
+        self._rungs.reverse()
+
+    def on_result(self, trial_id: str, cur_iter: int, metric: float) -> bool:
+        """True = continue, False = stop."""
+        keep = True
+        for milestone, recorded in self._rungs:
+            if cur_iter < milestone or trial_id in recorded:
+                continue
+            recorded[trial_id] = metric
+            vals = sorted(recorded.values(), reverse=True)
+            cutoff_idx = max(0, int(len(vals) / self.rf) - 1)
+            cutoff = vals[cutoff_idx]
+            if metric < cutoff:
+                keep = False
+            break
+        return keep
+
+
+class ASHAScheduler(TrialScheduler):
+    def __init__(self, metric: str | None = None, mode: str = "max",
+                 max_t: int = 100, grace_period: int = 1,
+                 reduction_factor: float = 4, brackets: int = 1):
+        self._metric = metric
+        self._mode = mode
+        self._max_t = max_t
+        self._grace = grace_period
+        self._rf = reduction_factor
+        self._brackets = [
+            _Bracket(grace_period * int(reduction_factor ** i), max_t,
+                     reduction_factor)
+            for i in range(brackets)
+        ]
+        self._trial_bracket: dict[str, _Bracket] = {}
+        self._counter = 0
+
+    def set_search_properties(self, metric, mode):
+        if self._metric is None:
+            self._metric = metric
+        if mode:
+            self._mode = mode
+        return True
+
+    def _signed(self, result: dict) -> float | None:
+        if self._metric not in result:
+            return None
+        v = float(result[self._metric])
+        return v if self._mode == "max" else -v
+
+    def on_trial_add(self, runner, trial):
+        bracket = self._brackets[self._counter % len(self._brackets)]
+        self._counter += 1
+        self._trial_bracket[trial.trial_id] = bracket
+
+    def on_trial_result(self, runner, trial, result):
+        value = self._signed(result)
+        it = result.get("training_iteration", 0)
+        if value is None:
+            return self.CONTINUE
+        if it >= self._max_t:
+            return self.STOP
+        bracket = self._trial_bracket[trial.trial_id]
+        return self.CONTINUE if bracket.on_result(
+            trial.trial_id, it, value) else self.STOP
+
+    def on_trial_complete(self, runner, trial, result):
+        value = self._signed(result or {})
+        if value is None:
+            return
+        bracket = self._trial_bracket.get(trial.trial_id)
+        if bracket is not None:
+            bracket.on_result(trial.trial_id,
+                              result.get("training_iteration", self._max_t),
+                              value)
+
+
+# Reference alias (async_hyperband.py exports both names).
+AsyncHyperBandScheduler = ASHAScheduler

@@ -1,0 +1,55 @@
+"""Median stopping rule (reference: python/ray/tune/schedulers/
+median_stopping_rule.py): stop a trial whose best result so far is worse
+than the median of other trials' running averages at the same iteration."""
+
+from __future__ import annotations
+
+import statistics
+from collections import defaultdict
+
+from ray_tpu.tune.schedulers.scheduler import TrialScheduler
+
+
+class MedianStoppingRule(TrialScheduler):
+    def __init__(self, metric: str | None = None, mode: str = "max",
+                 grace_period: int = 1, min_samples_required: int = 3):
+        self._metric = metric
+        self._mode = mode
+        self._grace = grace_period
+        self._min_samples = min_samples_required
+        # trial_id -> list of signed metric values per result
+        self._history: dict[str, list[float]] = defaultdict(list)
+
+    def set_search_properties(self, metric, mode):
+        if self._metric is None:
+            self._metric = metric
+        if mode:
+            self._mode = mode
+        return True
+
+    def _signed(self, result):
+        if self._metric not in result:
+            return None
+        v = float(result[self._metric])
+        return v if self._mode == "max" else -v
+
+    def on_trial_result(self, runner, trial, result):
+        value = self._signed(result)
+        if value is None:
+            return self.CONTINUE
+        history = self._history[trial.trial_id]
+        history.append(value)
+        it = len(history)
+        if it < self._grace:
+            return self.CONTINUE
+        # median of other trials' running means at this step count
+        means = [
+            statistics.fmean(h[:it])
+            for tid, h in self._history.items()
+            if tid != trial.trial_id and len(h) >= it
+        ]
+        if len(means) < self._min_samples:
+            return self.CONTINUE
+        if max(history) < statistics.median(means):
+            return self.STOP
+        return self.CONTINUE

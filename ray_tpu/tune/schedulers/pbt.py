@@ -1,0 +1,110 @@
+"""Population Based Training (reference: python/ray/tune/schedulers/pbt.py
+PopulationBasedTraining — at each perturbation_interval, bottom-quantile
+trials exploit (clone weights+config of) a top-quantile trial, then explore
+(perturb hyperparameters ×1.2/×0.8 or resample)."""
+
+from __future__ import annotations
+
+import random
+
+from ray_tpu.tune import sample as s
+from ray_tpu.tune.schedulers.scheduler import TrialScheduler
+
+
+class PopulationBasedTraining(TrialScheduler):
+    def __init__(self, metric: str | None = None, mode: str = "max",
+                 perturbation_interval: int = 5,
+                 hyperparam_mutations: dict | None = None,
+                 quantile_fraction: float = 0.25,
+                 resample_probability: float = 0.25,
+                 seed: int | None = None):
+        self._metric = metric
+        self._mode = mode
+        self._interval = perturbation_interval
+        self._mutations = hyperparam_mutations or {}
+        self._quantile = quantile_fraction
+        self._resample_prob = resample_probability
+        self._rng = random.Random(seed)
+        self._last_perturb: dict[str, int] = {}
+        # trial_id -> latest signed score
+        self._scores: dict[str, float] = {}
+        self.perturbations = 0  # exposed for tests/analysis
+
+    def set_search_properties(self, metric, mode):
+        if self._metric is None:
+            self._metric = metric
+        if mode:
+            self._mode = mode
+        return True
+
+    def _signed(self, result):
+        if self._metric not in result:
+            return None
+        v = float(result[self._metric])
+        return v if self._mode == "max" else -v
+
+    def _quantiles(self):
+        ranked = sorted(self._scores, key=self._scores.get)
+        k = max(1, int(len(ranked) * self._quantile))
+        if len(ranked) < 2 * k:
+            return [], []
+        return ranked[:k], ranked[-k:]
+
+    def _explore(self, config: dict) -> dict:
+        new = dict(config)
+        for key, spec in self._mutations.items():
+            if self._rng.random() < self._resample_prob:
+                if isinstance(spec, s.Domain):
+                    new[key] = spec.sample(self._rng)
+                elif isinstance(spec, (list, tuple)):
+                    new[key] = self._rng.choice(list(spec))
+                elif callable(spec):
+                    new[key] = spec()
+            elif isinstance(new.get(key), (int, float)):
+                factor = 1.2 if self._rng.random() > 0.5 else 0.8
+                new[key] = type(new[key])(new[key] * factor)
+            elif isinstance(spec, (list, tuple)) and new.get(key) in spec:
+                idx = list(spec).index(new[key])
+                shift = self._rng.choice([-1, 1])
+                new[key] = list(spec)[max(0, min(len(spec) - 1, idx + shift))]
+        return new
+
+    def on_trial_result(self, runner, trial, result):
+        value = self._signed(result)
+        if value is None:
+            return self.CONTINUE
+        self._scores[trial.trial_id] = value
+        it = result.get("training_iteration", 0)
+        last = self._last_perturb.get(trial.trial_id, 0)
+        if it - last < self._interval:
+            return self.CONTINUE
+        # Quantiles are only meaningful once every *live* trial has
+        # reported — otherwise early reporters exploit each other.
+        # Terminated/errored trials (whose scores were dropped) must not
+        # gate the rest of the population forever.
+        live = {t.trial_id for t in runner.trials
+                if t.status in ("PENDING", "RUNNING", "PAUSED")}
+        if not live <= set(self._scores):
+            return self.CONTINUE
+        self._last_perturb[trial.trial_id] = it
+        bottom, top = self._quantiles()
+        if trial.trial_id not in bottom:
+            return self.CONTINUE
+        donor_id = self._rng.choice(top)
+        donor = next(t for t in runner.trials if t.trial_id == donor_id)
+        if donor.checkpoint is None:
+            return self.CONTINUE
+        # exploit + explore: the runner restarts the trial from the donor's
+        # checkpoint with the mutated config.
+        trial.config = self._explore(donor.config)
+        trial.checkpoint = donor.checkpoint
+        self.perturbations += 1
+        self._last_perturb[trial.trial_id] = it
+        return "PERTURB"  # runner treats as restart-with-new-config
+
+    def on_trial_complete(self, runner, trial, result):
+        self._scores.pop(trial.trial_id, None)
+
+    def on_trial_error(self, runner, trial):
+        # Never let a dead trial linger in the ranking as a donor.
+        self._scores.pop(trial.trial_id, None)

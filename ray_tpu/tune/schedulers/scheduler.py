@@ -1,0 +1,47 @@
+"""TrialScheduler protocol (reference: python/ray/tune/schedulers/
+trial_scheduler.py) — decisions the runner acts on after each result."""
+
+from __future__ import annotations
+
+CONTINUE = "CONTINUE"
+PAUSE = "PAUSE"
+STOP = "STOP"
+
+
+class TrialScheduler:
+    CONTINUE = CONTINUE
+    PAUSE = PAUSE
+    STOP = STOP
+
+    def set_search_properties(self, metric: str | None,
+                              mode: str | None) -> bool:
+        return True
+
+    def on_trial_add(self, runner, trial):
+        pass
+
+    def on_trial_result(self, runner, trial, result: dict) -> str:
+        return CONTINUE
+
+    def on_trial_complete(self, runner, trial, result: dict):
+        pass
+
+    def on_trial_error(self, runner, trial):
+        pass
+
+    def choose_trial_to_run(self, runner):
+        """Pick the next PENDING/PAUSED trial to (re)start, or None."""
+        from ray_tpu.tune.trial import PAUSED, PENDING
+
+        for trial in runner.trials:
+            if trial.status == PENDING:
+                return trial
+        for trial in runner.trials:
+            if trial.status == PAUSED:
+                return trial
+        return None
+
+
+class FIFOScheduler(TrialScheduler):
+    """Run every trial to completion in submission order (reference:
+    trial_scheduler.py FIFOScheduler)."""

@@ -1,0 +1,8 @@
+"""Searchers (reference: python/ray/tune/suggest/) — Searcher protocol +
+BasicVariantGenerator (grid × random sampling, suggest/basic_variant.py)."""
+
+from ray_tpu.tune.search.basic_variant import BasicVariantGenerator
+from ray_tpu.tune.search.searcher import ConcurrencyLimiter, Repeater, Searcher
+
+__all__ = ["BasicVariantGenerator", "ConcurrencyLimiter", "Repeater",
+           "Searcher"]

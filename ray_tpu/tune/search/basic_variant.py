@@ -1,0 +1,94 @@
+"""BasicVariantGenerator — grid × random search (reference:
+python/ray/tune/suggest/basic_variant.py + suggest/variant_generator.py).
+
+Resolution order matches the reference: grid_search entries form the cross
+product; Domain objects are sampled per variant; sample_from Functions
+resolve last against the materialized spec.
+"""
+
+from __future__ import annotations
+
+import itertools
+import random
+from typing import Any
+
+from ray_tpu.tune import sample as s
+from ray_tpu.tune.search.searcher import Searcher
+
+
+def _walk(config: dict, path=()):
+    for key, value in config.items():
+        p = path + (key,)
+        if isinstance(value, dict) and not s.is_grid(value):
+            yield from _walk(value, p)
+        else:
+            yield p, value
+
+
+def _set(config: dict, path: tuple, value):
+    node = config
+    for key in path[:-1]:
+        node = node[key]
+    node[path[-1]] = value
+
+
+def _deepcopy_spec(config):
+    if isinstance(config, dict):
+        return {k: _deepcopy_spec(v) for k, v in config.items()}
+    if isinstance(config, list):
+        return [_deepcopy_spec(v) for v in config]
+    return config
+
+
+def generate_variants(config: dict, rng: random.Random):
+    """Yield concrete config dicts: cross-product of grids, then sampling."""
+    grid_paths = [(p, v["grid_search"]) for p, v in _walk(config)
+                  if s.is_grid(v)]
+    grids = [vals for _, vals in grid_paths]
+    for combo in itertools.product(*grids) if grids else [()]:
+        spec = _deepcopy_spec(config)
+        for (path, _), value in zip(grid_paths, combo):
+            _set(spec, path, value)
+        # sample plain domains
+        deferred = []
+        for path, value in list(_walk(spec)):
+            if isinstance(value, s.Function):
+                deferred.append((path, value))
+            elif isinstance(value, s.Domain):
+                _set(spec, path, value.sample(rng))
+        for path, fn in deferred:
+            _set(spec, path, fn.fn(spec))
+        yield spec
+
+
+class BasicVariantGenerator(Searcher):
+    def __init__(self, config: dict | None = None, num_samples: int = 1,
+                 seed: int | None = None):
+        super().__init__()
+        self._config = config or {}
+        self._num_samples = num_samples
+        self._rng = random.Random(seed)
+        self._iter = None
+        self._finished = False
+
+    def set_search_properties(self, metric, mode, config):
+        super().set_search_properties(metric, mode, config)
+        if config:
+            self._config = config
+        return True
+
+    def _variants(self):
+        for _ in range(self._num_samples):
+            yield from generate_variants(self._config, self._rng)
+
+    def suggest(self, trial_id):
+        if self._iter is None:
+            self._iter = self._variants()
+        try:
+            return next(self._iter)
+        except StopIteration:
+            self._finished = True
+            return None
+
+    def is_finished(self):
+        return self._finished

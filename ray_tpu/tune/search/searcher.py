@@ -1,0 +1,117 @@
+"""Searcher protocol + wrappers (reference: python/ray/tune/suggest/
+suggestion.py Searcher, suggest/repeater.py, suggest/concurrency_limiter)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+
+class Searcher:
+    """suggest(trial_id) -> config | None (None = exhausted for now);
+    on_trial_complete(trial_id, result) feeds the optimizer."""
+
+    def __init__(self, metric: str | None = None, mode: str | None = None):
+        self.metric = metric
+        self.mode = mode
+
+    def set_search_properties(self, metric: str | None, mode: str | None,
+                              config: dict) -> bool:
+        if self.metric is None:
+            self.metric = metric
+        if self.mode is None:
+            self.mode = mode
+        return True
+
+    def suggest(self, trial_id: str) -> dict | None:
+        raise NotImplementedError
+
+    def on_trial_result(self, trial_id: str, result: dict):
+        pass
+
+    def on_trial_complete(self, trial_id: str, result: dict | None = None,
+                          error: bool = False):
+        pass
+
+    def is_finished(self) -> bool:
+        return False
+
+
+class ConcurrencyLimiter(Searcher):
+    """Caps concurrent unfinished suggestions (reference:
+    suggest/suggestion.py ConcurrencyLimiter)."""
+
+    def __init__(self, searcher: Searcher, max_concurrent: int):
+        super().__init__(searcher.metric, searcher.mode)
+        self.searcher = searcher
+        self.max_concurrent = max_concurrent
+        self._live: set[str] = set()
+
+    def set_search_properties(self, metric, mode, config):
+        return self.searcher.set_search_properties(metric, mode, config)
+
+    def suggest(self, trial_id):
+        if len(self._live) >= self.max_concurrent:
+            return None
+        config = self.searcher.suggest(trial_id)
+        if config is not None:
+            self._live.add(trial_id)
+        return config
+
+    def on_trial_result(self, trial_id, result):
+        self.searcher.on_trial_result(trial_id, result)
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        self._live.discard(trial_id)
+        self.searcher.on_trial_complete(trial_id, result, error)
+
+    def is_finished(self):
+        return self.searcher.is_finished()
+
+
+class Repeater(Searcher):
+    """Repeats each suggestion N times and reports the averaged metric to
+    the wrapped searcher (reference: suggest/repeater.py)."""
+
+    def __init__(self, searcher: Searcher, repeat: int):
+        super().__init__(searcher.metric, searcher.mode)
+        self.searcher = searcher
+        self.repeat = repeat
+        self._groups: dict[str, dict[str, Any]] = {}
+        self._trial_group: dict[str, str] = {}
+        self._pending: list[tuple[str, dict]] = []
+
+    def set_search_properties(self, metric, mode, config):
+        return self.searcher.set_search_properties(metric, mode, config)
+
+    def suggest(self, trial_id):
+        if not self._pending:
+            base = self.searcher.suggest(trial_id)
+            if base is None:
+                return None
+            group_id = trial_id
+            self._groups[group_id] = {"config": base, "results": [],
+                                      "outstanding": self.repeat}
+            self._pending = [(group_id, base)] * self.repeat
+        group_id, config = self._pending.pop(0)
+        self._trial_group[trial_id] = group_id
+        return dict(config)
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        group_id = self._trial_group.pop(trial_id, None)
+        if group_id is None:
+            return
+        group = self._groups[group_id]
+        group["outstanding"] -= 1
+        if result and self.searcher.metric in result:
+            group["results"].append(result[self.searcher.metric])
+        if group["outstanding"] == 0:
+            vals = group["results"]
+            avg = sum(vals) / len(vals) if vals else None
+            final = dict(result or {})
+            if avg is not None:
+                final[self.searcher.metric] = avg
+            self.searcher.on_trial_complete(group_id, final, error)
+            del self._groups[group_id]
+
+    def is_finished(self):
+        return not self._pending and self.searcher.is_finished()

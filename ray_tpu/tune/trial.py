@@ -1,0 +1,41 @@
+"""Trial — one hyperparameter configuration's lifecycle state
+(reference: python/ray/tune/trial.py)."""
+
+from __future__ import annotations
+
+import itertools
+
+PENDING = "PENDING"
+RUNNING = "RUNNING"
+PAUSED = "PAUSED"
+TERMINATED = "TERMINATED"
+ERROR = "ERROR"
+
+_counter = itertools.count()
+
+
+class Trial:
+    def __init__(self, config: dict, trial_id: str | None = None,
+                 experiment_tag: str = ""):
+        self.trial_id = trial_id or f"trial_{next(_counter):05d}"
+        self.config = config
+        self.experiment_tag = experiment_tag
+        self.status = PENDING
+        self.last_result: dict = {}
+        self.results: list[dict] = []
+        self.checkpoint: bytes | None = None
+        self.last_checkpoint_iter = -1
+        self.error: str | None = None
+        self.actor = None          # handle while RUNNING/PAUSED-with-actor
+        self.inflight = None       # pending train.remote() ref
+
+    @property
+    def iteration(self) -> int:
+        return self.last_result.get("training_iteration", 0)
+
+    def metric(self, name: str, default=None):
+        return self.last_result.get(name, default)
+
+    def __repr__(self):
+        return (f"Trial({self.trial_id}, {self.status}, "
+                f"it={self.iteration})")

@@ -1,0 +1,230 @@
+"""TrialRunner — the tune event loop (reference: python/ray/tune/
+trial_runner.py:145, step :456; executor: ray_trial_executor.py:138 —
+trials run as remote actors; results fetched with ray_tpu.wait)."""
+
+from __future__ import annotations
+
+import logging
+import time
+
+import cloudpickle
+
+import ray_tpu
+from ray_tpu import exceptions as exc
+from ray_tpu.tune.schedulers.scheduler import FIFOScheduler
+from ray_tpu.tune.trial import (
+    ERROR, PAUSED, PENDING, RUNNING, TERMINATED, Trial,
+)
+
+logger = logging.getLogger("ray_tpu.tune")
+
+
+class _TrainableActor:
+    """The remote shell holding one Trainable instance (reference:
+    ray_trial_executor.py:496 start_trial)."""
+
+    def __init__(self, trainable_cls_pickled: bytes, config: dict):
+        cls = cloudpickle.loads(trainable_cls_pickled)
+        self._trainable = cls(config)
+
+    def train(self):
+        return self._trainable.train()
+
+    def save(self):
+        return self._trainable.save()
+
+    def restore(self, blob: bytes):
+        self._trainable.restore(blob)
+        return True
+
+    def reset(self, new_config: dict) -> bool:
+        ok = self._trainable.reset_config(new_config)
+        if ok:
+            self._trainable.config = new_config
+        return bool(ok)
+
+    def stop(self):
+        try:
+            self._trainable.stop()
+        finally:
+            ray_tpu.exit_actor()
+
+
+class TrialRunner:
+    def __init__(self, trainable_cls, *, search_alg, scheduler=None,
+                 metric: str | None = None, mode: str = "max",
+                 stop: dict | None = None,
+                 max_concurrent_trials: int = 0,
+                 resources_per_trial: dict | None = None,
+                 checkpoint_freq: int = 0,
+                 max_failures: int = 0):
+        self._trainable_cls = trainable_cls
+        self._pickled_cls = cloudpickle.dumps(trainable_cls)
+        self._search = search_alg
+        self._scheduler = scheduler or FIFOScheduler()
+        self._metric = metric
+        self._mode = mode
+        self._stop = stop or {}
+        self._max_concurrent = max_concurrent_trials
+        self._resources = dict(resources_per_trial or {"CPU": 1})
+        self._checkpoint_freq = checkpoint_freq
+        self._max_failures = max_failures
+        self._failures: dict[str, int] = {}
+        self.trials: list[Trial] = []
+        self._search.set_search_properties(metric, mode, None)
+        self._scheduler.set_search_properties(metric, mode)
+
+    # -- trial lifecycle -------------------------------------------------
+
+    def _next_trial(self) -> Trial | None:
+        trial_id = f"trial_{len(self.trials):05d}"
+        config = self._search.suggest(trial_id)
+        if config is None:
+            return None
+        trial = Trial(config, trial_id=trial_id)
+        self.trials.append(trial)
+        self._scheduler.on_trial_add(self, trial)
+        return trial
+
+    def _start_trial(self, trial: Trial):
+        actor_cls = ray_tpu.remote(resources=dict(self._resources))(
+            _TrainableActor)
+        trial.actor = actor_cls.remote(self._pickled_cls, dict(trial.config))
+        if trial.checkpoint is not None:
+            trial.actor.restore.remote(trial.checkpoint)
+        trial.status = RUNNING
+        trial.inflight = trial.actor.train.remote()
+
+    def _stop_trial(self, trial: Trial, status: str):
+        trial.status = status
+        trial.inflight = None
+        if trial.actor is not None:
+            try:
+                trial.actor.stop.remote()
+            except Exception:
+                pass
+            trial.actor = None
+
+    def _pause_trial(self, trial: Trial):
+        if trial.last_checkpoint_iter != trial.iteration:
+            try:
+                trial.checkpoint = ray_tpu.get(trial.actor.save.remote(),
+                                               timeout=60)
+                trial.last_checkpoint_iter = trial.iteration
+            except Exception:
+                pass
+        self._stop_trial(trial, PAUSED)
+
+    def _running(self) -> list[Trial]:
+        return [t for t in self.trials if t.status == RUNNING]
+
+    def _live_slots(self) -> int:
+        if self._max_concurrent:
+            return self._max_concurrent - len(self._running())
+        cpus = ray_tpu.cluster_resources().get("CPU", 1)
+        need = self._resources.get("CPU", 1) or 1
+        return max(1, int(cpus // need)) - len(self._running())
+
+    # -- event loop ------------------------------------------------------
+
+    def is_finished(self) -> bool:
+        active = any(t.status in (PENDING, RUNNING, PAUSED)
+                     for t in self.trials)
+        return not active and self._search.is_finished()
+
+    def step(self):
+        # 1. launch new/paused work while slots are free (resource view
+        # fetched once per step, not per launch)
+        slots = self._live_slots()
+        while slots > 0:
+            trial = self._scheduler.choose_trial_to_run(self)
+            if trial is None:
+                trial = self._next_trial()
+                if trial is None:
+                    break
+            self._start_trial(trial)
+            slots -= 1
+        running = self._running()
+        if not running:
+            return
+        # 2. wait for any result
+        refs = [t.inflight for t in running]
+        ready, _ = ray_tpu.wait(refs, num_returns=1, timeout=1.0)
+        for ref in ready:
+            trial = next(t for t in running if t.inflight == ref)
+            self._handle_result(trial, ref)
+
+    def _handle_result(self, trial: Trial, ref):
+        try:
+            result = ray_tpu.get(ref, timeout=60)
+        except (exc.TaskError, exc.ActorDiedError, exc.WorkerCrashedError,
+                exc.GetTimeoutError, exc.ObjectLostError) as e:
+            self._failures[trial.trial_id] = (
+                self._failures.get(trial.trial_id, 0) + 1)
+            if self._failures[trial.trial_id] <= self._max_failures:
+                logger.warning("trial %s failed (%s); restarting",
+                               trial.trial_id, e)
+                self._stop_trial(trial, PENDING)
+            else:
+                trial.error = str(e)
+                self._stop_trial(trial, ERROR)
+                self._scheduler.on_trial_error(self, trial)
+                self._search.on_trial_complete(trial.trial_id, None,
+                                               error=True)
+            return
+        trial.last_result = result
+        trial.results.append(result)
+        self._search.on_trial_result(trial.trial_id, result)
+        if (self._checkpoint_freq
+                and trial.iteration % self._checkpoint_freq == 0):
+            try:
+                trial.checkpoint = ray_tpu.get(trial.actor.save.remote(),
+                                               timeout=60)
+                trial.last_checkpoint_iter = trial.iteration
+            except Exception:
+                pass
+        if result.get("done") or self._should_stop(result):
+            self._complete_trial(trial, result)
+            return
+        decision = self._scheduler.on_trial_result(self, trial, result)
+        if decision == self._scheduler.STOP:
+            self._complete_trial(trial, result)
+        elif decision == self._scheduler.PAUSE:
+            self._pause_trial(trial)
+        elif decision == "PERTURB":
+            # PBT exploit/explore: prefer in-place reset_config (no actor
+            # restart); fall back to restarting from the donor checkpoint
+            # the scheduler stashed on the trial.
+            reused = False
+            try:
+                reused = ray_tpu.get(
+                    trial.actor.reset.remote(dict(trial.config)), timeout=60)
+                if reused and trial.checkpoint is not None:
+                    ray_tpu.get(trial.actor.restore.remote(trial.checkpoint),
+                                timeout=60)
+            except Exception:
+                reused = False
+            if reused:
+                trial.inflight = trial.actor.train.remote()
+            else:
+                self._stop_trial(trial, PENDING)
+        else:
+            trial.inflight = trial.actor.train.remote()
+
+    def _should_stop(self, result: dict) -> bool:
+        return any(result.get(k, float("-inf")) >= v
+                   for k, v in self._stop.items())
+
+    def _complete_trial(self, trial: Trial, result: dict):
+        self._scheduler.on_trial_complete(self, trial, result)
+        self._search.on_trial_complete(trial.trial_id, result)
+        self._stop_trial(trial, TERMINATED)
+
+    def run(self):
+        while not self.is_finished():
+            self.step()
+        # final sweep: make sure nothing is left running
+        for trial in self.trials:
+            if trial.status in (RUNNING, PAUSED, PENDING):
+                self._stop_trial(trial, TERMINATED)
+        time.sleep(0.05)

@@ -1,0 +1,109 @@
+"""tune.run — experiment entry point + ExperimentAnalysis (reference:
+python/ray/tune/tune.py:71 run; analysis.py ExperimentAnalysis)."""
+
+from __future__ import annotations
+
+import inspect
+
+from ray_tpu.tune.search.basic_variant import BasicVariantGenerator
+from ray_tpu.tune.trainable import Trainable, make_function_trainable
+from ray_tpu.tune.trial import TERMINATED
+from ray_tpu.tune.trial_runner import TrialRunner
+
+
+class ExperimentAnalysis:
+    def __init__(self, trials, metric: str | None, mode: str):
+        self.trials = trials
+        self._metric = metric
+        self._mode = mode
+
+    def _score(self, trial) -> float | None:
+        if self._metric is None or self._metric not in trial.last_result:
+            return None
+        v = float(trial.last_result[self._metric])
+        return v if self._mode == "max" else -v
+
+    @property
+    def best_trial(self):
+        scored = [(self._score(t), t) for t in self.trials]
+        scored = [(s, t) for s, t in scored if s is not None]
+        if not scored:
+            return None
+        return max(scored, key=lambda p: p[0])[1]
+
+    @property
+    def best_config(self) -> dict | None:
+        best = self.best_trial
+        return best.config if best else None
+
+    @property
+    def best_result(self) -> dict | None:
+        best = self.best_trial
+        return best.last_result if best else None
+
+    @property
+    def best_checkpoint(self):
+        best = self.best_trial
+        return best.checkpoint if best else None
+
+    def results_df(self):
+        """Rows of (trial_id, config, last metrics) — pandas if available."""
+        rows = [
+            {"trial_id": t.trial_id, "status": t.status,
+             **{f"config/{k}": v for k, v in t.config.items()},
+             **t.last_result}
+            for t in self.trials
+        ]
+        try:
+            import pandas as pd
+
+            return pd.DataFrame(rows)
+        except ImportError:
+            return rows
+
+    def dataframe(self):
+        return self.results_df()
+
+
+def run(run_or_experiment, *, config: dict | None = None,
+        num_samples: int = 1, metric: str | None = None, mode: str = "max",
+        search_alg=None, scheduler=None, stop: dict | None = None,
+        resources_per_trial: dict | None = None,
+        max_concurrent_trials: int = 0, checkpoint_freq: int = 0,
+        max_failures: int = 0, verbose: int = 1,
+        raise_on_failed_trial: bool = True) -> ExperimentAnalysis:
+    """Run a hyperparameter sweep (reference: tune/tune.py:71).
+
+    `run_or_experiment`: Trainable subclass or `def fn(config)` (generator
+    yielding result dicts, or using tune.report)."""
+    if mode not in ("min", "max"):
+        raise ValueError("mode must be 'min' or 'max'")
+    if inspect.isclass(run_or_experiment) and issubclass(
+            run_or_experiment, Trainable):
+        trainable_cls = run_or_experiment
+    elif callable(run_or_experiment):
+        trainable_cls = make_function_trainable(run_or_experiment)
+    else:
+        raise TypeError(f"not a trainable: {run_or_experiment!r}")
+
+    search = search_alg or BasicVariantGenerator(
+        config or {}, num_samples=num_samples)
+    runner = TrialRunner(
+        trainable_cls,
+        search_alg=search,
+        scheduler=scheduler,
+        metric=metric,
+        mode=mode,
+        stop=stop,
+        max_concurrent_trials=max_concurrent_trials,
+        resources_per_trial=resources_per_trial,
+        checkpoint_freq=checkpoint_freq,
+        max_failures=max_failures,
+    )
+    runner.run()
+    errored = [t for t in runner.trials if t.status == "ERROR"]
+    if errored and raise_on_failed_trial:
+        raise RuntimeError(
+            f"{len(errored)} trial(s) errored; first: "
+            f"{errored[0].trial_id}: {errored[0].error}")
+    return ExperimentAnalysis(runner.trials, metric, mode)
