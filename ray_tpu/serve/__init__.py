@@ -1,0 +1,19 @@
+"""ray_tpu.serve — actor-based model serving with dynamic micro-batching
+(the Serve equivalent; reference: python/ray/serve/). On TPU the batch is
+what fills the MXU: the router groups queries to max_batch_size before one
+replica RPC."""
+
+from ray_tpu.serve.api import Client, connect, shutdown, start
+from ray_tpu.serve.config import BackendConfig
+from ray_tpu.serve.replica import accept_batch
+from ray_tpu.serve.router import ServeHandle
+
+__all__ = [
+    "BackendConfig",
+    "Client",
+    "ServeHandle",
+    "accept_batch",
+    "connect",
+    "shutdown",
+    "start",
+]

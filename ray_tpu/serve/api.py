@@ -1,0 +1,132 @@
+"""serve public API (reference: python/ray/serve/api.py — serve.start
+:533, Client.create_endpoint :186, create_backend :330, get_handle)."""
+
+from __future__ import annotations
+
+import cloudpickle
+
+import ray_tpu
+from ray_tpu.serve.config import BackendConfig
+from ray_tpu.serve.controller import ServeController
+from ray_tpu.serve.http_proxy import HTTPProxy
+from ray_tpu.serve.router import ServeHandle
+
+_client = None
+
+
+class Client:
+    def __init__(self, controller, proxy=None, http_port: int | None = None):
+        self._controller = controller
+        self._proxy = proxy
+        self._http_port = http_port
+        self._handles: dict[str, ServeHandle] = {}
+
+    # -- backends --------------------------------------------------------
+
+    def create_backend(self, name: str, func_or_class, *init_args,
+                       config: BackendConfig | dict | None = None):
+        cfg = config or BackendConfig()
+        if isinstance(cfg, BackendConfig):
+            cfg = cfg.to_dict()
+        else:
+            cfg = BackendConfig.from_dict(cfg).to_dict()
+        ray_tpu.get(self._controller.create_backend.remote(
+            name, cloudpickle.dumps(func_or_class), tuple(init_args), cfg),
+            timeout=120)
+
+    def delete_backend(self, name: str):
+        ray_tpu.get(self._controller.delete_backend.remote(name), timeout=60)
+
+    def update_backend_config(self, name: str,
+                              config: BackendConfig | dict):
+        if isinstance(config, BackendConfig):
+            config = config.to_dict()
+        ray_tpu.get(self._controller.update_backend_config.remote(
+            name, dict(config)), timeout=120)
+
+    def get_backend_config(self, name: str) -> BackendConfig:
+        return BackendConfig.from_dict(ray_tpu.get(
+            self._controller.get_backend_config.remote(name), timeout=60))
+
+    def list_backends(self) -> list[str]:
+        return ray_tpu.get(self._controller.list_backends.remote(),
+                           timeout=60)
+
+    # -- endpoints -------------------------------------------------------
+
+    def create_endpoint(self, name: str, *, backend: str,
+                        route: str | None = None,
+                        methods: list[str] | None = None):
+        ray_tpu.get(self._controller.create_endpoint.remote(
+            name, backend, route, methods), timeout=60)
+
+    def delete_endpoint(self, name: str):
+        ray_tpu.get(self._controller.delete_endpoint.remote(name),
+                    timeout=60)
+
+    def list_endpoints(self) -> dict:
+        return ray_tpu.get(self._controller.list_endpoints.remote(),
+                           timeout=60)
+
+    def get_handle(self, endpoint: str) -> ServeHandle:
+        if endpoint not in self._handles:
+            self._handles[endpoint] = ServeHandle(self._controller, endpoint)
+        return self._handles[endpoint]
+
+    # -- http ------------------------------------------------------------
+
+    def enable_http(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        """Start the HTTP proxy actor after the fact; returns the port."""
+        if self._proxy is None:
+            proxy_cls = ray_tpu.remote(HTTPProxy)
+            self._proxy = proxy_cls.remote(self._controller, host, port)
+            self._http_port = ray_tpu.get(self._proxy.port.remote(),
+                                          timeout=60)
+        return self._http_port
+
+    @property
+    def http_port(self) -> int | None:
+        return self._http_port
+
+    def shutdown(self):
+        global _client
+        for handle in self._handles.values():
+            handle._router.close()
+        self._handles.clear()
+        for actor in ([self._proxy] if self._proxy else []) + [
+                self._controller]:
+            try:
+                ray_tpu.kill(actor)
+            except Exception:
+                pass
+        if _client is self:
+            _client = None
+
+
+def start(*, http: bool = False, http_host: str = "127.0.0.1",
+          http_port: int = 0, detached: bool = False) -> Client:
+    """Start (or connect to) a serve instance (reference: api.py:533)."""
+    global _client
+    if _client is not None:
+        return _client
+    controller_cls = ray_tpu.remote(ServeController)
+    controller = controller_cls.remote()
+    proxy = None
+    port = None
+    if http:
+        proxy_cls = ray_tpu.remote(HTTPProxy)
+        proxy = proxy_cls.remote(controller, http_host, http_port)
+        port = ray_tpu.get(proxy.port.remote(), timeout=60)
+    _client = Client(controller, proxy, port)
+    return _client
+
+
+def connect() -> Client:
+    if _client is None:
+        raise RuntimeError("serve has not been started in this process")
+    return _client
+
+
+def shutdown():
+    if _client is not None:
+        _client.shutdown()

@@ -1,0 +1,32 @@
+"""Backend configuration (reference: python/ray/serve/config.py
+BackendConfig — num_replicas, max_batch_size, batch_wait_timeout,
+max_concurrent_queries)."""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass
+class BackendConfig:
+    num_replicas: int = 1
+    max_batch_size: int | None = None     # None = no batching
+    batch_wait_timeout: float = 0.01      # s to wait filling a batch
+    max_concurrent_queries: int = 8       # in-flight cap per replica
+    user_config: dict | None = None
+
+    def __post_init__(self):
+        if self.num_replicas < 0:
+            raise ValueError("num_replicas must be >= 0")
+        if self.max_batch_size is not None and self.max_batch_size < 1:
+            raise ValueError("max_batch_size must be >= 1")
+        if self.max_concurrent_queries < 1:
+            raise ValueError("max_concurrent_queries must be >= 1")
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "BackendConfig":
+        return cls(**{k: v for k, v in d.items()
+                      if k in {f.name for f in dataclasses.fields(cls)}})

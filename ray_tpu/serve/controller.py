@@ -1,0 +1,129 @@
+"""ServeController actor (reference: python/ray/serve/controller.py:34 +
+backend_state.py reconciliation): owns the desired state — backends,
+endpoints, replica sets — and reconciles actual replica actors toward it.
+Config versions let routers/proxies poll-refresh (the long_poll.py idea)."""
+
+from __future__ import annotations
+
+import ray_tpu
+from ray_tpu.serve.config import BackendConfig
+from ray_tpu.serve.replica import Replica
+
+
+class ServeController:
+    def __init__(self):
+        # name -> {"config": dict, "pickled": bytes, "init_args": tuple,
+        #          "replicas": [handle]}
+        self.backends: dict[str, dict] = {}
+        # name -> {"backend": str, "route": str|None, "methods": [str]}
+        self.endpoints: dict[str, dict] = {}
+        self.version = 0
+
+    # -- backends --------------------------------------------------------
+
+    def create_backend(self, name: str, pickled_callable: bytes,
+                       init_args: tuple, config: dict):
+        if name in self.backends:
+            raise ValueError(f"backend {name!r} already exists")
+        cfg = BackendConfig.from_dict(config)
+        self.backends[name] = {
+            "config": cfg.to_dict(),
+            "pickled": pickled_callable,
+            "init_args": init_args,
+            "replicas": [],
+        }
+        self._reconcile(name)
+        self.version += 1
+        return True
+
+    def delete_backend(self, name: str):
+        rec = self.backends.pop(name, None)
+        if rec is None:
+            return False
+        for handle in rec["replicas"]:
+            try:
+                ray_tpu.kill(handle)
+            except Exception:
+                pass
+        self.version += 1
+        return True
+
+    def update_backend_config(self, name: str, config: dict):
+        rec = self._backend(name)
+        merged = {**rec["config"], **config}
+        rec["config"] = BackendConfig.from_dict(merged).to_dict()
+        self._reconcile(name)
+        if rec["config"].get("user_config") is not None:
+            refs = [r.reconfigure.remote(rec["config"]["user_config"])
+                    for r in rec["replicas"]]
+            ray_tpu.get(refs, timeout=60)
+        self.version += 1
+        return True
+
+    def get_backend_config(self, name: str) -> dict:
+        return dict(self._backend(name)["config"])
+
+    def list_backends(self) -> list[str]:
+        return list(self.backends)
+
+    def _backend(self, name: str) -> dict:
+        if name not in self.backends:
+            raise ValueError(f"no backend {name!r}")
+        return self.backends[name]
+
+    def _reconcile(self, name: str):
+        rec = self._backend(name)
+        want = rec["config"]["num_replicas"]
+        replicas = rec["replicas"]
+        replica_cls = ray_tpu.remote(Replica)
+        while len(replicas) < want:
+            replicas.append(replica_cls.remote(
+                rec["pickled"], rec["init_args"],
+                rec["config"].get("user_config")))
+        while len(replicas) > want:
+            handle = replicas.pop()
+            try:
+                ray_tpu.kill(handle)
+            except Exception:
+                pass
+
+    # -- endpoints -------------------------------------------------------
+
+    def create_endpoint(self, name: str, backend: str,
+                        route: str | None = None,
+                        methods: list[str] | None = None):
+        self._backend(backend)
+        self.endpoints[name] = {
+            "backend": backend,
+            "route": route,
+            "methods": [m.upper() for m in (methods or ["GET"])],
+        }
+        self.version += 1
+        return True
+
+    def delete_endpoint(self, name: str):
+        out = self.endpoints.pop(name, None) is not None
+        self.version += 1
+        return out
+
+    def list_endpoints(self) -> dict:
+        return {k: {kk: vv for kk, vv in v.items()}
+                for k, v in self.endpoints.items()}
+
+    # -- router/proxy state sync ----------------------------------------
+
+    def get_version(self) -> int:
+        return self.version
+
+    def get_routing_state(self, endpoint: str) -> dict:
+        """Everything a router needs to drive one endpoint."""
+        ep = self.endpoints.get(endpoint)
+        if ep is None:
+            raise ValueError(f"no endpoint {endpoint!r}")
+        rec = self._backend(ep["backend"])
+        return {
+            "version": self.version,
+            "backend": ep["backend"],
+            "config": dict(rec["config"]),
+            "replicas": list(rec["replicas"]),
+        }

@@ -1,0 +1,102 @@
+"""HTTP proxy actor (reference: python/ray/serve/http_proxy.py:165
+HTTPProxyActor — uvicorn/starlette there, aiohttp here). Routes
+`route -> endpoint` from the controller; JSON bodies in/out."""
+
+from __future__ import annotations
+
+import json
+import threading
+
+
+class HTTPProxy:
+    """Actor: runs an aiohttp server on a thread; one Router per endpoint."""
+
+    def __init__(self, controller, host: str = "127.0.0.1", port: int = 0):
+        self._controller = controller
+        self._routers: dict[str, object] = {}
+        self._routes: dict[str, dict] = {}
+        self._version = -1
+        self._host = host
+        self._port = port
+        self._actual_port = None
+        self._ready = threading.Event()
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+        self._ready.wait(timeout=10)
+
+    def _refresh_routes(self):
+        import ray_tpu
+
+        version = ray_tpu.get(self._controller.get_version.remote(),
+                              timeout=30)
+        if version == self._version:
+            return
+        endpoints = ray_tpu.get(self._controller.list_endpoints.remote(),
+                                timeout=30)
+        self._routes = {
+            ep["route"]: {"endpoint": name, "methods": ep["methods"]}
+            for name, ep in endpoints.items() if ep.get("route")
+        }
+        self._version = version
+
+    def _router_for(self, endpoint: str):
+        if endpoint not in self._routers:
+            from ray_tpu.serve.router import Router
+
+            self._routers[endpoint] = Router(self._controller, endpoint)
+        return self._routers[endpoint]
+
+    def _serve(self):
+        import asyncio
+
+        from aiohttp import web
+
+        async def handler(request: "web.Request"):
+            body = await request.read()
+            loop = asyncio.get_running_loop()
+
+            # Everything blocking (controller RPCs, routing, get) runs in
+            # the executor — the event loop only parses/serializes HTTP.
+            def _call():
+                import ray_tpu
+
+                self._refresh_routes()
+                route = self._routes.get(request.path)
+                if route is None:
+                    return 404, {"error": f"no route {request.path}"}
+                if request.method.upper() not in route["methods"]:
+                    return 405, {
+                        "error": f"method {request.method} not allowed"}
+                try:
+                    data = json.loads(body) if body else None
+                except json.JSONDecodeError:
+                    return 400, {"error": "invalid JSON"}
+                router = self._router_for(route["endpoint"])
+                try:
+                    ref = router.assign(data)
+                    return 200, {"result": ray_tpu.get(ref, timeout=60)}
+                except Exception as e:
+                    return 500, {"error": str(e)}
+
+            status, payload = await loop.run_in_executor(None, _call)
+            return web.json_response(payload, status=status)
+
+        async def run():
+            app = web.Application()
+            app.router.add_route("*", "/{tail:.*}", handler)
+            runner = web.AppRunner(app)
+            await runner.setup()
+            site = web.TCPSite(runner, self._host, self._port)
+            await site.start()
+            self._actual_port = site._server.sockets[0].getsockname()[1]
+            self._ready.set()
+            while True:
+                await asyncio.sleep(3600)
+
+        asyncio.run(run())
+
+    def port(self) -> int:
+        return self._actual_port
+
+    def ping(self):
+        return "pong"

@@ -1,0 +1,64 @@
+"""Replica actor (reference: python/ray/serve/backend_worker.py:175
+RayServeReplica). Batching lives router-side here (the BatchQueue idea,
+backend_worker.py:33, moved to the caller so one actor RPC carries a whole
+batch — on TPU the batch is the unit that fills the MXU)."""
+
+from __future__ import annotations
+
+import inspect
+
+import cloudpickle
+
+
+def _is_accept_batch(fn) -> bool:
+    return getattr(fn, "_serve_accept_batch", False)
+
+
+def accept_batch(fn):
+    """Mark a callable as taking a LIST of requests per call (reference:
+    serve/api.py:697 accept_batch)."""
+    fn._serve_accept_batch = True
+    return fn
+
+
+class Replica:
+    """Hosts one copy of the user's callable."""
+
+    def __init__(self, pickled_callable: bytes, init_args: tuple,
+                 user_config: dict | None):
+        target = cloudpickle.loads(pickled_callable)
+        if inspect.isclass(target):
+            self._callable = target(*init_args)
+            call = getattr(self._callable, "__call__", None)
+            self._accept_batch = _is_accept_batch(
+                getattr(type(self._callable), "__call__", None)) or \
+                _is_accept_batch(call)
+        else:
+            self._callable = target
+            self._accept_batch = _is_accept_batch(target)
+        if user_config is not None:
+            reconfigure = getattr(self._callable, "reconfigure", None)
+            if reconfigure:
+                reconfigure(user_config)
+
+    def reconfigure(self, user_config: dict):
+        fn = getattr(self._callable, "reconfigure", None)
+        if fn:
+            fn(user_config)
+        return True
+
+    def handle_batch(self, requests: list):
+        """One RPC per batch; returns per-request results (the runtime
+        splits them into the callers' ObjectRefs via num_returns)."""
+        if self._accept_batch:
+            out = self._callable(requests)
+            if len(out) != len(requests):
+                raise ValueError(
+                    f"accept_batch callable returned {len(out)} results "
+                    f"for {len(requests)} requests")
+        else:
+            out = [self._callable(r) for r in requests]
+        return tuple(out) if len(out) > 1 else out[0]
+
+    def ping(self):
+        return "pong"

@@ -1,0 +1,195 @@
+"""Router — batches queries and balances them over replicas (reference:
+python/ray/serve/router.py:178 Router / :48 ReplicaSet; micro-batching from
+backend_worker.py:33 BatchQueue lives here so one actor RPC carries a full
+batch — the TPU-relevant unit of work).
+
+Each endpoint gets a flusher thread: queries queue up to max_batch_size or
+batch_wait_timeout, then fly to the least-loaded replica with a free slot
+(max_concurrent_queries in-flight batches per replica). A single completion
+thread polls outstanding batches to release replica slots."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class _PendingQuery:
+    __slots__ = ("data", "event", "ref", "error", "abandoned")
+
+    def __init__(self, data):
+        self.data = data
+        self.event = threading.Event()
+        self.ref = None
+        self.error = None
+        self.abandoned = False
+
+
+class Router:
+    def __init__(self, controller, endpoint: str,
+                 refresh_interval: float = 0.25):
+        self._controller = controller
+        self._endpoint = endpoint
+        self._refresh_interval = refresh_interval
+        self._lock = threading.Lock()
+        self._queue: list[_PendingQuery] = []
+        self._inflight: dict[bytes, int] = {}   # actor_id -> live batches
+        self._outstanding: list[tuple[bytes, list]] = []  # (actor_id, refs)
+        self._state = None
+        self._state_time = 0.0
+        self._closed = False
+        self._wake = threading.Event()
+        self._refresh()
+        self._flusher = threading.Thread(target=self._flush_loop, daemon=True)
+        self._flusher.start()
+        self._completer = threading.Thread(target=self._completion_loop,
+                                           daemon=True)
+        self._completer.start()
+
+    # -- state sync ------------------------------------------------------
+
+    def _refresh(self):
+        import ray_tpu
+
+        self._state = ray_tpu.get(
+            self._controller.get_routing_state.remote(self._endpoint),
+            timeout=30)
+        self._state_time = time.monotonic()
+
+    def _maybe_refresh(self):
+        if time.monotonic() - self._state_time > self._refresh_interval:
+            try:
+                self._refresh()
+            except Exception:
+                pass
+
+    # -- client surface --------------------------------------------------
+
+    def assign(self, data, timeout: float = 30.0):
+        """Enqueue one query; block until its batch is dispatched; return
+        the caller's ObjectRef slice of the batched call."""
+        q = _PendingQuery(data)
+        with self._lock:
+            self._queue.append(q)
+        self._wake.set()
+        if not q.event.wait(timeout):
+            # Nobody will consume the result — withdraw the query so it
+            # doesn't burn a replica slot after we've given up on it.
+            with self._lock:
+                q.abandoned = True
+                if q in self._queue:
+                    self._queue.remove(q)
+            raise TimeoutError(
+                f"no replica accepted the query within {timeout}s")
+        if q.error is not None:
+            raise q.error
+        return q.ref
+
+    def close(self):
+        self._closed = True
+        self._wake.set()
+
+    # -- flusher ---------------------------------------------------------
+
+    def _pick_replica(self):
+        cfg = self._state["config"]
+        cap = cfg["max_concurrent_queries"]
+        with self._lock:
+            best, best_load = None, None
+            for handle in self._state["replicas"]:
+                load = self._inflight.get(handle._actor_id.binary(), 0)
+                if load < cap and (best_load is None or load < best_load):
+                    best, best_load = handle, load
+        return best
+
+    def _flush_loop(self):
+        while not self._closed:
+            self._wake.wait(timeout=0.05)
+            self._wake.clear()
+            while not self._closed:
+                self._maybe_refresh()
+                cfg = self._state["config"]
+                max_bs = cfg["max_batch_size"] or 1
+                with self._lock:
+                    if not self._queue:
+                        break
+                # fill a batch (or give stragglers batch_wait_timeout)
+                if cfg["max_batch_size"]:
+                    deadline = time.monotonic() + cfg["batch_wait_timeout"]
+                    while (not self._closed
+                           and len(self._queue) < max_bs
+                           and time.monotonic() < deadline):
+                        time.sleep(0.001)
+                replica = self._pick_replica()
+                if replica is None:
+                    # every replica saturated — wait for capacity
+                    time.sleep(0.002)
+                    continue
+                with self._lock:
+                    batch = [q for q in self._queue[:max_bs]
+                             if not q.abandoned]
+                    del self._queue[:max_bs]
+                if not batch:
+                    continue
+                self._dispatch(replica, batch)
+
+    def _dispatch(self, replica, batch: list[_PendingQuery]):
+        key = replica._actor_id.binary()
+        with self._lock:
+            self._inflight[key] = self._inflight.get(key, 0) + 1
+        refs: list = []
+        try:
+            out = replica.handle_batch.options(
+                num_returns=len(batch)).remote([q.data for q in batch])
+            refs = [out] if len(batch) == 1 else list(out)
+            for q, ref in zip(batch, refs):
+                q.ref = ref
+                q.event.set()
+        except Exception as e:
+            for q in batch:
+                q.error = e
+                q.event.set()
+        with self._lock:
+            if refs:
+                self._outstanding.append((key, refs))
+            else:
+                self._inflight[key] -= 1
+
+    def _completion_loop(self):
+        """One thread polls every outstanding batch; a finished batch frees
+        its replica slot (no thread-per-batch)."""
+        import ray_tpu
+
+        while not self._closed:
+            with self._lock:
+                outstanding = list(self._outstanding)
+            if not outstanding:
+                time.sleep(0.005)
+                continue
+            for key, refs in outstanding:
+                try:
+                    _, not_done = ray_tpu.wait(
+                        refs, num_returns=len(refs), timeout=0)
+                except Exception:
+                    not_done = []
+                if not not_done:
+                    with self._lock:
+                        self._outstanding.remove((key, refs))
+                        self._inflight[key] -= 1
+                    self._wake.set()
+            time.sleep(0.005)
+
+
+class ServeHandle:
+    """Caller-facing handle (reference: python/ray/serve/handle.py):
+    handle.remote(data) -> ObjectRef; ray_tpu.get(ref) -> result."""
+
+    def __init__(self, controller, endpoint: str):
+        self._router = Router(controller, endpoint)
+        self.endpoint = endpoint
+
+    def remote(self, data=None):
+        return self._router.assign(data)
+
+    def __repr__(self):
+        return f"ServeHandle({self.endpoint!r})"

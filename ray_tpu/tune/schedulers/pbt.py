@@ -92,6 +92,17 @@ class PopulationBasedTraining(TrialScheduler):
             return self.CONTINUE
         donor_id = self._rng.choice(top)
         donor = next(t for t in runner.trials if t.trial_id == donor_id)
+        if donor.actor is not None:
+            # Exploit-time checkpoint (reference pbt.py saves the donor on
+            # demand) — don't depend on the runner's checkpoint_freq knob.
+            try:
+                import ray_tpu
+
+                donor.checkpoint = ray_tpu.get(donor.actor.save.remote(),
+                                               timeout=60)
+                donor.last_checkpoint_iter = donor.iteration
+            except Exception:
+                pass
         if donor.checkpoint is None:
             return self.CONTINUE
         # exploit + explore: the runner restarts the trial from the donor's

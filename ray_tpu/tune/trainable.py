@@ -7,6 +7,7 @@ from __future__ import annotations
 
 import os
 import pickle
+import shutil
 import tempfile
 import time
 
@@ -63,20 +64,25 @@ class Trainable:
         """Serialize a checkpoint to bytes (the object plane carries it;
         reference saves to disk + syncer — here checkpoints are plain
         values so multi-node restore needs no shared filesystem)."""
+        own_tmp = checkpoint_dir is None
         tmp = checkpoint_dir or tempfile.mkdtemp(prefix="tune_ckpt_")
-        data = self.save_checkpoint(tmp)
-        if isinstance(data, str):
-            # user wrote files under tmp and returned the path
-            payload = {}
-            base = data if os.path.isdir(data) else os.path.dirname(data)
-            for root, _, files in os.walk(base):
-                for f in files:
-                    p = os.path.join(root, f)
-                    with open(p, "rb") as fh:
-                        payload[os.path.relpath(p, base)] = fh.read()
-            blob = {"kind": "dir", "files": payload}
-        else:
-            blob = {"kind": "obj", "data": data}
+        try:
+            data = self.save_checkpoint(tmp)
+            if isinstance(data, str):
+                # user wrote files under tmp and returned the path
+                payload = {}
+                base = data if os.path.isdir(data) else os.path.dirname(data)
+                for root, _, files in os.walk(base):
+                    for f in files:
+                        p = os.path.join(root, f)
+                        with open(p, "rb") as fh:
+                            payload[os.path.relpath(p, base)] = fh.read()
+                blob = {"kind": "dir", "files": payload}
+            else:
+                blob = {"kind": "obj", "data": data}
+        finally:
+            if own_tmp:
+                shutil.rmtree(tmp, ignore_errors=True)
         # Framework counters ride along so a resumed trial keeps its
         # training_iteration (schedulers key rungs/intervals off it).
         blob["iteration"] = self._iteration
@@ -89,12 +95,15 @@ class Trainable:
         self._time_total = state.get("time_total", self._time_total)
         if state["kind"] == "dir":
             tmp = tempfile.mkdtemp(prefix="tune_restore_")
-            for rel, content in state["files"].items():
-                p = os.path.join(tmp, rel)
-                os.makedirs(os.path.dirname(p), exist_ok=True)
-                with open(p, "wb") as fh:
-                    fh.write(content)
-            self.load_checkpoint(tmp)
+            try:
+                for rel, content in state["files"].items():
+                    p = os.path.join(tmp, rel)
+                    os.makedirs(os.path.dirname(p), exist_ok=True)
+                    with open(p, "wb") as fh:
+                        fh.write(content)
+                self.load_checkpoint(tmp)
+            finally:
+                shutil.rmtree(tmp, ignore_errors=True)
         else:
             self.load_checkpoint(state["data"])
 
