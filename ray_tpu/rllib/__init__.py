@@ -1,0 +1,18 @@
+"""ray_tpu.rllib — reinforcement learning (the RLlib equivalent;
+reference: rllib/). JAX policies with jitted learner steps; CPU rollout
+actors feed the (TPU) learner."""
+
+from ray_tpu.rllib.agents import PPOTrainer, Trainer, build_trainer
+from ray_tpu.rllib.env import make_env, register_env
+from ray_tpu.rllib.policy import JAXPolicy, Policy, SampleBatch
+
+__all__ = [
+    "JAXPolicy",
+    "PPOTrainer",
+    "Policy",
+    "SampleBatch",
+    "Trainer",
+    "build_trainer",
+    "make_env",
+    "register_env",
+]

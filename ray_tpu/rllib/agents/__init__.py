@@ -1,0 +1,4 @@
+from ray_tpu.rllib.agents.ppo import PPOTrainer
+from ray_tpu.rllib.agents.trainer import Trainer, build_trainer
+
+__all__ = ["PPOTrainer", "Trainer", "build_trainer"]

@@ -1,0 +1,139 @@
+"""PPO (reference: rllib/agents/ppo/ppo.py + ppo_torch_policy.py):
+GAE advantages (postprocessing.py compute_advantages), clipped surrogate
+objective, value clipping, entropy bonus, minibatch SGD epochs.
+
+TPU shape: the whole SGD epoch runs as jitted steps on the learner while
+CPU rollout actors collect the next train batch."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rllib.agents.trainer import build_trainer
+from ray_tpu.rllib.policy.jax_policy import JAXPolicy
+from ray_tpu.rllib.policy.sample_batch import SampleBatch
+
+PPO_CONFIG: dict = {
+    "rollout_fragment_length": 256,
+    "train_batch_size": 1024,
+    "sgd_minibatch_size": 256,
+    "num_sgd_iter": 8,
+    "lr": 3e-4,
+    "gamma": 0.99,
+    "lambda": 0.95,
+    "clip_param": 0.2,
+    "vf_clip_param": 10.0,
+    "vf_loss_coeff": 0.5,
+    "entropy_coeff": 0.0,
+}
+
+
+def compute_gae(batch: SampleBatch, last_value: float, gamma: float,
+                lam: float) -> SampleBatch:
+    """reference: rllib/evaluation/postprocessing.py compute_advantages."""
+    rewards = batch[SampleBatch.REWARDS].astype(np.float64)
+    values = batch[SampleBatch.VF_PREDS].astype(np.float64)
+    dones = batch[SampleBatch.DONES].astype(np.float64)
+    n = len(rewards)
+    adv = np.zeros(n)
+    next_value = last_value
+    next_adv = 0.0
+    for t in range(n - 1, -1, -1):
+        nonterminal = 1.0 - dones[t]
+        delta = rewards[t] + gamma * next_value * nonterminal - values[t]
+        next_adv = delta + gamma * lam * nonterminal * next_adv
+        adv[t] = next_adv
+        next_value = values[t]
+    batch[SampleBatch.ADVANTAGES] = adv.astype(np.float32)
+    batch[SampleBatch.VALUE_TARGETS] = (adv + values).astype(np.float32)
+    return batch
+
+
+class PPOPolicy(JAXPolicy):
+    def __init__(self, observation_space, action_space, config):
+        merged = {**PPO_CONFIG, **config}
+        super().__init__(observation_space, action_space, merged,
+                         loss_fn=ppo_loss)
+
+    def postprocess_trajectory(self, batch, other_agent_batches=None,
+                               episode=None):
+        """Per-episode GAE; bootstrap non-terminated fragment tails with
+        the value function."""
+        out = []
+        for episode_batch in batch.split_by_episode():
+            if episode_batch[SampleBatch.DONES][-1]:
+                last_value = 0.0
+            else:
+                last_value = float(self.compute_values(
+                    episode_batch[SampleBatch.NEXT_OBS][-1:])[0])
+            out.append(compute_gae(episode_batch, last_value,
+                                   self.config["gamma"],
+                                   self.config["lambda"]))
+        return SampleBatch.concat_samples(out)
+
+
+def ppo_loss(params, batch, policy: PPOPolicy):
+    """reference: ppo_torch_policy.py ppo_surrogate_loss."""
+    cfg = policy.config
+    pi_out, values = JAXPolicy.model_out(
+        params, batch[SampleBatch.OBS].astype(jnp.float32))
+    logp = policy.logp_fn()(pi_out, batch[SampleBatch.ACTIONS])
+    entropy = policy.entropy_fn()(pi_out).mean()
+
+    adv = batch[SampleBatch.ADVANTAGES]
+    adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+    ratio = jnp.exp(logp - batch[SampleBatch.ACTION_LOGP])
+    surrogate = jnp.minimum(
+        adv * ratio,
+        adv * jnp.clip(ratio, 1 - cfg["clip_param"],
+                       1 + cfg["clip_param"]))
+    policy_loss = -surrogate.mean()
+
+    # Dual-clip value loss (reference ppo_torch_policy.py): clip the
+    # prediction's movement from the old value, keep the max of the two
+    # losses — clipping the error itself would zero gradients exactly
+    # when the value function is far from its targets.
+    targets = batch[SampleBatch.VALUE_TARGETS]
+    old_values = batch[SampleBatch.VF_PREDS]
+    vf_loss1 = (values - targets) ** 2
+    clipped = old_values + jnp.clip(values - old_values,
+                                    -cfg["vf_clip_param"],
+                                    cfg["vf_clip_param"])
+    vf_loss2 = (clipped - targets) ** 2
+    vf_loss = jnp.maximum(vf_loss1, vf_loss2).mean()
+
+    total = (policy_loss + cfg["vf_loss_coeff"] * vf_loss
+             - cfg["entropy_coeff"] * entropy)
+    return total, {
+        "policy_loss": policy_loss,
+        "vf_loss": vf_loss,
+        "entropy": entropy,
+        "mean_ratio": ratio.mean(),
+    }
+
+
+def ppo_train_step(workers, config) -> dict:
+    """Collect → minibatch SGD epochs → broadcast (reference:
+    ppo.py:238 execution_plan = ParallelRollouts → TrainOneStep)."""
+    target = config["train_batch_size"]
+    batches = []
+    collected = 0
+    while collected < target:
+        b = workers.sample(config["rollout_fragment_length"])
+        batches.append(b)
+        collected += len(b)
+    batch = SampleBatch.concat_samples(batches)
+
+    policy = workers.local_worker.policy
+    metrics: dict = {}
+    rng = np.random.RandomState(0)
+    for _ in range(config["num_sgd_iter"]):
+        for mb in batch.minibatches(config["sgd_minibatch_size"], rng):
+            metrics = policy.learn_on_batch(mb)
+    workers.sync_weights()
+    metrics["num_env_steps_trained"] = len(batch)
+    return metrics
+
+
+PPOTrainer = build_trainer("PPO", PPO_CONFIG, PPOPolicy, ppo_train_step)

@@ -1,0 +1,97 @@
+"""Trainer — RL algorithm shell extending tune.Trainable (reference:
+rllib/agents/trainer.py:414 Trainer, train :503, setup :551;
+trainer_template.py build_trainer)."""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from ray_tpu.rllib.evaluation.worker_set import WorkerSet
+from ray_tpu.tune.trainable import Trainable
+
+COMMON_CONFIG: dict = {
+    "env": None,
+    "env_config": {},
+    "num_workers": 0,
+    "num_envs_per_worker": 1,
+    "num_cpus_per_worker": 1,
+    "rollout_fragment_length": 200,
+    "train_batch_size": 2000,
+    "gamma": 0.99,
+    "lr": 5e-4,
+    "fcnet_hiddens": [64, 64],
+    "seed": None,
+}
+
+
+class Trainer(Trainable):
+    """Subclasses define: default_config() -> dict,
+    policy_builder(obs_space, act_space, config) -> Policy,
+    train_step(worker_set, config) -> metrics dict."""
+
+    _default_config: dict = COMMON_CONFIG
+    _name = "Trainer"
+
+    def __init__(self, config: dict | None = None, env=None):
+        config = dict(config or {})
+        if env is not None:
+            config["env"] = env
+        merged = {**COMMON_CONFIG, **self._default_config, **config}
+        super().__init__(merged)
+
+    def setup(self, config: dict):
+        if config.get("env") is None:
+            raise ValueError("config['env'] must be set")
+        self.workers = WorkerSet(
+            config["env"], type(self).policy_builder, config,
+            num_workers=config.get("num_workers", 0))
+
+    # -- to implement ---------------------------------------------------
+
+    @staticmethod
+    def policy_builder(obs_space, action_space, config):
+        raise NotImplementedError
+
+    def train_step(self) -> dict:
+        raise NotImplementedError
+
+    # -- Trainable surface ----------------------------------------------
+
+    def step(self) -> dict:
+        metrics = self.train_step()
+        metrics.update(self.workers.collect_metrics())
+        return metrics
+
+    def save_checkpoint(self, checkpoint_dir: str):
+        return {"weights": self.workers.local_worker.get_weights()}
+
+    def load_checkpoint(self, state):
+        self.workers.local_worker.set_weights(state["weights"])
+        self.workers.sync_weights()
+
+    def get_policy(self):
+        return self.workers.local_worker.policy
+
+    def compute_action(self, obs, explore: bool = False):
+        import numpy as np
+
+        actions, _ = self.get_policy().compute_actions(
+            np.asarray(obs)[None], explore=explore)
+        return actions[0]
+
+    def cleanup(self):
+        self.workers.stop()
+
+
+def build_trainer(name: str, default_config: dict,
+                  policy_builder: Callable,
+                  train_step: Callable) -> type:
+    """reference: rllib/agents/trainer_template.py:build_trainer."""
+
+    cls = type(name, (Trainer,), {
+        "_name": name,
+        "_default_config": default_config,
+        "policy_builder": staticmethod(policy_builder),
+        "train_step": lambda self: train_step(self.workers, self.config),
+    })
+    return cls

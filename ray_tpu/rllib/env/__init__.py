@@ -1,0 +1,24 @@
+"""Env registry + creation (reference: rllib/env/ + tune/registry.py
+register_env). Accepts gymnasium env ids or registered creator fns."""
+
+from __future__ import annotations
+
+from typing import Callable
+
+_ENV_REGISTRY: dict[str, Callable] = {}
+
+
+def register_env(name: str, creator: Callable):
+    """register_env("my_env", lambda config: MyEnv(config))"""
+    _ENV_REGISTRY[name] = creator
+
+
+def make_env(env_spec, env_config: dict | None = None):
+    env_config = env_config or {}
+    if callable(env_spec):
+        return env_spec(env_config)
+    if env_spec in _ENV_REGISTRY:
+        return _ENV_REGISTRY[env_spec](env_config)
+    import gymnasium
+
+    return gymnasium.make(env_spec, **env_config)

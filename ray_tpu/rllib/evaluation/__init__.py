@@ -1,0 +1,4 @@
+from ray_tpu.rllib.evaluation.rollout_worker import RolloutWorker
+from ray_tpu.rllib.evaluation.worker_set import WorkerSet
+
+__all__ = ["RolloutWorker", "WorkerSet"]

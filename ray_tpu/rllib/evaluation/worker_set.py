@@ -1,0 +1,71 @@
+"""WorkerSet — local learner worker + remote rollout actors (reference:
+rllib/evaluation/worker_set.py:27). On TPU the local worker owns the
+jitted learner step; remote workers are CPU actors producing batches."""
+
+from __future__ import annotations
+
+import cloudpickle
+
+import ray_tpu
+from ray_tpu.rllib.evaluation.rollout_worker import RolloutWorker
+from ray_tpu.rllib.policy.sample_batch import SampleBatch
+
+
+class WorkerSet:
+    def __init__(self, env_spec, policy_builder, config: dict,
+                 num_workers: int = 0):
+        pickled_builder = cloudpickle.dumps(policy_builder)
+        self.local_worker = RolloutWorker(env_spec, pickled_builder, config,
+                                          worker_index=0)
+        remote_cls = ray_tpu.remote(
+            resources={"CPU": config.get("num_cpus_per_worker", 1)})(
+            RolloutWorker)
+        self.remote_workers = [
+            remote_cls.remote(env_spec, pickled_builder, config, i + 1)
+            for i in range(num_workers)
+        ]
+
+    def sync_weights(self):
+        """Broadcast local (learner) weights to all rollout actors."""
+        if not self.remote_workers:
+            return
+        weights = self.local_worker.get_weights()
+        ray_tpu.get([w.set_weights.remote(weights)
+                     for w in self.remote_workers], timeout=120)
+
+    def sample(self, num_steps: int | None = None) -> SampleBatch:
+        """ParallelRollouts (reference: execution/rollout_ops.py:21):
+        gather one fragment from every worker."""
+        if not self.remote_workers:
+            return self.local_worker.sample(num_steps)
+        batches = ray_tpu.get(
+            [w.sample.remote(num_steps) for w in self.remote_workers],
+            timeout=600)
+        return SampleBatch.concat_samples(batches)
+
+    def collect_metrics(self) -> dict:
+        metrics = [self.local_worker.get_metrics()]
+        if self.remote_workers:
+            metrics += ray_tpu.get(
+                [w.get_metrics.remote() for w in self.remote_workers],
+                timeout=120)
+        rewards = [r for m in metrics for r in m["episode_rewards"]]
+        lengths = [l for m in metrics for l in m["episode_lengths"]]
+        return {
+            "episode_reward_mean": (sum(rewards) / len(rewards)
+                                    if rewards else float("nan")),
+            "episode_reward_min": min(rewards) if rewards else float("nan"),
+            "episode_reward_max": max(rewards) if rewards else float("nan"),
+            "episode_len_mean": (sum(lengths) / len(lengths)
+                                 if lengths else float("nan")),
+            "episodes_this_iter": len(rewards),
+        }
+
+    def stop(self):
+        self.local_worker.stop()
+        for w in self.remote_workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass
+        self.remote_workers = []

@@ -1,0 +1,5 @@
+from ray_tpu.rllib.policy.jax_policy import JAXPolicy
+from ray_tpu.rllib.policy.policy import Policy
+from ray_tpu.rllib.policy.sample_batch import MultiAgentBatch, SampleBatch
+
+__all__ = ["JAXPolicy", "MultiAgentBatch", "Policy", "SampleBatch"]

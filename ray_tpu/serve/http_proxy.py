@@ -15,6 +15,7 @@ class HTTPProxy:
         self._controller = controller
         self._routers: dict[str, object] = {}
         self._routes: dict[str, dict] = {}
+        self._state_lock = threading.Lock()
         self._version = -1
         self._host = host
         self._port = port
@@ -27,24 +28,28 @@ class HTTPProxy:
     def _refresh_routes(self):
         import ray_tpu
 
-        version = ray_tpu.get(self._controller.get_version.remote(),
-                              timeout=30)
-        if version == self._version:
-            return
-        endpoints = ray_tpu.get(self._controller.list_endpoints.remote(),
-                                timeout=30)
-        self._routes = {
-            ep["route"]: {"endpoint": name, "methods": ep["methods"]}
-            for name, ep in endpoints.items() if ep.get("route")
-        }
-        self._version = version
+        with self._state_lock:
+            version = ray_tpu.get(self._controller.get_version.remote(),
+                                  timeout=30)
+            if version == self._version:
+                return
+            endpoints = ray_tpu.get(self._controller.list_endpoints.remote(),
+                                    timeout=30)
+            self._routes = {
+                ep["route"]: {"endpoint": name, "methods": ep["methods"]}
+                for name, ep in endpoints.items() if ep.get("route")
+            }
+            self._version = version
 
     def _router_for(self, endpoint: str):
-        if endpoint not in self._routers:
-            from ray_tpu.serve.router import Router
+        # Executor threads race here; the lock keeps it to one Router
+        # (each owns flusher/completion threads) per endpoint.
+        with self._state_lock:
+            if endpoint not in self._routers:
+                from ray_tpu.serve.router import Router
 
-            self._routers[endpoint] = Router(self._controller, endpoint)
-        return self._routers[endpoint]
+                self._routers[endpoint] = Router(self._controller, endpoint)
+            return self._routers[endpoint]
 
     def _serve(self):
         import asyncio

@@ -92,7 +92,8 @@ class PopulationBasedTraining(TrialScheduler):
             return self.CONTINUE
         donor_id = self._rng.choice(top)
         donor = next(t for t in runner.trials if t.trial_id == donor_id)
-        if donor.actor is not None:
+        if (donor.actor is not None
+                and donor.last_checkpoint_iter != donor.iteration):
             # Exploit-time checkpoint (reference pbt.py saves the donor on
             # demand) — don't depend on the runner's checkpoint_freq knob.
             try:
