@@ -1,0 +1,125 @@
+"""Core microbenchmark suite (reference: python/ray/ray_perf.py, invoked
+as `ray microbenchmark`; harness: _private/ray_microbenchmark_helpers.py).
+Metric names match the reference's release logs
+(release/release_logs/1.2.0/microbenchmark.txt) so numbers are directly
+comparable with BASELINE.md."""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+import ray_tpu
+
+
+def timeit(name: str, fn, multiplier: int = 1, seconds: float = 2.0,
+           results: list | None = None):
+    """reference: ray_microbenchmark_helpers.py:timeit."""
+    # warmup
+    fn()
+    trials = []
+    for _ in range(3):
+        start = time.perf_counter()
+        count = 0
+        while time.perf_counter() - start < seconds / 3:
+            fn()
+            count += 1
+        dt = time.perf_counter() - start
+        trials.append(count * multiplier / dt)
+    mean = float(np.mean(trials))
+    sd = float(np.std(trials))
+    print(f"{name} per second {mean:.2f} +- {sd:.2f}")
+    if results is not None:
+        results.append({"name": name, "per_second": mean, "sd": sd})
+    return mean
+
+
+def main(seconds_per_case: float = 2.0) -> list[dict]:
+    results: list[dict] = []
+    ray_tpu.init()
+
+    arr = np.zeros(100, dtype=np.int64)            # small: inline path
+    big = np.zeros(10 * 1024 * 1024, dtype=np.uint8)  # 10MB: plasma path
+
+    def put_small():
+        ray_tpu.put(arr)
+
+    timeit("single client put calls", put_small, results=results)
+
+    def get_small():
+        ref = ray_tpu.put(arr)
+        ray_tpu.get(ref)
+
+    timeit("single client get calls", get_small, results=results)
+
+    def put_large():
+        ray_tpu.get(ray_tpu.put(big))
+
+    n = timeit("single client put+get large (10MB)", put_large,
+               results=results)
+    gb_s = n * big.nbytes / 1e9
+    print(f"single client put gigabytes per second {gb_s:.2f}")
+    results.append({"name": "single client put gigabytes",
+                    "per_second": gb_s, "sd": 0.0})
+
+    @ray_tpu.remote
+    def small_task():
+        return b"ok"
+
+    def task_sync():
+        ray_tpu.get(small_task.remote())
+
+    timeit("single client tasks sync", task_sync, results=results)
+
+    def tasks_async():
+        ray_tpu.get([small_task.remote() for _ in range(100)])
+
+    timeit("single client tasks async", tasks_async, multiplier=100,
+           results=results)
+
+    @ray_tpu.remote
+    class Actor:
+        def small_value(self):
+            return b"ok"
+
+    a = Actor.remote()
+
+    def actor_sync():
+        ray_tpu.get(a.small_value.remote())
+
+    timeit("1:1 actor calls sync", actor_sync, results=results)
+
+    def actor_async():
+        ray_tpu.get([a.small_value.remote() for _ in range(100)])
+
+    timeit("1:1 actor calls async", actor_async, multiplier=100,
+           results=results)
+
+    n_actors = 4
+    actors = [Actor.remote() for _ in range(n_actors)]
+
+    def actors_async():
+        refs = []
+        for actor in actors:
+            refs.extend(actor.small_value.remote() for _ in range(25))
+        ray_tpu.get(refs)
+
+    timeit("n:n actor calls async", actors_async, multiplier=100,
+           results=results)
+
+    ray_tpu.shutdown()
+    return results
+
+
+if __name__ == "__main__":
+    import argparse
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--json", action="store_true",
+                        help="also print one JSON line with all results")
+    args = parser.parse_args()
+    out = main()
+    if args.json:
+        print(json.dumps(out))

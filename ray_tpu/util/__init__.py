@@ -1,6 +1,7 @@
 """ray_tpu.util — distributed utilities layered on the task/actor API
 (reference: python/ray/util/__init__.py)."""
 
+from ray_tpu.util.actor_pool import ActorPool
 from ray_tpu.util.placement_group import (
     PlacementGroup,
     get_placement_group,
@@ -8,9 +9,14 @@ from ray_tpu.util.placement_group import (
     placement_group_table,
     remove_placement_group,
 )
+from ray_tpu.util.queue import Empty, Full, Queue
 
 __all__ = [
+    "ActorPool",
+    "Empty",
+    "Full",
     "PlacementGroup",
+    "Queue",
     "get_placement_group",
     "placement_group",
     "placement_group_table",

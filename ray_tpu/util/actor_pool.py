@@ -1,0 +1,87 @@
+"""ActorPool (reference: python/ray/util/actor_pool.py) — distribute work
+over a fixed set of actors."""
+
+from __future__ import annotations
+
+import ray_tpu
+
+
+class ActorPool:
+    def __init__(self, actors: list):
+        self._idle = list(actors)
+        self._future_to_actor: dict = {}
+        self._index_to_future: dict[int, object] = {}
+        self._next_task_index = 0
+        self._next_return_index = 0
+        self._pending_submits: list = []
+
+    def submit(self, fn, value):
+        """fn(actor, value) -> ObjectRef; queues if no actor is idle."""
+        if self._idle:
+            actor = self._idle.pop()
+            future = fn(actor, value)
+            self._future_to_actor[future] = (self._next_task_index, actor)
+            self._index_to_future[self._next_task_index] = future
+            self._next_task_index += 1
+        else:
+            self._pending_submits.append((fn, value))
+
+    def has_next(self) -> bool:
+        return bool(self._index_to_future) or bool(self._pending_submits)
+
+    def get_next(self, timeout: float | None = None):
+        """Next result in submission order."""
+        if not self.has_next():
+            raise StopIteration("no more results")
+        idx = self._next_return_index
+        self._next_return_index += 1
+        future = self._index_to_future.pop(idx)
+        value = ray_tpu.get(future, timeout=timeout)
+        self._return_actor(future)
+        return value
+
+    def get_next_unordered(self, timeout: float | None = None):
+        """Whichever result finishes first."""
+        if not self.has_next():
+            raise StopIteration("no more results")
+        ready, _ = ray_tpu.wait(list(self._future_to_actor),
+                                num_returns=1, timeout=timeout)
+        if not ready:
+            raise TimeoutError("no result within timeout")
+        future = ready[0]
+        idx, _ = self._future_to_actor[future]
+        del self._index_to_future[idx]
+        value = ray_tpu.get(future)
+        self._return_actor(future)
+        return value
+
+    def _return_actor(self, future):
+        _, actor = self._future_to_actor.pop(future)
+        self._idle.append(actor)
+        while self._pending_submits and self._idle:
+            fn, value = self._pending_submits.pop(0)
+            self.submit(fn, value)
+
+    def map(self, fn, values):
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next()
+
+    def map_unordered(self, fn, values):
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next_unordered()
+
+    def has_free(self) -> bool:
+        return bool(self._idle) and not self._pending_submits
+
+    def pop_idle(self):
+        return self._idle.pop() if self.has_free() else None
+
+    def push(self, actor):
+        self._idle.append(actor)
+        while self._pending_submits and self._idle:
+            fn, value = self._pending_submits.pop(0)
+            self.submit(fn, value)

@@ -1,0 +1,259 @@
+"""ParallelIterator (reference: python/ray/util/iter.py, 1,241 LoC) —
+sharded lazy iterators over actors.
+
+Core surface: from_items/from_range/from_iterators, for_each, filter,
+batch, flatten, local_shuffle, gather_sync, gather_async, union, take,
+num_shards. Each shard is an actor applying the op chain locally; gather
+pulls items over the task plane."""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Callable, Iterable
+
+import ray_tpu
+
+_SENTINEL = "__parallel_iter_stop__"
+
+
+class _Shard:
+    """Actor: one shard's source iterator + op chain."""
+
+    def __init__(self, make_source_pickled: bytes, ops: list):
+        import cloudpickle
+
+        self._make_source = cloudpickle.loads(make_source_pickled)
+        self._ops = [cloudpickle.loads(op) for op in ops]
+        self._it = None
+
+    def _build(self):
+        it = iter(self._make_source())
+        for kind, arg in self._ops:
+            if kind == "for_each":
+                it = map(arg, it)
+            elif kind == "filter":
+                it = filter(arg, it)
+            elif kind == "batch":
+                it = _batch_iter(it, arg)
+            elif kind == "flatten":
+                it = (x for item in it for x in item)
+            elif kind == "shuffle":
+                it = _shuffle_iter(it, *arg)
+        return it
+
+    def next_items(self, n: int = 1) -> list:
+        """Pull up to n items; a trailing _SENTINEL marks exhaustion."""
+        if self._it is None:
+            self._it = self._build()
+        out = []
+        for _ in range(n):
+            try:
+                out.append(next(self._it))
+            except StopIteration:
+                out.append(_SENTINEL)
+                break
+        return out
+
+    def reset(self):
+        self._it = None
+        return True
+
+
+def _batch_iter(it, n):
+    buf = []
+    for x in it:
+        buf.append(x)
+        if len(buf) == n:
+            yield buf
+            buf = []
+    if buf:
+        yield buf
+
+
+def _shuffle_iter(it, buffer_size, seed):
+    rng = random.Random(seed)
+    buf = []
+    for x in it:
+        buf.append(x)
+        if len(buf) >= buffer_size:
+            idx = rng.randrange(len(buf))
+            yield buf.pop(idx)
+    rng.shuffle(buf)
+    yield from buf
+
+
+class LocalIterator:
+    """Driver-side iterator over gathered shard output (reference:
+    util/iter.py LocalIterator)."""
+
+    def __init__(self, gen_fn: Callable[[], Iterable]):
+        self._gen_fn = gen_fn
+
+    def __iter__(self):
+        return iter(self._gen_fn())
+
+    def for_each(self, fn) -> "LocalIterator":
+        gen = self._gen_fn
+        return LocalIterator(lambda: map(fn, gen()))
+
+    def filter(self, fn) -> "LocalIterator":
+        gen = self._gen_fn
+        return LocalIterator(lambda: filter(fn, gen()))
+
+    def batch(self, n) -> "LocalIterator":
+        gen = self._gen_fn
+        return LocalIterator(lambda: _batch_iter(gen(), n))
+
+    def take(self, n) -> list:
+        out = []
+        for x in self:
+            out.append(x)
+            if len(out) >= n:
+                break
+        return out
+
+
+class ParallelIterator:
+    def __init__(self, source_pickles: list[bytes], ops: list[bytes],
+                 prefetch: int = 16):
+        self._sources = source_pickles
+        self._ops = ops
+        self._prefetch = prefetch
+        self._actors = None
+
+    # -- construction ---------------------------------------------------
+
+    @property
+    def actors(self):
+        if self._actors is None:
+            shard_cls = ray_tpu.remote(num_cpus=0)(_Shard)
+            self._actors = [shard_cls.remote(src, self._ops)
+                            for src in self._sources]
+        return self._actors
+
+    def _derive(self, op_kind: str, arg) -> "ParallelIterator":
+        import cloudpickle
+
+        return ParallelIterator(
+            self._sources, self._ops + [cloudpickle.dumps((op_kind, arg))],
+            self._prefetch)
+
+    # -- transforms (lazy, run inside shard actors) ----------------------
+
+    def for_each(self, fn) -> "ParallelIterator":
+        return self._derive("for_each", fn)
+
+    def filter(self, fn) -> "ParallelIterator":
+        return self._derive("filter", fn)
+
+    def batch(self, n: int) -> "ParallelIterator":
+        return self._derive("batch", n)
+
+    def flatten(self) -> "ParallelIterator":
+        return self._derive("flatten", None)
+
+    def local_shuffle(self, shuffle_buffer_size: int,
+                      seed: int | None = None) -> "ParallelIterator":
+        return self._derive("shuffle", (shuffle_buffer_size, seed))
+
+    def union(self, other: "ParallelIterator") -> "ParallelIterator":
+        if self._ops != other._ops:
+            # materialize both op chains shard-side; simplest correct form
+            raise ValueError(
+                "union requires iterators with identical op chains")
+        return ParallelIterator(self._sources + other._sources, self._ops,
+                                self._prefetch)
+
+    def num_shards(self) -> int:
+        return len(self._sources)
+
+    # -- gathering -------------------------------------------------------
+
+    def gather_sync(self) -> LocalIterator:
+        """Round-robin over shards, strict order, blocking per shard."""
+        def gen():
+            actors = list(self.actors)
+            ray_tpu.get([a.reset.remote() for a in actors], timeout=60)
+            live = list(actors)
+            while live:
+                for actor in list(live):
+                    items = ray_tpu.get(
+                        actor.next_items.remote(self._prefetch), timeout=300)
+                    for item in items:
+                        if isinstance(item, str) and item == _SENTINEL:
+                            live.remove(actor)
+                            break
+                        yield item
+        return LocalIterator(gen)
+
+    def gather_async(self) -> LocalIterator:
+        """Items as shards produce them (reference: gather_async)."""
+        def gen():
+            actors = list(self.actors)
+            ray_tpu.get([a.reset.remote() for a in actors], timeout=60)
+            inflight = {a.next_items.remote(self._prefetch): a
+                        for a in actors}
+            while inflight:
+                ready, _ = ray_tpu.wait(list(inflight), num_returns=1,
+                                        timeout=300)
+                if not ready:
+                    raise TimeoutError("shard stalled in gather_async")
+                ref = ready[0]
+                actor = inflight.pop(ref)
+                items = ray_tpu.get(ref)
+                done = False
+                for item in items:
+                    if isinstance(item, str) and item == _SENTINEL:
+                        done = True
+                        break
+                    yield item
+                if not done:
+                    inflight[actor.next_items.remote(self._prefetch)] = actor
+        return LocalIterator(gen)
+
+    def take(self, n: int) -> list:
+        return self.gather_sync().take(n)
+
+    def show(self, n: int = 20):
+        for x in self.take(n):
+            print(x)
+
+    def __iter__(self):
+        return iter(self.gather_sync())
+
+
+def from_iterators(generators: list[Callable[[], Iterable]],
+                   repeat: bool = False) -> ParallelIterator:
+    """Each callable produces one shard's (re-iterable) source."""
+    import cloudpickle
+
+    def wrap(gen_fn):
+        if not repeat:
+            return gen_fn
+
+        def repeating():
+            while True:
+                yielded = False
+                for x in gen_fn():
+                    yielded = True
+                    yield x
+                if not yielded:
+                    return
+        return repeating
+
+    return ParallelIterator(
+        [cloudpickle.dumps(wrap(g)) for g in generators], [])
+
+
+def from_items(items: list, num_shards: int = 2,
+               repeat: bool = False) -> ParallelIterator:
+    shards = [items[i::num_shards] for i in range(num_shards)]
+    return from_iterators([lambda s=s: list(s) for s in shards],
+                          repeat=repeat)
+
+
+def from_range(n: int, num_shards: int = 2,
+               repeat: bool = False) -> ParallelIterator:
+    return from_iterators(
+        [lambda i=i: range(i, n, num_shards) for i in range(num_shards)],
+        repeat=repeat)

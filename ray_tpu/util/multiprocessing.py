@@ -1,0 +1,139 @@
+"""Drop-in multiprocessing.Pool over the task plane (reference:
+python/ray/util/multiprocessing/pool.py, 679 LoC)."""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Iterable
+
+import ray_tpu
+
+
+class AsyncResult:
+    def __init__(self, refs, single: bool):
+        self._refs = refs
+        self._single = single
+
+    def get(self, timeout: float | None = None):
+        out = ray_tpu.get(self._refs, timeout=timeout)
+        return out[0] if self._single else out
+
+    def wait(self, timeout: float | None = None):
+        ray_tpu.wait(self._refs, num_returns=len(self._refs),
+                     timeout=timeout)
+
+    def ready(self) -> bool:
+        ready, _ = ray_tpu.wait(self._refs, num_returns=len(self._refs),
+                                timeout=0)
+        return len(ready) == len(self._refs)
+
+    def successful(self) -> bool:
+        try:
+            self.get(timeout=0.001)
+            return True
+        except Exception:
+            return False
+
+
+class Pool:
+    """map/starmap/apply/imap surface of multiprocessing.Pool."""
+
+    def __init__(self, processes: int | None = None,
+                 initializer: Callable | None = None,
+                 initargs: tuple = ()):
+        import ray_tpu as rt
+
+        if not rt.is_initialized():
+            rt.init()
+        self._processes = processes or int(
+            rt.cluster_resources().get("CPU", 1))
+        self._closed = False
+        # initializer support: run once per pool "slot" via tasks that
+        # execute initializer then the function (stateless workers).
+        self._initializer = initializer
+        self._initargs = initargs
+
+    def _remote_fn(self, func):
+        initializer, initargs = self._initializer, self._initargs
+
+        def call(*args):
+            if initializer is not None:
+                initializer(*initargs)
+            return func(*args)
+
+        return ray_tpu.remote(call)
+
+    def _check_open(self):
+        if self._closed:
+            raise ValueError("Pool is closed")
+
+    def apply(self, func, args: tuple = (), kwds: dict | None = None):
+        return self.apply_async(func, args, kwds).get()
+
+    def apply_async(self, func, args: tuple = (),
+                    kwds: dict | None = None) -> AsyncResult:
+        self._check_open()
+        fn = ray_tpu.remote(func)
+        ref = fn.remote(*args, **(kwds or {}))
+        return AsyncResult([ref], single=True)
+
+    def map(self, func, iterable: Iterable, chunksize: int | None = None):
+        return self.map_async(func, iterable, chunksize).get()
+
+    def map_async(self, func, iterable: Iterable,
+                  chunksize: int | None = None) -> AsyncResult:
+        self._check_open()
+        items = list(iterable)
+        chunksize = chunksize or max(1, len(items) // (self._processes * 4)
+                                     or 1)
+        chunks = [items[i:i + chunksize]
+                  for i in range(0, len(items), chunksize)]
+        fn = self._remote_fn(lambda chunk: [func(x) for x in chunk])
+        refs = [fn.remote(c) for c in chunks]
+
+        class _ChunkedResult(AsyncResult):
+            def get(self, timeout=None):
+                nested = ray_tpu.get(self._refs, timeout=timeout)
+                return list(itertools.chain.from_iterable(nested))
+
+        return _ChunkedResult(refs, single=False)
+
+    def starmap(self, func, iterable: Iterable[tuple],
+                chunksize: int | None = None):
+        return self.map(lambda args: func(*args), iterable, chunksize)
+
+    def starmap_async(self, func, iterable, chunksize=None):
+        return self.map_async(lambda args: func(*args), iterable, chunksize)
+
+    def imap(self, func, iterable: Iterable, chunksize: int = 1):
+        self._check_open()
+        fn = self._remote_fn(func)
+        refs = [fn.remote(x) for x in iterable]
+        for ref in refs:
+            yield ray_tpu.get(ref)
+
+    def imap_unordered(self, func, iterable: Iterable, chunksize: int = 1):
+        self._check_open()
+        fn = self._remote_fn(func)
+        pending = [fn.remote(x) for x in iterable]
+        while pending:
+            ready, pending = ray_tpu.wait(pending, num_returns=1,
+                                          timeout=300)
+            for ref in ready:
+                yield ray_tpu.get(ref)
+
+    def close(self):
+        self._closed = True
+
+    def terminate(self):
+        self._closed = True
+
+    def join(self):
+        if not self._closed:
+            raise ValueError("Pool is still open")
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.terminate()

@@ -1,0 +1,126 @@
+"""Distributed Queue (reference: python/ray/util/queue.py) — an
+actor-backed FIFO shared across tasks/actors."""
+
+from __future__ import annotations
+
+import time
+
+import ray_tpu
+
+
+class Empty(Exception):
+    pass
+
+
+class Full(Exception):
+    pass
+
+
+class _QueueActor:
+    def __init__(self, maxsize: int):
+        from collections import deque
+
+        self.maxsize = maxsize
+        self.items: "deque" = deque()
+
+    def qsize(self) -> int:
+        return len(self.items)
+
+    def empty(self) -> bool:
+        return not self.items
+
+    def full(self) -> bool:
+        return self.maxsize > 0 and len(self.items) >= self.maxsize
+
+    def put(self, item) -> bool:
+        if self.full():
+            return False
+        self.items.append(item)
+        return True
+
+    def put_batch(self, items: list) -> int:
+        n = 0
+        for item in items:
+            if not self.put(item):
+                break
+            n += 1
+        return n
+
+    def get(self):
+        if not self.items:
+            return False, None
+        return True, self.items.popleft()
+
+    def get_batch(self, n: int) -> list:
+        out = []
+        while self.items and len(out) < n:
+            out.append(self.items.popleft())
+        return out
+
+
+class Queue:
+    """put/get with optional blocking + timeouts (reference semantics:
+    queue.Queue surface over a shared actor)."""
+
+    def __init__(self, maxsize: int = 0, actor_options: dict | None = None):
+        cls = ray_tpu.remote(**(actor_options or {"num_cpus": 0}))(
+            _QueueActor) if actor_options else ray_tpu.remote(
+            num_cpus=0)(_QueueActor)
+        self.actor = cls.remote(maxsize)
+        self.maxsize = maxsize
+
+    def qsize(self) -> int:
+        return ray_tpu.get(self.actor.qsize.remote(), timeout=30)
+
+    def size(self) -> int:
+        return self.qsize()
+
+    def empty(self) -> bool:
+        return ray_tpu.get(self.actor.empty.remote(), timeout=30)
+
+    def full(self) -> bool:
+        return ray_tpu.get(self.actor.full.remote(), timeout=30)
+
+    def put(self, item, block: bool = True, timeout: float | None = None):
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            ok = ray_tpu.get(self.actor.put.remote(item), timeout=30)
+            if ok:
+                return
+            if not block:
+                raise Full("queue is full")
+            if deadline is not None and time.monotonic() >= deadline:
+                raise Full("queue is full (timeout)")
+            time.sleep(0.01)
+
+    def get(self, block: bool = True, timeout: float | None = None):
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            ok, item = ray_tpu.get(self.actor.get.remote(), timeout=30)
+            if ok:
+                return item
+            if not block:
+                raise Empty("queue is empty")
+            if deadline is not None and time.monotonic() >= deadline:
+                raise Empty("queue is empty (timeout)")
+            time.sleep(0.01)
+
+    def put_nowait(self, item):
+        return self.put(item, block=False)
+
+    def get_nowait(self):
+        return self.get(block=False)
+
+    def put_nowait_batch(self, items: list):
+        n = ray_tpu.get(self.actor.put_batch.remote(list(items)), timeout=30)
+        if n < len(items):
+            raise Full(f"queue accepted only {n}/{len(items)} items")
+
+    def get_nowait_batch(self, num_items: int) -> list:
+        out = ray_tpu.get(self.actor.get_batch.remote(num_items), timeout=30)
+        if len(out) < num_items:
+            raise Empty(f"queue had only {len(out)}/{num_items} items")
+        return out
+
+    def shutdown(self):
+        ray_tpu.kill(self.actor)

@@ -1,0 +1,106 @@
+"""Utility-layer tests (reference idiom: python/ray/tests/test_actor_pool,
+test_queue, test_iter, test_multiprocessing)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import ActorPool, Empty, Full, Queue
+from ray_tpu.util import iter as par_iter
+from ray_tpu.util.multiprocessing import Pool
+
+
+@ray_tpu.remote
+class _Doubler:
+    def double(self, x):
+        return 2 * x
+
+
+def test_actor_pool_ordered(ray_start_shared):
+    pool = ActorPool([_Doubler.remote() for _ in range(2)])
+    out = list(pool.map(lambda a, v: a.double.remote(v), range(8)))
+    assert out == [0, 2, 4, 6, 8, 10, 12, 14]
+
+
+def test_actor_pool_unordered_and_queueing(ray_start_shared):
+    pool = ActorPool([_Doubler.remote()])  # 1 actor, 5 submits -> queue
+    for i in range(5):
+        pool.submit(lambda a, v: a.double.remote(v), i)
+    out = set()
+    while pool.has_next():
+        out.add(pool.get_next_unordered(timeout=30))
+    assert out == {0, 2, 4, 6, 8}
+
+
+def test_queue_fifo_and_limits(ray_start_shared):
+    q = Queue(maxsize=2)
+    q.put(1)
+    q.put(2)
+    with pytest.raises(Full):
+        q.put(3, block=False)
+    assert q.qsize() == 2
+    assert q.get() == 1
+    assert q.get() == 2
+    with pytest.raises(Empty):
+        q.get_nowait()
+    q.shutdown()
+
+
+def test_queue_cross_actor(ray_start_shared):
+    q = Queue()
+
+    @ray_tpu.remote
+    def producer(q):
+        for i in range(5):
+            q.put(i)
+        return True
+
+    assert ray_tpu.get(producer.remote(q), timeout=60)
+    assert [q.get(timeout=10) for _ in range(5)] == [0, 1, 2, 3, 4]
+    q.shutdown()
+
+
+def test_parallel_iterator_transforms(ray_start_shared):
+    it = (par_iter.from_range(16, num_shards=2)
+          .for_each(lambda x: x * 2)
+          .filter(lambda x: x % 4 == 0))
+    assert sorted(it.gather_sync()) == [0, 4, 8, 12, 16, 20, 24, 28]
+
+    batched = par_iter.from_items(list(range(6)), num_shards=2).batch(2)
+    batches = list(batched.gather_sync())
+    assert all(len(b) <= 2 for b in batches)
+    assert sorted(x for b in batches for x in b) == list(range(6))
+
+
+def test_parallel_iterator_async_and_union(ray_start_shared):
+    a = par_iter.from_range(4, num_shards=1)
+    b = par_iter.from_range(4, num_shards=1)
+    # union of identical chains doubles every element
+    u = a.union(b)
+    assert sorted(u.gather_async()) == sorted(list(range(4)) * 2)
+    assert u.num_shards() == 2
+
+
+def test_parallel_iterator_shuffle(ray_start_shared):
+    it = par_iter.from_items(list(range(32)), num_shards=1)
+    out = list(it.local_shuffle(8, seed=0).gather_sync())
+    assert sorted(out) == list(range(32))
+    assert out != list(range(32))  # actually shuffled
+
+
+def test_multiprocessing_pool(ray_start_shared):
+    with Pool(processes=2) as pool:
+        assert pool.map(lambda x: x * x, range(6)) == [0, 1, 4, 9, 16, 25]
+        assert pool.apply(lambda a, b: a + b, (3, 4)) == 7
+        r = pool.apply_async(lambda: 42)
+        assert r.get(timeout=30) == 42
+        assert sorted(pool.imap_unordered(lambda x: -x, range(3))) == [
+            -2, -1, 0]
+        assert pool.starmap(lambda a, b: a * b, [(2, 3), (4, 5)]) == [6, 20]
+
+
+def test_microbenchmark_harness(ray_start_shared):
+    from ray_tpu.microbenchmark import timeit
+
+    results = []
+    timeit("noop", lambda: None, seconds=0.15, results=results)
+    assert results[0]["per_second"] > 1000
