@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import asyncio
 import concurrent.futures
+import contextvars
 import logging
 import os
 import queue as queue_mod
@@ -42,6 +43,11 @@ logger = logging.getLogger("ray_tpu.core_worker")
 
 DRIVER = "driver"
 WORKER = "worker"
+
+# Task id of the async-actor coroutine currently running on the actor's
+# event loop (asyncio snapshots the context per scheduled coroutine).
+_ASYNC_TASK_ID: contextvars.ContextVar = contextvars.ContextVar(
+    "ray_tpu_async_task_id", default=None)
 
 
 class _Lease:
@@ -128,6 +134,7 @@ class CoreWorker:
         self._actor_id: ActorID | None = None
         self._actor_reorder: dict[bytes, dict] = {}  # caller -> {next, heap}
         self._async_loop: rpc.EventLoopThread | None = None
+        self._exec_pool = None  # ThreadPoolExecutor when max_concurrency>1
         self._shutdown = False
         self._exiting = False
 
@@ -498,7 +505,11 @@ class CoreWorker:
     # ------------------------------------------------------------------
 
     def _current_task_id(self) -> TaskID:
-        return getattr(self._task_ctx, "task_id", None) or self.current_task_id
+        # Async-actor coroutines carry their task id in a contextvar (they
+        # all share the loop thread); sync tasks use the thread-local.
+        return (_ASYNC_TASK_ID.get()
+                or getattr(self._task_ctx, "task_id", None)
+                or self.current_task_id)
 
     def _serialize_args(self, args, kwargs) -> tuple[list[dict], list[ObjectID]]:
         """Returns (arg descriptors, pinned object ids)."""
@@ -1002,17 +1013,94 @@ class CoreWorker:
 
     def run_task_execution_loop(self):
         """Main loop of worker processes (reference:
-        CoreWorkerProcess::RunTaskExecutionLoop, core_worker.h:193)."""
+        CoreWorkerProcess::RunTaskExecutionLoop, core_worker.h:193).
+
+        The dispatcher thread pops tasks in arrival order (so actor tasks
+        *start* in order) but does not necessarily run them itself:
+        coroutine methods are scheduled onto the actor's asyncio loop and
+        interleave (reference: asyncio actors, _raylet.pyx:377-424), and
+        when the actor declared max_concurrency>1, sync methods run on a
+        thread pool (reference: fiber.h:30-45)."""
         while not self._shutdown:
             try:
                 item = self._exec_queue.get(timeout=0.1)
             except queue_mod.Empty:
                 continue
             spec, fut, loop = item
-            reply = self._execute_task(spec)
-            if not loop.is_closed():
-                loop.call_soon_threadsafe(
-                    lambda f=fut, r=reply: f.done() or f.set_result(r))
+            if not self._dispatch_concurrent(spec, fut, loop):
+                self._deliver_reply(self._execute_task(spec), fut, loop)
+
+    @staticmethod
+    def _deliver_reply(reply, fut, loop):
+        if not loop.is_closed():
+            loop.call_soon_threadsafe(
+                lambda f=fut, r=reply: f.done() or f.set_result(r))
+
+    def _dispatch_concurrent(self, spec, fut, loop) -> bool:
+        """Route an actor task to the async loop or the thread pool.
+        Returns False if the task should run inline on the dispatcher."""
+        if spec["type"] != common.ACTOR_TASK or self._actor_instance is None:
+            return False
+        import inspect
+
+        method = getattr(self._actor_instance, spec["method_name"], None)
+        if inspect.iscoroutinefunction(method):
+            if self._async_loop is None:
+                self._async_loop = rpc.EventLoopThread(name="actor-async")
+            # Resolve args on the dispatcher thread: _resolve_args may block
+            # on remote refs, and blocking the actor's event loop would
+            # freeze every interleaved coroutine (and deadlock if the ref
+            # is produced by this very actor).
+            try:
+                args, kwargs = self._resolve_args(spec["args"])
+            except BaseException as e:
+                self._deliver_reply(self._pack_error(spec, exc.TaskError(
+                    type(e).__name__, repr(e), traceback.format_exc())),
+                    fut, loop)
+                return True
+            cfut = self._async_loop.submit(
+                self._execute_coro_task(spec, method, args, kwargs))
+
+            def _done(cf, spec=spec, fut=fut, loop=loop):
+                try:
+                    reply = cf.result()
+                except BaseException as e:
+                    # Cancelled loop / SystemExit from the method: still
+                    # resolve the caller's future instead of hanging it.
+                    reply = self._pack_error(spec, exc.TaskError(
+                        type(e).__name__, repr(e), ""))
+                self._deliver_reply(reply, fut, loop)
+
+            cfut.add_done_callback(_done)
+            return True
+        if self._exec_pool is not None:
+            self._exec_pool.submit(
+                lambda: self._deliver_reply(
+                    self._execute_task(spec), fut, loop))
+            return True
+        return False
+
+    async def _execute_coro_task(self, spec, method, args, kwargs):
+        """Async-actor path: await the coroutine method on the actor's
+        event loop so concurrent calls interleave at await points.
+
+        The current task id lives in a contextvar (not the thread-local
+        _task_ctx): every interleaved coroutine shares the loop thread, and
+        asyncio gives each scheduled coroutine its own context copy, so
+        puts/nested submits inside the method attribute to the right task.
+        """
+        token = _ASYNC_TASK_ID.set(TaskID(spec["task_id"]))
+        try:
+            result = await method(*args, **kwargs)
+            return self._pack_returns(spec, result)
+        except BaseException as e:
+            if isinstance(e, (SystemExit, KeyboardInterrupt)):
+                raise
+            error = exc.TaskError(type(e).__name__, repr(e),
+                                  traceback.format_exc())
+            return self._pack_error(spec, error)
+        finally:
+            _ASYNC_TASK_ID.reset(token)
 
     def _execute_task(self, spec) -> dict:
         task_id = TaskID(spec["task_id"])

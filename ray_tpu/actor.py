@@ -139,8 +139,12 @@ class ActorClass:
         num_tpus = opts.get("num_tpus", self._num_tpus)
         # Reference semantics: actors without an explicit request hold no
         # CPU while alive (so long-lived actors don't starve task
-        # scheduling); explicit num_cpus is held for the actor's lifetime.
-        resources["CPU"] = 0 if num_cpus is None else num_cpus
+        # scheduling); an explicit num_cpus — or an explicit "CPU" key in
+        # resources= — is held for the actor's lifetime.
+        if num_cpus is not None:
+            resources["CPU"] = num_cpus
+        elif "CPU" not in resources:
+            resources["CPU"] = 0
         if num_tpus:
             resources["TPU"] = num_tpus
         pg = opts.get("placement_group")

@@ -75,6 +75,9 @@ class Raylet:
         # placement group bundles: (pg_id, index) -> {"resources", "available",
         # "state"}
         self.bundles: dict[tuple[bytes, int], dict] = {}
+        # pg_id -> resources leased out of bundles that were since removed;
+        # returned to self.available as those leases end.
+        self._removed_bundles: dict[bytes, ResourceSet] = {}
 
         # object manager
         self.local_objects: dict[bytes, dict] = {}  # oid -> {size, pinned, spilled}
@@ -147,10 +150,15 @@ class Raylet:
             "--store-root", self.store_root,
             "--log-file", log_file,
         ]
+        # stderr lands in the worker's log file so crashes (uncaught
+        # tracebacks, aborts) are diagnosable post-mortem.
+        errf = open(log_file + ".err", "ab") if log_file else subprocess.DEVNULL
         proc = subprocess.Popen(
             cmd, env=env,
-            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            stdout=subprocess.DEVNULL, stderr=errf,
             start_new_session=True)
+        if errf is not subprocess.DEVNULL:
+            errf.close()
         logger.info("started worker process pid=%d", proc.pid)
         return proc
 
@@ -257,9 +265,16 @@ class Raylet:
             bundle = self.bundles.get(pg_key) or self._find_bundle(pg_key)
             if bundle is not None:
                 bundle["available"].add(res)
-            # Bundle already cancelled/returned: its whole reservation went
-            # back to self.available then — adding res again would mint
-            # resources out of thin air.
+                return
+            # Bundle was cancelled/returned while this lease was out: its
+            # unleased part already went back to self.available, and the
+            # leased part was recorded in _removed_bundles — return it now.
+            outstanding = self._removed_bundles.get(pg_key[0])
+            if outstanding is not None:
+                self.available.add(res)
+                outstanding.subtract(res)
+                if outstanding.is_empty():
+                    del self._removed_bundles[pg_key[0]]
             return
         self.available.add(res)
 
@@ -466,9 +481,26 @@ class Raylet:
         return True
 
     async def h_cancel_bundle(self, conn, d):
-        bundle = self.bundles.pop((d["pg_id"], d["bundle_index"]), None)
+        """Remove a bundle. Only the unleased remainder goes back to
+        self.available immediately; the leased portion returns as each
+        lease ends (_release tracks it via _removed_bundles). Workers
+        still leasing from the removed group are killed, matching the
+        reference's kill-tasks-of-removed-PG behavior
+        (placement_group_resource_manager.h:51)."""
+        key = (d["pg_id"], d["bundle_index"])
+        bundle = self.bundles.pop(key, None)
         if bundle is not None:
-            self.available.add(bundle["resources"])
+            self.available.add(bundle["available"])
+            outstanding = bundle["resources"].copy()
+            outstanding.subtract(bundle["available"])
+            if not outstanding.is_empty():
+                prior = self._removed_bundles.setdefault(
+                    d["pg_id"], ResourceSet({}))
+                prior.add(outstanding)
+            for w in list(self.workers.values()):
+                if w.lease_pg is not None and w.lease_pg[0] == d["pg_id"]:
+                    await self.h_kill_actor_worker(
+                        conn, {"worker_id": w.worker_id})
             await self._dispatch_pending()
         return True
 

@@ -7,12 +7,24 @@ CPU rollout actors collect the next train batch."""
 
 from __future__ import annotations
 
+import weakref
+
 import jax.numpy as jnp
 import numpy as np
 
 from ray_tpu.rllib.agents.trainer import build_trainer
 from ray_tpu.rllib.policy.jax_policy import JAXPolicy
 from ray_tpu.rllib.policy.sample_batch import SampleBatch
+
+_SHUFFLE_RNGS = weakref.WeakKeyDictionary()
+
+
+def _shuffle_rng(workers, seed: int) -> np.random.RandomState:
+    rng = _SHUFFLE_RNGS.get(workers)
+    if rng is None:
+        rng = np.random.RandomState(seed)
+        _SHUFFLE_RNGS[workers] = rng
+    return rng
 
 PPO_CONFIG: dict = {
     "rollout_fragment_length": 256,
@@ -127,7 +139,10 @@ def ppo_train_step(workers, config) -> dict:
 
     policy = workers.local_worker.policy
     metrics: dict = {}
-    rng = np.random.RandomState(0)
+    # One shuffle stream per worker set (not per call, and not stashed in
+    # the user-visible config) so minibatch composition decorrelates
+    # across iterations.
+    rng = _shuffle_rng(workers, config.get("seed", 0))
     for _ in range(config["num_sgd_iter"]):
         for mb in batch.minibatches(config["sgd_minibatch_size"], rng):
             metrics = policy.learn_on_batch(mb)

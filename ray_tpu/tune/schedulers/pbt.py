@@ -111,7 +111,13 @@ class PopulationBasedTraining(TrialScheduler):
         trial.config = self._explore(donor.config)
         trial.checkpoint = donor.checkpoint
         self.perturbations += 1
-        self._last_perturb[trial.trial_id] = it
+        # After the restart the trial resumes from the donor's checkpoint,
+        # so its training_iteration counter becomes the donor's. Record
+        # _last_perturb on that counter — not the pre-restore one — or the
+        # interval would be measured across two different counters.
+        self._last_perturb[trial.trial_id] = (
+            donor.last_checkpoint_iter
+            if donor.last_checkpoint_iter >= 0 else it)
         return "PERTURB"  # runner treats as restart-with-new-config
 
     def on_trial_complete(self, runner, trial, result):

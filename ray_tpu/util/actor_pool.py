@@ -1,66 +1,104 @@
-"""ActorPool (reference: python/ray/util/actor_pool.py) — distribute work
-over a fixed set of actors."""
+"""ActorPool — fan work out over a fixed set of actors.
+
+Capability parity with the reference's ``ray.util.ActorPool``
+(python/ray/util/actor_pool.py), built here as a ticket/slot design:
+each dispatched call gets a monotonically increasing ticket number, and
+two small maps (ticket -> future, future -> (ticket, actor)) drive both
+in-order and completion-order retrieval.  A timed-out ``get_next`` never
+mutates pool state, and an errored task still recycles its actor before
+the exception propagates.
+"""
 
 from __future__ import annotations
+
+import collections
 
 import ray_tpu
 
 
 class ActorPool:
+    """Distribute work over a set of actors.
+
+    Example:
+        pool = ActorPool([Worker.remote() for _ in range(4)])
+        for out in pool.map(lambda a, x: a.double.remote(x), range(100)):
+            ...
+    """
+
     def __init__(self, actors: list):
-        self._idle = list(actors)
-        self._future_to_actor: dict = {}
-        self._index_to_future: dict[int, object] = {}
-        self._next_task_index = 0
-        self._next_return_index = 0
-        self._pending_submits: list = []
+        self._workers = collections.deque(actors)
+        # Work submitted while every worker was busy, FIFO.
+        self._backlog: collections.deque = collections.deque()
+        # Tickets are issued at dispatch time; because the backlog drains
+        # FIFO, ticket order == submission order.
+        self._tickets_issued = 0
+        self._tickets_served = 0
+        self._ticket_of: dict = {}        # future -> (ticket, actor)
+        self._future_of: dict[int, object] = {}   # ticket -> future
+
+    # -- submission ----------------------------------------------------
 
     def submit(self, fn, value):
-        """fn(actor, value) -> ObjectRef; queues if no actor is idle."""
-        if self._idle:
-            actor = self._idle.pop()
-            future = fn(actor, value)
-            self._future_to_actor[future] = (self._next_task_index, actor)
-            self._index_to_future[self._next_task_index] = future
-            self._next_task_index += 1
+        """Schedule ``fn(actor, value) -> ObjectRef`` on an idle actor,
+        or queue it until one frees up."""
+        if self._workers:
+            self._dispatch(fn, value)
         else:
-            self._pending_submits.append((fn, value))
+            self._backlog.append((fn, value))
+
+    def _dispatch(self, fn, value):
+        actor = self._workers.popleft()
+        future = fn(actor, value)
+        ticket = self._tickets_issued
+        self._tickets_issued += 1
+        self._ticket_of[future] = (ticket, actor)
+        self._future_of[ticket] = future
+
+    def _recycle(self, future):
+        """Return a finished future's actor to the pool and drain backlog."""
+        _, actor = self._ticket_of.pop(future)
+        self._workers.append(actor)
+        while self._backlog and self._workers:
+            fn, value = self._backlog.popleft()
+            self._dispatch(fn, value)
+
+    # -- retrieval -----------------------------------------------------
 
     def has_next(self) -> bool:
-        return bool(self._index_to_future) or bool(self._pending_submits)
+        return bool(self._future_of) or bool(self._backlog)
 
     def get_next(self, timeout: float | None = None):
-        """Next result in submission order."""
+        """Next result in submission order.
+
+        On timeout, raises TimeoutError with the pool untouched, so the
+        same result can be retried.  A task exception propagates, but
+        only after the actor has been returned to the pool.
+        """
         if not self.has_next():
-            raise StopIteration("no more results")
-        idx = self._next_return_index
-        self._next_return_index += 1
-        future = self._index_to_future.pop(idx)
-        value = ray_tpu.get(future, timeout=timeout)
-        self._return_actor(future)
-        return value
+            raise StopIteration("no more results to get")
+        future = self._future_of[self._tickets_served]
+        ready, _ = ray_tpu.wait([future], num_returns=1, timeout=timeout)
+        if not ready:
+            raise TimeoutError(
+                f"result {self._tickets_served} not ready in {timeout}s")
+        del self._future_of[self._tickets_served]
+        self._tickets_served += 1
+        self._recycle(future)
+        return ray_tpu.get(future)
 
     def get_next_unordered(self, timeout: float | None = None):
-        """Whichever result finishes first."""
+        """Whichever outstanding result completes first."""
         if not self.has_next():
-            raise StopIteration("no more results")
-        ready, _ = ray_tpu.wait(list(self._future_to_actor),
+            raise StopIteration("no more results to get")
+        ready, _ = ray_tpu.wait(list(self._ticket_of),
                                 num_returns=1, timeout=timeout)
         if not ready:
-            raise TimeoutError("no result within timeout")
+            raise TimeoutError(f"no result ready in {timeout}s")
         future = ready[0]
-        idx, _ = self._future_to_actor[future]
-        del self._index_to_future[idx]
-        value = ray_tpu.get(future)
-        self._return_actor(future)
-        return value
-
-    def _return_actor(self, future):
-        _, actor = self._future_to_actor.pop(future)
-        self._idle.append(actor)
-        while self._pending_submits and self._idle:
-            fn, value = self._pending_submits.pop(0)
-            self.submit(fn, value)
+        ticket, _ = self._ticket_of[future]
+        del self._future_of[ticket]
+        self._recycle(future)
+        return ray_tpu.get(future)
 
     def map(self, fn, values):
         for v in values:
@@ -74,14 +112,16 @@ class ActorPool:
         while self.has_next():
             yield self.get_next_unordered()
 
+    # -- direct worker management --------------------------------------
+
     def has_free(self) -> bool:
-        return bool(self._idle) and not self._pending_submits
+        return bool(self._workers) and not self._backlog
 
     def pop_idle(self):
-        return self._idle.pop() if self.has_free() else None
+        return self._workers.popleft() if self.has_free() else None
 
     def push(self, actor):
-        self._idle.append(actor)
-        while self._pending_submits and self._idle:
-            fn, value = self._pending_submits.pop(0)
-            self.submit(fn, value)
+        self._workers.append(actor)
+        while self._backlog and self._workers:
+            fn, value = self._backlog.popleft()
+            self._dispatch(fn, value)

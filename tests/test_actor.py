@@ -212,3 +212,45 @@ def test_actor_handle_in_actor(ray_start_shared):
     counter = Counter.remote()
     holder = Holder.remote(counter)
     assert ray_tpu.get(holder.bump_remote.remote()) == 1
+
+
+def test_max_concurrency_threaded(ray_start_shared):
+    """4 concurrent 0.2s sleeps on a max_concurrency=4 actor overlap
+    (reference: threaded actors via fiber.h:30-45)."""
+
+    @ray_tpu.remote
+    class Sleeper:
+        def nap(self):
+            time.sleep(0.2)
+            return 1
+
+    a = Sleeper.options(max_concurrency=4).remote()
+    ray_tpu.get(a.nap.remote())  # warm the worker
+    t0 = time.time()
+    assert ray_tpu.get([a.nap.remote() for _ in range(4)]) == [1] * 4
+    assert time.time() - t0 < 0.6
+
+
+def test_async_actor_interleaves(ray_start_shared):
+    """Coroutine methods run on the actor's event loop and overlap
+    (reference: asyncio actors, _raylet.pyx:377-424)."""
+    import asyncio
+
+    @ray_tpu.remote
+    class AsyncSleeper:
+        async def nap(self):
+            await asyncio.sleep(0.2)
+            return 1
+
+        async def boom(self):
+            raise ValueError("async boom")
+
+    a = AsyncSleeper.remote()
+    ray_tpu.get(a.nap.remote())
+    t0 = time.time()
+    assert ray_tpu.get([a.nap.remote() for _ in range(4)]) == [1] * 4
+    assert time.time() - t0 < 0.6
+    with pytest.raises(exc.TaskError):
+        ray_tpu.get(a.boom.remote())
+    # actor still alive after an async error
+    assert ray_tpu.get(a.nap.remote()) == 1
