@@ -41,6 +41,13 @@ class Config:
     heartbeat_interval_s: float = 0.5
     num_heartbeats_timeout: int = 20
     gcs_port: int = 0  # 0 = pick free port
+    # GCS fault tolerance: clients (raylets, workers, drivers) redial a
+    # restarted GCS for this long before giving up (reference:
+    # gcs_rpc_server_reconnect_timeout_s in ray_config_def.h); the node
+    # monitor respawns a crashed GCS when enabled.
+    gcs_reconnect_timeout_s: float = 30.0
+    gcs_persistence: bool = True
+    gcs_auto_restart: bool = True
 
     # --- scheduling ---
     # Max in-flight lease-reused tasks pushed to one worker

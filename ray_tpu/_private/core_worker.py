@@ -159,6 +159,7 @@ class CoreWorker:
             "create_actor": self.h_create_actor,
             "push_actor_task": self.h_push_actor_task,
             "get_object": self.h_get_object,
+            "recover_object": self.h_recover_object,
             "add_borrow": self.h_add_borrow,
             "remove_borrow": self.h_remove_borrow,
             "exit": self.h_exit,
@@ -170,8 +171,26 @@ class CoreWorker:
         async def setup():
             port = await self.server.start_tcp()
             self.address = f"127.0.0.1:{port}"
-            self.gcs = await rpc.connect(gcs_address, name="cw->gcs")
+            # GCS connection survives GCS restarts: on redial, re-subscribe
+            # every actor channel and resync state missed while down
+            # (reference: service_based_gcs_client.h reconnection).
+            async def _gcs_reconnected(conn):
+                for client in list(self.actor_clients.values()):
+                    if not client.subscribed:
+                        continue
+                    await conn.call("subscribe", {
+                        "channel": f"actor:{client.actor_id.hex()}"})
+                    info = await conn.call("get_actor",
+                                           {"actor_id": client.actor_id})
+                    if info:
+                        self._apply_actor_update(info)
+                        await self._flush_actor_queue(client)
+
+            self.gcs = rpc.ReconnectingConnection(
+                gcs_address, name="cw->gcs", on_reconnect=_gcs_reconnected,
+                retry_timeout=self.config.gcs_reconnect_timeout_s)
             self.gcs.set_push_handler(self._on_gcs_push)
+            await self.gcs.ensure_connected()
             # Duplex: the raylet sends actor-creation/kill requests back
             # over this same connection. A worker cannot function without
             # its raylet — it dies with it (reference: worker exits when
@@ -190,11 +209,14 @@ class CoreWorker:
                 "worker_id": self.worker_id.binary(),
                 "address": self.address,
                 "pid": os.getpid(),
+                "flavor": os.environ.get("RAY_TPU_WORKER_FLAVOR", "cpu"),
             })
             self.node_id = NodeID(reply["node_id"])
             if self.mode == DRIVER:
-                job = await self.gcs.call("register_job",
-                                          {"driver_addr": self.address})
+                job = await self.gcs.call(
+                    "register_job",
+                    {"driver_addr": self.address,
+                     "token": self.worker_id.hex()})
                 self.job_id = JobID(job["job_id"])
                 self.current_task_id = TaskID.for_driver(self.job_id)
 
@@ -372,24 +394,54 @@ class CoreWorker:
                     f"get() timed out waiting for {object_id.hex()[:12]}")
             found, value, is_exc = self.memstore.get_if_ready(object_id)
         if value is IN_PLASMA:
-            return self._read_plasma(object_id, timeout)
+            return self._read_plasma(object_id, timeout,
+                                     owner=ref.owner_address)
         result = serialization.deserialize(value)
         if is_exc:
             raise result
         return result
 
-    def _read_plasma(self, object_id: ObjectID, timeout: float | None):
-        buf = self.store.get(object_id)
-        if buf is None:
+    def _read_plasma(self, object_id: ObjectID, timeout: float | None,
+                     owner: str = ""):
+        """Resolve a plasma-resident object, pulling from remote nodes and
+        — when every copy is gone — reconstructing it from lineage
+        (reference: object_recovery_manager.h:87-103: pin existing copy →
+        else re-submit the creating task)."""
+        deadline = (time.monotonic() + timeout) if timeout is not None else None
+        while True:
+            buf = self.store.get(object_id)
+            if buf is not None:
+                break
+            remaining = None
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise exc.GetTimeoutError(
+                        f"timed out pulling {object_id.hex()[:12]}")
+            # Bounded probe so total loss is *detected* instead of blocking
+            # in the pull forever.
+            probe = 2.0 if remaining is None else max(0.05, min(2.0, remaining))
             ok = self._io.run(self.raylet.call(
                 "wait_object_local",
-                {"object_id": object_id.binary(), "timeout": timeout}))
-            if not ok:
-                raise exc.GetTimeoutError(
-                    f"timed out pulling {object_id.hex()[:12]}")
-            buf = self.store.get(object_id)
-            if buf is None:
+                {"object_id": object_id.binary(), "timeout": probe}))
+            if ok:
+                continue
+            try:
+                locations = self._io.run(self.gcs.call(
+                    "get_object_locations",
+                    {"object_id": object_id.binary()}))
+            except Exception:
+                locations = None
+            if locations:
+                continue  # a copy exists somewhere; keep pulling
+            if not self._recover_object(object_id, owner):
                 raise exc.ObjectLostError(object_id.hex())
+            # Reconstruction resubmitted the creating task; wait for the
+            # fresh value (memstore flips back to ready on task reply for
+            # the owner; borrowers just keep probing the pull path).
+            if object_id in self.owned:
+                self.memstore.wait([object_id], 1,
+                                   remaining if remaining is not None else 30.0)
         try:
             value = serialization.deserialize(buf.view)
         finally:
@@ -398,6 +450,67 @@ class CoreWorker:
         if isinstance(value, exc.RayTpuError):
             raise value
         return value
+
+    # ---- object reconstruction (reference: object_recovery_manager.h) ----
+
+    def _recover_object(self, object_id: ObjectID, owner: str = "") -> bool:
+        """Every copy of a plasma object is gone: re-execute the task that
+        created it (owner-side, bounded by the task's max_retries), or ask
+        the owner to if we're a borrower. Returns True if recovery is in
+        flight."""
+        with self._lock:
+            rec = self.owned.get(object_id)
+        if rec is not None:
+            return self._try_reconstruct(object_id)
+        if owner and owner != self.address:
+            try:
+                return bool(self._io.run(self._ask_owner_recover(
+                    object_id, owner)))
+            except Exception as e:
+                logger.warning("owner %s unreachable for recovery of %s: %s",
+                               owner, object_id.hex()[:12], e)
+                return False
+        return False
+
+    async def _ask_owner_recover(self, object_id: ObjectID, owner: str):
+        conn = await self._peer(owner)
+        return await conn.call("recover_object",
+                               {"object_id": object_id.binary()})
+
+    async def h_recover_object(self, conn, d):
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            None, self._try_reconstruct, ObjectID(d["object_id"]))
+
+    def _try_reconstruct(self, object_id: ObjectID) -> bool:
+        """Re-submit the lineage task for a lost object. Idempotent while a
+        reconstruction is already in flight; the whole check-then-insert is
+        under the lock (a get()ing user thread and a borrower's RPC both
+        race into here)."""
+        with self._lock:
+            rec = self.owned.get(object_id)
+            lineage = rec.lineage_task if rec is not None else None
+            if lineage is None:
+                return False
+            spec = lineage["spec"]
+            task_id = spec["task_id"]
+            if task_id in self.submitted:
+                return True  # already reconstructing
+            if lineage["retries"] <= 0:
+                return False
+            lineage["retries"] -= 1
+            self.submitted[task_id] = {
+                "spec": spec, "pinned": [],
+                "retries": lineage["retries"], "cancelled": False,
+            }
+        logger.warning("object %s lost; reconstructing via task %s "
+                       "(%d lineage retries left)", object_id.hex()[:12],
+                       spec["name"], lineage["retries"])
+        for i in range(spec["num_returns"]):
+            rid = ObjectID.for_return(TaskID(task_id), i)
+            self.memstore.reset(rid)
+        self._io.submit(self._submit_async(spec))
+        return True
 
     def _ensure_fetch(self, ref: ObjectRef):
         """Make sure something will eventually fill the memstore entry."""
@@ -614,11 +727,13 @@ class CoreWorker:
         self._lease_requests[key] = 1
         try:
             target = self.raylet
+            hops = 0
             while True:
                 reply = await target.call("request_worker_lease",
-                                          {"spec": spec})
+                                          {"spec": spec, "hops": hops})
                 if reply.get("spillback"):
                     target = await self._peer(reply["spillback"])
+                    hops = int(reply.get("hops", hops + 1))
                     continue
                 break
             conn = await self._peer(reply["worker_address"])
@@ -712,6 +827,12 @@ class CoreWorker:
         rec = self.submitted.pop(task_id, None)
         if rec is not None:
             self._release_pins(rec["pinned"])
+        # Lineage shared by all plasma returns of this task: enough to
+        # re-execute it if every copy is later lost (reference:
+        # object_recovery_manager.h:87-103; lineage retained while the
+        # refs live, task_manager.h lineage pinning).
+        lineage = {"spec": spec,
+                   "retries": rec["retries"] if rec else 0}
         for i, ret in enumerate(reply["returns"]):
             return_id = ObjectID.for_return(TaskID(task_id), i)
             if ret["kind"] == "inline":
@@ -722,6 +843,10 @@ class CoreWorker:
                     owned = self.owned.get(return_id)
                     if owned is not None:
                         owned.plasma = True
+                        # A stray duplicate reply (rec already popped) must
+                        # not clobber live lineage with retries=0.
+                        if rec is not None or owned.lineage_task is None:
+                            owned.lineage_task = lineage
                 self.memstore.put(return_id, IN_PLASMA)
 
     def _fail_task(self, spec, error: Exception, release=False):

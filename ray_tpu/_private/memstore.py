@@ -92,6 +92,12 @@ class MemoryStore:
                         return ready
                 self._cv.wait(remaining)
 
+    def reset(self, object_id: ObjectID) -> None:
+        """Return an entry to PENDING (object reconstruction: the lost
+        value is being recomputed, so `put` must win again)."""
+        with self._lock:
+            self._entries[object_id] = _Entry()
+
     def delete(self, object_id: ObjectID) -> None:
         with self._lock:
             self._entries.pop(object_id, None)

@@ -66,17 +66,44 @@ class ServiceProcess:
             pass
 
 
+_TPU_ENV_KEYS = ("PALLAS_AXON_POOL_IPS",)
+
+
 def strip_tpu_plugin_env(env: dict) -> dict:
     """Remove TPU-plugin activation vars so pure control-plane processes
     skip the expensive jax/PJRT import their sitecustomize would trigger
-    (observed ~2s per process; catastrophic on few-core hosts)."""
-    for key in ("PALLAS_AXON_POOL_IPS",):
+    (observed ~2s per process; catastrophic on few-core hosts).
+
+    The stripped values are stashed in RAY_TPU_TPU_ENV so the raylet can
+    hand them back to workers spawned for TPU-resource leases
+    (restore_tpu_plugin_env) even though the raylet itself runs without
+    them."""
+    saved = {k: env[k] for k in _TPU_ENV_KEYS if k in env}
+    if saved and "RAY_TPU_TPU_ENV" not in env:
+        saved["JAX_PLATFORMS"] = env.get("JAX_PLATFORMS", "")
+        env["RAY_TPU_TPU_ENV"] = json.dumps(saved)
+    for key in _TPU_ENV_KEYS:
         env.pop(key, None)
     # If the ambient env pins jax to the stripped plugin's platform, the
     # child would fail backend init ("axon not in known backends") — let
     # jax pick from what's actually registered there.
     if env.get("JAX_PLATFORMS", "").lower() not in ("", "cpu"):
         env["JAX_PLATFORMS"] = ""
+    return env
+
+
+def restore_tpu_plugin_env(env: dict) -> dict:
+    """Give a TPU-designated worker back the plugin env that
+    strip_tpu_plugin_env stashed on the raylet's way up."""
+    saved = env.pop("RAY_TPU_TPU_ENV", None)
+    if saved:
+        vals = json.loads(saved)
+        jax_platforms = vals.pop("JAX_PLATFORMS", "")
+        if jax_platforms:
+            env["JAX_PLATFORMS"] = jax_platforms
+        else:
+            env.pop("JAX_PLATFORMS", None)
+        env.update(vals)
     return env
 
 
@@ -92,14 +119,27 @@ def _spawn(cmd: list[str], config: Config, name: str) -> ServiceProcess:
 def start_gcs(session_dir: str, config: Config, port: int = 0) -> tuple[ServiceProcess, str]:
     ready = os.path.join(session_dir, f"gcs_ready_{uuid.uuid4().hex[:6]}")
     log_file = os.path.join(session_dir, "logs", "gcs_server.log")
-    svc = _spawn([
+    cmd = [
         sys.executable, "-m", "ray_tpu.gcs.server",
         "--port", str(port),
         "--ready-file", ready,
         "--log-file", log_file,
-    ], config, "gcs_server")
+    ]
+    if config.gcs_persistence:
+        cmd += ["--store-dir", os.path.join(session_dir, "gcs_store")]
+    svc = _spawn(cmd, config, "gcs_server")
     actual_port = _wait_ready(ready, svc.proc, "gcs_server")
     return svc, f"127.0.0.1:{actual_port}"
+
+
+def restart_gcs(session_dir: str, config: Config,
+                gcs_address: str) -> ServiceProcess:
+    """Bring a (crashed) GCS back on its old port against its persisted
+    store, so clients' redial loops land on a server that remembers them
+    (reference: test_gcs_fault_tolerance.py restart path)."""
+    port = int(gcs_address.rsplit(":", 1)[1])
+    svc, _addr = start_gcs(session_dir, config, port)
+    return svc
 
 
 def start_raylet(session_dir: str, gcs_address: str, config: Config, *,
@@ -159,12 +199,65 @@ class Node:
         self.raylet_address = raylet_addr
         self.node_id = node_id
         self.store_root = store_root
+        self._stopping = False
         atexit.register(self.kill_all_processes)
+        if self.is_head and config.gcs_persistence and config.gcs_auto_restart:
+            self._start_gcs_monitor()
+
+    def _start_gcs_monitor(self):
+        """Supervise the GCS: a crashed GCS is restarted on its old port
+        against its persisted tables (the process-level analog of the
+        reference's externally-supervised gcs_server + Redis durability;
+        behavior: python/ray/tests/test_gcs_fault_tolerance.py)."""
+        import threading
+
+        def _watch():
+            while not self._stopping:
+                time.sleep(0.5)
+                gcs = next((s for s in self.processes
+                            if s.name == "gcs_server"), None)
+                if gcs is None or self._stopping:
+                    continue
+                if not gcs.alive():
+                    if self._stopping:
+                        continue
+                    logger.warning("GCS exited (rc=%s); restarting on %s",
+                                   gcs.proc.returncode, self.gcs_address)
+                    try:
+                        new = restart_gcs(self.session_dir, self.config,
+                                          self.gcs_address)
+                    except Exception:
+                        logger.exception("GCS restart failed")
+                        continue
+                    # Shutdown may have started while we were spawning
+                    # (kill_all sets _stopping before killing): don't leak
+                    # an orphan GCS outliving the driver.
+                    if self._stopping:
+                        new.kill()
+                        continue
+                    try:
+                        self.processes[self.processes.index(gcs)] = new
+                    except ValueError:
+                        if self._stopping:
+                            new.kill()
+                        else:
+                            self.processes.append(new)
+
+        threading.Thread(target=_watch, name="gcs-monitor",
+                         daemon=True).start()
 
     def kill_all_processes(self):
+        self._stopping = True
         for svc in reversed(self.processes):
             svc.kill()
         self.processes.clear()
+
+    def kill_gcs(self):
+        """Fault injection: kill the GCS process (it will be auto-restarted
+        by the monitor when gcs_auto_restart is on)."""
+        for svc in self.processes:
+            if svc.name == "gcs_server":
+                svc.kill()
 
     def kill_raylet(self):
         """Fault injection: kill this node's raylet (reference test idiom:

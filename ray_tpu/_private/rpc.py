@@ -255,6 +255,131 @@ async def connect(address: str, handlers: dict | None = None,
     raise ConnectionLost(f"could not connect to {address}: {last_err}")
 
 
+class ReconnectingConnection:
+    """Client connection that survives server restarts (the GCS fault-
+    tolerance plane; reference: src/ray/gcs/gcs_client/service_based_gcs_client.h
+    reconnection + python/ray/tests/test_gcs_fault_tolerance.py behavior).
+
+    `call()` transparently retries across a connection loss: it redials the
+    same address until `retry_timeout` elapses, runs `on_reconnect(conn)`
+    on the fresh connection so the caller can re-establish session state
+    (re-register, re-subscribe) BEFORE queued calls resume, and then
+    replays the call. Handlers/push-handler are re-attached automatically.
+    Calls whose reply was lost mid-flight are retried, so server handlers
+    reached through this wrapper must be idempotent.
+    """
+
+    def __init__(self, address: str, handlers: dict | None = None,
+                 name: str = "client", on_reconnect=None,
+                 retry_timeout: float = 30.0, on_give_up=None,
+                 dial_timeout: float = 10.0):
+        self.address = address
+        self.name = name
+        self._handlers = handlers or {}
+        self._on_reconnect = on_reconnect
+        self._on_give_up = on_give_up
+        self._retry_timeout = retry_timeout
+        self._dial_timeout = dial_timeout
+        self._conn: Connection | None = None
+        self._push_handler = None
+        self._dial_lock: asyncio.Lock | None = None
+        self._ever_connected = False
+        self._gave_up = False
+        self.context: dict[str, Any] = {}
+
+    async def ensure_connected(self) -> Connection:
+        if self._conn is not None and not self._conn.closed:
+            return self._conn
+        if self._gave_up:
+            raise ConnectionLost(f"{self.name}: gave up on {self.address}")
+        if self._dial_lock is None:
+            self._dial_lock = asyncio.Lock()
+        async with self._dial_lock:
+            if self._conn is not None and not self._conn.closed:
+                return self._conn
+            timeout = (self._retry_timeout if self._ever_connected
+                       else self._dial_timeout)
+            try:
+                conn = await connect(
+                    self.address, self._handlers, name=self.name,
+                    on_disconnect=self._lost, timeout=timeout)
+            except ConnectionLost:
+                if self._ever_connected:
+                    self._gave_up = True
+                    if self._on_give_up is not None:
+                        try:
+                            res = self._on_give_up()
+                            if asyncio.iscoroutine(res):
+                                await res
+                        except Exception:
+                            logger.exception("%s on_give_up failed", self.name)
+                raise
+            if self._push_handler is not None:
+                conn.set_push_handler(self._push_handler)
+            conn.context.update(self.context)
+            reconnecting = self._ever_connected
+            self._ever_connected = True
+            self._conn = conn
+            if reconnecting and self._on_reconnect is not None:
+                logger.info("%s: reconnected to %s", self.name, self.address)
+                try:
+                    await self._on_reconnect(conn)
+                except Exception:
+                    logger.exception("%s on_reconnect failed", self.name)
+            return conn
+
+    async def _lost(self, conn):
+        # Proactive background redial so pubsub pushes resume without
+        # waiting for the next outbound call.
+        if self._gave_up:
+            return
+        async def _redial():
+            try:
+                await self.ensure_connected()
+            except Exception:
+                pass
+        try:
+            asyncio.get_running_loop().create_task(_redial())
+        except RuntimeError:
+            pass
+
+    def set_push_handler(self, fn):
+        self._push_handler = fn
+        if self._conn is not None:
+            self._conn.set_push_handler(fn)
+
+    async def call(self, method: str, data: Any = None,
+                   timeout: float | None = None):
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + self._retry_timeout
+        while True:
+            conn = await self.ensure_connected()
+            try:
+                return await conn.call(method, data, timeout)
+            except ConnectionLost:
+                if loop.time() >= deadline:
+                    raise
+                await asyncio.sleep(0.1)
+
+    async def notify(self, method: str, data: Any = None):
+        conn = await self.ensure_connected()
+        await conn.notify(method, data)
+
+    async def push(self, channel: str, data: Any = None):
+        conn = await self.ensure_connected()
+        await conn.push(channel, data)
+
+    @property
+    def closed(self) -> bool:
+        # A lost underlying connection is redialable, not closed.
+        return self._gave_up
+
+    async def close(self):
+        self._gave_up = True
+        if self._conn is not None:
+            await self._conn.close()
+
+
 class EventLoopThread:
     """A dedicated asyncio loop on a daemon thread.
 

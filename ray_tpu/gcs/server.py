@@ -10,8 +10,13 @@ in-process table + push channels over our RPC layer; no Redis process),
 object location directory (GcsObjectManager), and placement groups
 (GcsPlacementGroupManager, gcs_placement_group_manager.h:130).
 
-State is in-memory with optional JSON snapshot persistence; a restarted GCS
-reloads the snapshot (the reference equivalently restores from Redis).
+State is write-through persisted via GcsStorage (WAL + snapshot under the
+session dir — see storage.py; reference: gcs_table_storage.h:294 persists
+to Redis): a restarted GCS reloads jobs/actors/named-actors/placement
+groups/KV/node table, raylets and drivers redial and re-register
+(rpc.ReconnectingConnection), and the cluster continues — the analog of
+the reference's GCS fault-tolerance behavior
+(python/ray/tests/test_gcs_fault_tolerance.py).
 """
 
 from __future__ import annotations
@@ -38,8 +43,9 @@ DEAD = "DEAD"
 
 
 class GcsServer:
-    def __init__(self, config: Config):
+    def __init__(self, config: Config, storage=None):
         self.config = config
+        self.storage = storage
         self.kv: dict[str, bytes] = {}
         self.subscriptions: dict[str, set[rpc.Connection]] = {}
         # node_id(bytes) -> node info dict
@@ -58,6 +64,60 @@ class GcsServer:
         self.server = rpc.Server(self._handlers(), on_disconnect=self._on_disconnect,
                                  name="gcs")
         self._pending_actor_queue: list[bytes] = []
+        if storage is not None:
+            self._restore()
+
+    # ---- persistence (reference: gcs_table_storage.h:294) ----
+    def _restore(self):
+        """Reload control state after a GCS restart. Raylets redial and
+        re-register (restoring conns/heartbeats); actors that were mid-
+        scheduling are re-queued; ALIVE actors keep running untouched."""
+        st = self.storage
+        self.kv = dict(st.table("kv"))
+        self.jobs = dict(st.table("jobs"))
+        self.next_job = st.get("meta", "next_job", 1)
+        now = time.monotonic()
+        for node_id, info in st.table("nodes").items():
+            self.nodes[node_id] = dict(info)
+            # Full resources until the raylet's next heartbeat corrects it.
+            self.available[node_id] = ResourceSet.from_raw(info["resources"])
+            # Grace window: a raylet that outlived the GCS reconnects well
+            # within the normal heartbeat timeout.
+            self.last_heartbeat[node_id] = now
+        for actor_id, rec in st.table("actors").items():
+            rec = dict(rec)
+            self.actors[actor_id] = rec
+            if rec["state"] in (PENDING_CREATION, RESTARTING):
+                self._pending_actor_queue.append(actor_id)
+        for key, actor_id in st.table("named_actors").items():
+            ns, _, name = key.partition("\x00")
+            self.named_actors[(ns, name)] = actor_id
+        for pg_id, rec in st.table("placement_groups").items():
+            rec = dict(rec)
+            rec.pop("creating", None)
+            self.placement_groups[pg_id] = rec
+        if self.nodes or self.actors:
+            logger.info(
+                "restored GCS state: %d nodes, %d actors, %d pgs, %d kv",
+                len(self.nodes), len(self.actors),
+                len(self.placement_groups), len(self.kv))
+
+    def _persist(self, table: str, key, value, sync: bool = False):
+        if self.storage is not None:
+            self.storage.put(table, key, value, sync=sync)
+
+    def _persist_del(self, table: str, key):
+        if self.storage is not None:
+            self.storage.delete(table, key)
+
+    def _persist_actor(self, rec):
+        # Everything in rec travelled over msgpack RPC, so it persists
+        # as-is. Actor transitions fsync: losing one strands live handles.
+        self._persist("actors", rec["actor_id"], rec, sync=True)
+
+    def _persist_pg(self, rec):
+        clean = {k: v for k, v in rec.items() if k != "creating"}
+        self._persist("placement_groups", rec["pg_id"], clean, sync=True)
 
     def _handlers(self):
         return {
@@ -72,6 +132,7 @@ class GcsServer:
             "register_node": self.h_register_node,
             "heartbeat": self.h_heartbeat,
             "get_all_nodes": self.h_get_all_nodes,
+            "get_available_resources": self.h_get_available_resources,
             "drain_node": self.h_drain_node,
             "register_job": self.h_register_job,
             "register_actor": self.h_register_actor,
@@ -98,12 +159,14 @@ class GcsServer:
         if not d.get("overwrite", True) and key in self.kv:
             return False
         self.kv[key] = d["value"]
+        self._persist("kv", key, d["value"])
         return True
 
     async def h_kv_get(self, conn, d):
         return self.kv.get(d["key"])
 
     async def h_kv_del(self, conn, d):
+        self._persist_del("kv", d["key"])
         return self.kv.pop(d["key"], None) is not None
 
     async def h_kv_exists(self, conn, d):
@@ -150,13 +213,20 @@ class GcsServer:
             "state": "ALIVE",
             "start_time": time.time(),
         }
+        rejoining = node_id in self.nodes  # redial after a GCS restart
         self.nodes[node_id] = info
-        self.available[node_id] = ResourceSet.from_raw(d["resources"])
+        self.available[node_id] = ResourceSet.from_raw(
+            d.get("available", d["resources"]))
         self.last_heartbeat[node_id] = time.monotonic()
         conn.context["node_id"] = node_id
         self.node_conns[node_id] = conn
-        await self.publish("nodes", {"event": "added", "node": _node_public(info)})
-        logger.info("node registered: %s @ %s", node_id.hex()[:8], d["address"])
+        self._persist("nodes", node_id, info)
+        if not rejoining:
+            await self.publish("nodes",
+                               {"event": "added", "node": _node_public(info)})
+        logger.info("node %s: %s @ %s",
+                    "re-registered" if rejoining else "registered",
+                    node_id.hex()[:8], d["address"])
         await self._try_schedule_pending_actors()
         await self._retry_pending_pgs()
         return True
@@ -174,6 +244,13 @@ class GcsServer:
     async def h_get_all_nodes(self, conn, d):
         return [_node_public(info) for info in self.nodes.values()]
 
+    async def h_get_available_resources(self, conn, d):
+        """Heartbeat-fresh per-node availability, used by raylets for
+        load-aware spillback (reference: the scheduler's cluster resource
+        view fed by resource usage broadcast, cluster_resource_scheduler.cc:217)."""
+        return {node_id: avail.raw()
+                for node_id, avail in self.available.items()}
+
     async def h_drain_node(self, conn, d):
         await self._remove_node(d["node_id"], reason="drained")
         return True
@@ -186,6 +263,7 @@ class GcsServer:
         if info is None:
             return
         info["state"] = "DEAD"
+        self._persist_del("nodes", node_id)
         await self.publish("nodes", {"event": "removed",
                                      "node": _node_public(info),
                                      "reason": reason})
@@ -210,10 +288,21 @@ class GcsServer:
 
     # ---- jobs ----
     async def h_register_job(self, conn, d):
+        # Idempotent by driver-supplied token: a replayed call (reply lost
+        # across a GCS restart) returns the already-allocated job instead
+        # of minting a ghost.
+        token = d.get("token") or ""
+        if token:
+            for rec in self.jobs.values():
+                if rec.get("token") == token:
+                    return {"job_id": rec["job_id"]}
         job_id = self.next_job.to_bytes(4, "big")
         self.next_job += 1
         self.jobs[job_id] = {"job_id": job_id, "driver_addr": d.get("driver_addr", ""),
-                             "start_time": time.time(), "state": "RUNNING"}
+                             "start_time": time.time(), "state": "RUNNING",
+                             "token": token}
+        self._persist("meta", "next_job", self.next_job)
+        self._persist("jobs", job_id, self.jobs[job_id])
         return {"job_id": job_id}
 
     # ---- actors ----
@@ -226,6 +315,11 @@ class GcsServer:
         """
         spec = d["spec"]
         actor_id = spec["actor_id"]
+        # Idempotent: a client retrying across a GCS restart (or a lost
+        # reply) must not double-register.
+        existing_rec = self.actors.get(actor_id)
+        if existing_rec is not None:
+            return self._actor_public(existing_rec)
         name = spec["actor_creation"].get("name") or ""
         namespace = spec["actor_creation"].get("namespace") or "default"
         if name:
@@ -235,6 +329,7 @@ class GcsServer:
                 if self.actors.get(existing, {}).get("state") != DEAD:
                     raise ValueError(f"actor name {name!r} already taken")
             self.named_actors[key] = actor_id
+            self._persist("named_actors", f"{namespace}\x00{name}", actor_id)
         rec = {
             "actor_id": actor_id,
             "spec": spec,
@@ -249,6 +344,7 @@ class GcsServer:
             "death_cause": "",
         }
         self.actors[actor_id] = rec
+        self._persist_actor(rec)
         await self._schedule_actor(actor_id)
         return self._actor_public(rec)
 
@@ -272,17 +368,21 @@ class GcsServer:
                 node_id for node_id, avail in self.available.items()
                 if need.is_subset_of(avail)
             ]
+        # Only nodes with a live raylet connection are placeable. A
+        # restored-from-storage node whose raylet hasn't redialed yet is
+        # NOT dead (its actors are alive) — skip it and let the heartbeat
+        # checker decide its fate, never _remove_node from here.
+        candidates = [
+            n for n in candidates
+            if (c := self.node_conns.get(n)) is not None and not c.closed
+        ]
         if not candidates:
             if actor_id not in self._pending_actor_queue:
                 self._pending_actor_queue.append(actor_id)
             logger.info("actor %s pending: no feasible node", actor_id.hex()[:8])
             return
         node_id = random.choice(candidates)
-        conn = self.node_conns.get(node_id)
-        if conn is None or conn.closed:
-            await self._remove_node(node_id, "connection lost")
-            await self._schedule_actor(actor_id)
-            return
+        conn = self.node_conns[node_id]
         rec["node_id"] = node_id
         try:
             reply = await conn.call("create_actor", {"spec": spec})
@@ -314,6 +414,9 @@ class GcsServer:
             await self._publish_actor(rec)
 
     async def _publish_actor(self, rec):
+        # Every externally-visible actor transition goes through here, so
+        # it is also the persistence point.
+        self._persist_actor(rec)
         await self.publish(f"actor:{rec['actor_id'].hex()}", self._actor_public(rec))
 
     def _actor_public(self, rec):
@@ -420,11 +523,16 @@ class GcsServer:
         gcs_placement_group_scheduler.h:49; strategies :133-160). Infeasible
         groups stay PENDING and are retried as nodes join / resources free."""
         pg_id = d["pg_id"]
-        self.placement_groups[pg_id] = {
-            "pg_id": pg_id, "bundles": [dict(b) for b in d["bundles"]],
-            "strategy": d.get("strategy", "PACK"), "state": "PENDING",
-            "name": d.get("name", ""),
-        }
+        # Idempotent: a call replayed across a GCS restart (lost reply)
+        # must not reset a CREATED group to PENDING and double-reserve
+        # its bundles.
+        if pg_id not in self.placement_groups:
+            self.placement_groups[pg_id] = {
+                "pg_id": pg_id, "bundles": [dict(b) for b in d["bundles"]],
+                "strategy": d.get("strategy", "PACK"), "state": "PENDING",
+                "name": d.get("name", ""),
+            }
+            self._persist_pg(self.placement_groups[pg_id])
         return {"state": await self._try_create_pg(pg_id)}
 
     async def _retry_pending_pgs(self):
@@ -525,6 +633,7 @@ class GcsServer:
              "node_id": placement[i]}
             for i in range(len(bundles))
         ]
+        self._persist_pg(rec)
         return "CREATED"
 
     def _place_bundles(self, bundles, strategy):
@@ -590,6 +699,7 @@ class GcsServer:
         return placement
 
     async def h_remove_placement_group(self, conn, d):
+        self._persist_del("placement_groups", d["pg_id"])
         rec = self.placement_groups.pop(d["pg_id"], None)
         if rec and rec["state"] == "CREATED":
             for b in rec["bundles"]:
@@ -649,12 +759,19 @@ def main():
     parser.add_argument("--port", type=int, default=0)
     parser.add_argument("--ready-file", default=None)
     parser.add_argument("--log-file", default=None)
+    parser.add_argument("--store-dir", default=None,
+                        help="WAL+snapshot dir; enables persistence/restart")
     args = parser.parse_args()
     from ray_tpu._private.log_utils import setup_process_logging
 
     setup_process_logging("gcs_server", args.log_file)
     set_config(Config.load())
-    server = GcsServer(get_config())
+    storage = None
+    if args.store_dir:
+        from ray_tpu.gcs.storage import GcsStorage
+
+        storage = GcsStorage(args.store_dir)
+    server = GcsServer(get_config(), storage=storage)
     asyncio.run(server.run(args.port, args.ready_file))
 
 

@@ -1,0 +1,124 @@
+"""GCS table storage: crash-safe persistence for the control plane.
+
+Plays the role of the reference's GcsTableStorage over Redis/in-memory
+store clients (reference: src/ray/gcs/gcs_server/gcs_table_storage.h:294,
+src/ray/gcs/store_client/redis_store_client.h): every control-plane
+mutation (KV, jobs, actors, named actors, placement groups, node table)
+is written through to disk, and a restarted GCS reloads the exact table
+state. The design differs deliberately: instead of an external Redis
+process, a single-writer append-only WAL of msgpack frames plus periodic
+snapshot compaction under the session directory — no extra process, no
+network hop, fsync only on actor/PG state transitions (the records whose
+loss would strand live workers).
+
+File layout (under `<dir>/`):
+    snapshot.bin   msgpack({table: {key: value}})   (atomic rename)
+    wal.bin        appended msgpack frames [op, table, key, value]
+
+Recovery = load snapshot, replay WAL in order. Compaction rewrites the
+snapshot and truncates the WAL once it outgrows `compact_bytes`.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+
+import msgpack
+
+_HDR = struct.Struct(">I")
+PUT, DELETE = 0, 1
+
+
+class GcsStorage:
+    """Write-through table store. Keys/values must be msgpack-serializable
+    (bytes keys fine). Thread-safe for the single-process GCS server."""
+
+    def __init__(self, dir_path: str, compact_bytes: int = 4 << 20):
+        self.dir = dir_path
+        self.compact_bytes = compact_bytes
+        os.makedirs(dir_path, exist_ok=True)
+        self._snap_path = os.path.join(dir_path, "snapshot.bin")
+        self._wal_path = os.path.join(dir_path, "wal.bin")
+        self._lock = threading.Lock()
+        self.tables: dict[str, dict] = {}
+        self._load()
+        self._wal = open(self._wal_path, "ab")
+
+    # -- recovery ------------------------------------------------------
+
+    def _load(self):
+        if os.path.exists(self._snap_path):
+            with open(self._snap_path, "rb") as f:
+                raw = msgpack.unpackb(f.read(), raw=False, strict_map_key=False)
+            self.tables = {t: dict(kv) for t, kv in raw.items()}
+        if os.path.exists(self._wal_path):
+            with open(self._wal_path, "rb") as f:
+                data = f.read()
+            off = 0
+            while off + _HDR.size <= len(data):
+                (length,) = _HDR.unpack_from(data, off)
+                end = off + _HDR.size + length
+                if end > len(data):
+                    break  # torn tail from a crash mid-append: discard
+                op, table, key, value = msgpack.unpackb(
+                    data[off + _HDR.size:end], raw=False,
+                    strict_map_key=False)
+                tbl = self.tables.setdefault(table, {})
+                if op == PUT:
+                    tbl[key] = value
+                else:
+                    tbl.pop(key, None)
+                off = end
+
+    # -- mutation ------------------------------------------------------
+
+    def _append(self, op: int, table: str, key, value, sync: bool):
+        body = msgpack.packb([op, table, key, value], use_bin_type=True)
+        with self._lock:
+            self._wal.write(_HDR.pack(len(body)) + body)
+            self._wal.flush()
+            if sync:
+                os.fsync(self._wal.fileno())
+            if self._wal.tell() > self.compact_bytes:
+                self._compact_locked()
+
+    def put(self, table: str, key, value, sync: bool = False):
+        self.tables.setdefault(table, {})[key] = value
+        self._append(PUT, table, key, value, sync)
+
+    def delete(self, table: str, key, sync: bool = False):
+        self.tables.setdefault(table, {}).pop(key, None)
+        self._append(DELETE, table, key, None, sync)
+
+    def get(self, table: str, key, default=None):
+        return self.tables.get(table, {}).get(key, default)
+
+    def table(self, table: str) -> dict:
+        return self.tables.get(table, {})
+
+    # -- compaction ----------------------------------------------------
+
+    def _compact_locked(self):
+        tmp = self._snap_path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(msgpack.packb(self.tables, use_bin_type=True))
+            f.flush()
+            os.fsync(f.fileno())
+        os.rename(tmp, self._snap_path)
+        self._wal.close()
+        self._wal = open(self._wal_path, "wb")
+
+    def compact(self):
+        with self._lock:
+            self._compact_locked()
+
+    def close(self):
+        with self._lock:
+            try:
+                self._wal.flush()
+                os.fsync(self._wal.fileno())
+                self._wal.close()
+            except Exception:
+                pass

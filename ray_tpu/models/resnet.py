@@ -63,16 +63,25 @@ def _bn_init(c):
 
 
 def _bn(x, p, s, train: bool, momentum=0.9, eps=1e-5):
+    """Batchnorm, bandwidth-lean: the two stat reductions run with fp32
+    accumulation (XLA fuses the convert into the reduce — no fp32 copy of
+    the activation is materialized), and the normalization itself is a
+    per-channel scale/offset applied in the compute dtype so the only
+    full-size tensors that touch HBM stay bfloat16."""
     if train:
-        mean = jnp.mean(x.astype(jnp.float32), axis=(0, 1, 2))
-        var = jnp.var(x.astype(jnp.float32), axis=(0, 1, 2))
+        xf = x.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=(0, 1, 2))
+        # clamp: one-pass E[x²]−E[x]² can dip negative from fp32 rounding
+        var = jnp.maximum(
+            jnp.mean(jnp.square(xf), axis=(0, 1, 2)) - jnp.square(mean), 0.0)
         new_s = {"mean": momentum * s["mean"] + (1 - momentum) * mean,
                  "var": momentum * s["var"] + (1 - momentum) * var}
     else:
         mean, var, new_s = s["mean"], s["var"], s
     inv = lax.rsqrt(var + eps) * p["scale"]
-    y = (x.astype(jnp.float32) - mean) * inv + p["bias"]
-    return y.astype(x.dtype), new_s
+    offset = p["bias"] - mean * inv
+    y = x * inv.astype(x.dtype) + offset.astype(x.dtype)
+    return y, new_s
 
 
 def _block_channels(cfg: ResNetConfig, stage: int) -> tuple[int, int]:

@@ -44,6 +44,7 @@ class WorkerHandle:
         self.lease_id: bytes | None = None
         self.lease_resources: ResourceSet | None = None
         self.lease_pg: tuple[bytes, int] | None = None
+        self.flavor: str = "cpu"  # "cpu" | "tpu" — which env it spawned with
 
 
 class Raylet:
@@ -61,11 +62,23 @@ class Raylet:
         self.store = LocalObjectStore(store_root)
         self.store_root = store_root
 
-        # worker pool
+        # worker pool — two flavors: plain CPU workers (TPU-plugin env
+        # stripped) and TPU workers (plugin env restored). A worker's
+        # flavor is fixed at spawn; leases route to the matching pool so
+        # only leases that declare TPU resources ever run in a process
+        # that can claim the chip.
         self.workers: dict[bytes, WorkerHandle] = {}  # registered, by worker_id
         self.idle: list[WorkerHandle] = []
+        self.idle_tpu: list[WorkerHandle] = []
         self.starting = 0
-        self._worker_waiters: list[asyncio.Future] = []
+        self.starting_tpu = 0
+        self._worker_waiters: list[tuple[asyncio.Future, bool]] = []
+        # Spawned-but-unregistered worker processes, so a worker that dies
+        # during startup (plugin import error, chip already claimed, OOM)
+        # is reaped and its `starting` slot released instead of wedging
+        # _pop_worker forever.
+        self._starting_procs: list = []  # [(Popen, flavor)]
+        self._warned_infeasible: set[tuple] = set()
         self.num_cpus = int(resources.get("CPU", os.cpu_count() or 1))
 
         # scheduling
@@ -126,21 +139,34 @@ class Raylet:
     # worker pool (reference: src/ray/raylet/worker_pool.h)
     # ------------------------------------------------------------------
 
-    def _start_worker_process(self):
-        self.starting += 1
+    def _start_worker_process(self, tpu: bool = False):
+        if tpu:
+            self.starting_tpu += 1
+        else:
+            self.starting += 1
         log_file = os.path.join(
             self.session_dir, "logs",
-            f"worker-{self.node_id.hex()[:8]}-{self.starting}-{time.time():.0f}.log")
+            f"worker-{self.node_id.hex()[:8]}-{self.starting + self.starting_tpu}"
+            f"-{time.time():.0f}.log")
         env = dict(os.environ)
         env.update(self.config.child_env())
-        # Workers must not grab the TPU: only tasks that declare TPU
-        # resources run on a TPU-visible worker. Stripping the TPU-plugin
-        # env also skips the ~2s jax import the plugin's sitecustomize
-        # forces on every python start.
-        if not os.environ.get("RAY_TPU_WORKER_TPU"):
-            from ray_tpu._private.node import strip_tpu_plugin_env
+        # Only workers serving TPU-resource leases get the TPU-plugin env
+        # (process-exclusive chip claim + ~2s jax import at python start);
+        # everyone else runs with it stripped.
+        from ray_tpu._private.node import (restore_tpu_plugin_env,
+                                           strip_tpu_plugin_env)
 
+        if tpu:
+            restore_tpu_plugin_env(env)
+            # Tells worker/main.py not to pin JAX_PLATFORMS=cpu, and the
+            # worker echoes the flavor back at registration.
+            env["RAY_TPU_WORKER_TPU"] = "1"
+            env["RAY_TPU_WORKER_FLAVOR"] = "tpu"
+        else:
             strip_tpu_plugin_env(env)
+            env.pop("RAY_TPU_TPU_ENV", None)
+            env.pop("RAY_TPU_WORKER_TPU", None)
+            env["RAY_TPU_WORKER_FLAVOR"] = "cpu"
         cmd = [
             sys.executable, "-m", "ray_tpu.worker.main",
             "--raylet-address", self.address,
@@ -159,20 +185,51 @@ class Raylet:
             start_new_session=True)
         if errf is not subprocess.DEVNULL:
             errf.close()
-        logger.info("started worker process pid=%d", proc.pid)
+        self._starting_procs.append((proc, "tpu" if tpu else "cpu"))
+        logger.info("started %s worker process pid=%d",
+                    "tpu" if tpu else "cpu", proc.pid)
         return proc
 
-    async def _pop_worker(self, ignore_cap: bool = False) -> WorkerHandle:
+    def _reap_starting_workers(self):
+        """Release `starting` slots held by worker processes that exited
+        before registering, and re-wake waiters so they respawn."""
+        alive, died = [], []
+        for proc, flavor in self._starting_procs:
+            (alive if proc.poll() is None else died).append((proc, flavor))
+        self._starting_procs = alive
+        for proc, flavor in died:
+            logger.warning("%s worker pid=%d exited (rc=%s) before "
+                           "registering", flavor, proc.pid, proc.returncode)
+            if flavor == "tpu":
+                self.starting_tpu = max(0, self.starting_tpu - 1)
+            else:
+                self.starting = max(0, self.starting - 1)
+        if died:
+            # Wake every waiter; each re-runs its loop and respawns now
+            # that the stuck `starting` slot is free.
+            for fut, _tpu in self._worker_waiters:
+                if not fut.done():
+                    fut.set_result(None)
+            self._worker_waiters = []
+
+    async def _pop_worker(self, ignore_cap: bool = False,
+                          tpu: bool = False) -> WorkerHandle:
         while True:
-            if self.idle:
-                return self.idle.pop()
-            max_workers = (self.config.max_workers_per_node
-                           or max(self.num_cpus, 4))
-            active = len(self.workers) + self.starting
-            if ignore_cap or active < max_workers or self.starting == 0:
-                self._start_worker_process()
+            pool = self.idle_tpu if tpu else self.idle
+            if pool:
+                return pool.pop()
+            if tpu:
+                # TPU workers are dedicated and rare — no cap games.
+                if self.starting_tpu == 0:
+                    self._start_worker_process(tpu=True)
+            else:
+                max_workers = (self.config.max_workers_per_node
+                               or max(self.num_cpus, 4))
+                active = len(self.workers) + self.starting
+                if ignore_cap or active < max_workers or self.starting == 0:
+                    self._start_worker_process()
             fut = asyncio.get_running_loop().create_future()
-            self._worker_waiters.append(fut)
+            self._worker_waiters.append((fut, tpu))
             await fut
 
     def _push_worker(self, worker: WorkerHandle):
@@ -181,23 +238,34 @@ class Raylet:
         worker.lease_pg = None
         if worker.conn.closed:
             return
-        self.idle.append(worker)
+        (self.idle_tpu if worker.flavor == "tpu" else self.idle).append(worker)
         self._wake_worker_waiters()
 
     def _wake_worker_waiters(self):
-        while self._worker_waiters and self.idle:
-            fut = self._worker_waiters.pop(0)
-            if not fut.done():
+        remaining = []
+        for fut, tpu in self._worker_waiters:
+            pool = self.idle_tpu if tpu else self.idle
+            if pool and not fut.done():
                 fut.set_result(None)
+            elif not fut.done():
+                remaining.append((fut, tpu))
+        self._worker_waiters = remaining
 
     async def h_register_client(self, conn, d):
         kind = d["kind"]
         if kind == "worker":
             worker = WorkerHandle(d["worker_id"], d["address"], d["pid"], conn)
+            worker.flavor = d.get("flavor", "cpu")
+            self._starting_procs = [(p, f) for p, f in self._starting_procs
+                                    if p.pid != d["pid"]]
             self.workers[d["worker_id"]] = worker
             conn.context["worker"] = worker
-            self.starting = max(0, self.starting - 1)
-            self.idle.append(worker)
+            if worker.flavor == "tpu":
+                self.starting_tpu = max(0, self.starting_tpu - 1)
+                self.idle_tpu.append(worker)
+            else:
+                self.starting = max(0, self.starting - 1)
+                self.idle.append(worker)
             self._wake_worker_waiters()
         else:  # driver
             conn.context["driver"] = True
@@ -210,6 +278,8 @@ class Raylet:
         self.workers.pop(worker.worker_id, None)
         if worker in self.idle:
             self.idle.remove(worker)
+        if worker in self.idle_tpu:
+            self.idle_tpu.remove(worker)
         # release lease resources
         if worker.lease_resources is not None:
             self._release(worker.lease_resources, worker.lease_pg)
@@ -298,6 +368,40 @@ class Raylet:
                 cands.append(info["address"])
         return random.choice(cands) if cands else None
 
+    async def _pick_spillback_load_aware(self, spec) -> str | None:
+        """Local node is feasible-by-totals but saturated: find a remote
+        node with the capacity available RIGHT NOW (heartbeat-fresh GCS
+        view) instead of hoarding the task in the local queue
+        (reference: availability-scored hybrid policy,
+        cluster_resource_scheduler.cc:217-320)."""
+        import random
+
+        if self.gcs is None or len(self.cluster_nodes) <= 1:
+            return None
+        try:
+            avail_by_node = await self.gcs.call("get_available_resources", {})
+        except Exception:
+            return None
+        need = ResourceSet.from_raw(spec["resources"])
+        me = self.node_id.binary()
+        cands = []
+        for node_id, raw in avail_by_node.items():
+            if node_id == me or node_id not in self.cluster_nodes:
+                continue
+            if need.is_subset_of(ResourceSet.from_raw(raw)):
+                cands.append(self.cluster_nodes[node_id]["address"])
+        return random.choice(cands) if cands else None
+
+    def _warn_infeasible(self, spec):
+        shape = tuple(sorted(spec.get("resources", {}).items()))
+        if shape not in self._warned_infeasible:
+            self._warned_infeasible.add(shape)
+            logger.warning(
+                "task %s demands resources %s that no node in the cluster "
+                "can ever satisfy; it will hang until matching nodes join "
+                "(reference warns identically: cluster_task_manager.cc)",
+                spec.get("name", "?"), dict(spec.get("resources", {})))
+
     async def _pg_spillback(self, key) -> str | None:
         """A lease targeting a bundle this node doesn't host: redirect to
         the raylet that committed it (the GCS holds bundle→node placement;
@@ -329,19 +433,32 @@ class Raylet:
             addr = await self._pg_spillback(key)
             if addr is not None:
                 return {"spillback": addr}
+        hops = int(d.get("hops", 0))
         if not self._feasible_ever(spec):
             addr = self._pick_spillback(spec)
             if addr is not None:
-                return {"spillback": addr}
+                return {"spillback": addr, "hops": hops + 1}
             # Infeasible everywhere: queue until the cluster changes.
+            self._warn_infeasible(spec)
+        elif key is None and hops < 3:
+            # Feasible here but saturated: offer it to a node that can run
+            # it now rather than hoarding it (hop-capped to stop ping-pong
+            # when the whole cluster is saturated).
+            addr = await self._pick_spillback_load_aware(spec)
+            if addr is not None:
+                return {"spillback": addr, "hops": hops + 1}
         fut = asyncio.get_running_loop().create_future()
         self.pending_leases.append((spec, fut))
         return await fut
 
+    @staticmethod
+    def _needs_tpu(spec) -> bool:
+        return float(spec.get("resources", {}).get("TPU") or 0) > 0
+
     async def _grant_lease(self, spec, acquired):
         res, pg_key = acquired
         try:
-            worker = await self._pop_worker()
+            worker = await self._pop_worker(tpu=self._needs_tpu(spec))
         except Exception:
             self._release(res, pg_key)
             raise
@@ -402,7 +519,7 @@ class Raylet:
         res, pg_key = acquired
         try:
             worker = await asyncio.wait_for(
-                self._pop_worker(ignore_cap=True),
+                self._pop_worker(ignore_cap=True, tpu=self._needs_tpu(spec)),
                 self.config.worker_register_timeout_s)
         except Exception:
             self._release(res, pg_key)
@@ -731,6 +848,14 @@ class Raylet:
                 self.cluster_nodes.pop(node["node_id"], None)
                 await self._dispatch_pending()
 
+    async def _reap_loop(self):
+        while True:
+            await asyncio.sleep(1.0)
+            try:
+                self._reap_starting_workers()
+            except Exception:
+                logger.exception("starting-worker reap failed")
+
     async def heartbeat_loop(self):
         while True:
             await asyncio.sleep(self.config.heartbeat_interval_s)
@@ -745,25 +870,41 @@ class Raylet:
     async def run(self, port: int = 0, ready_file: str | None = None):
         actual = await self.server.start_tcp(port=port)
         self.address = f"127.0.0.1:{actual}"
+
+        async def _gcs_session(conn):
+            """(Re-)establish GCS session state: subscribe, refresh the
+            cluster view, re-register this node. Runs on first connect and
+            again after every GCS restart (reference: raylet re-registers
+            via service_based_gcs_client reconnection)."""
+            await conn.call("subscribe", {"channel": "nodes"})
+            nodes = await conn.call("get_all_nodes", {})
+            self.cluster_nodes = {n["node_id"]: n for n in nodes}
+            await conn.call("register_node", {
+                "node_id": self.node_id.binary(),
+                "address": self.address,
+                "resources": self.total.raw(),
+                "available": self.available.raw(),
+                "hostname": os.uname().nodename,
+                "is_head": self.is_head,
+                "labels": self.labels,
+            })
+
+        def _gcs_gone():
+            logger.error("GCS unreachable past reconnect timeout; raylet "
+                         "exiting (workers die with it)")
+            os._exit(1)
+
         # Duplex: the GCS drives actor creation and bundle 2PC back over
-        # this connection.
-        self.gcs = await rpc.connect(self.gcs_address,
-                                     handlers=self._handlers(),
-                                     name="raylet->gcs")
+        # this connection; it survives GCS restarts.
+        self.gcs = rpc.ReconnectingConnection(
+            self.gcs_address, handlers=self._handlers(), name="raylet->gcs",
+            on_reconnect=_gcs_session,
+            retry_timeout=self.config.gcs_reconnect_timeout_s,
+            on_give_up=_gcs_gone)
         self.gcs.set_push_handler(self._handle_gcs_push)
-        await self.gcs.call("subscribe", {"channel": "nodes"})
-        nodes = await self.gcs.call("get_all_nodes", {})
-        for node in nodes:
-            self.cluster_nodes[node["node_id"]] = node
-        await self.gcs.call("register_node", {
-            "node_id": self.node_id.binary(),
-            "address": self.address,
-            "resources": self.total.raw(),
-            "hostname": os.uname().nodename,
-            "is_head": self.is_head,
-            "labels": self.labels,
-        })
+        await _gcs_session(await self.gcs.ensure_connected())
         asyncio.create_task(self.heartbeat_loop())
+        asyncio.create_task(self._reap_loop())
         prestart = self.config.num_initial_workers
         if prestart < 0:
             prestart = min(int(self.num_cpus), 8)

@@ -6,8 +6,14 @@ register :187, train_epoch :437), redesigned jax-first:
   optimizer instead of nn.Module/torch.optim objects;
 - the framework jits one fused step: value_and_grad → (cross-worker grad
   allreduce) → optimizer update with donated buffers;
-- gradients cross workers as ONE flat bucket (ravel_pytree), the DDP
-  bucketing idea without the bookkeeping.
+- when the model has mutable state (batchnorm stats), register with
+  stateful=True and model_init returning (params, state), loss_fn
+  (params, state, batch) -> (loss, new_state);
+- single-worker (or XLA-backend) groups run ONE fused jit per batch with
+  all buffers donated and the loss left on device — no host syncs inside
+  the epoch loop, so the framework path matches a bare jit loop;
+- multi-worker host groups move gradients as ONE flat bucket
+  (ravel_pytree), the DDP bucketing idea without the bookkeeping.
 """
 
 from __future__ import annotations
@@ -48,17 +54,30 @@ class TrainingOperator:
         raise NotImplementedError
 
     def register(self, *, model_init: Callable[[jax.Array], Any],
-                 loss_fn: Callable[[Any, Any], jax.Array],
-                 optimizer, seed: int = 0,
-                 eval_fn: Callable[[Any, Any], dict] | None = None):
-        """model_init(rng) -> params pytree; loss_fn(params, batch) -> scalar
-        loss; optimizer: optax GradientTransformation; eval_fn(params, batch)
-        -> metrics dict (defaults to {"val_loss": loss_fn(...)})."""
+                 loss_fn: Callable[..., jax.Array],
+                 optimizer, seed: int = 0, stateful: bool = False,
+                 eval_fn: Callable[..., dict] | None = None):
+        """Register the functional model.
+
+        stateful=False: model_init(rng) -> params;
+            loss_fn(params, batch) -> scalar loss.
+        stateful=True (models with mutable state, e.g. batchnorm):
+            model_init(rng) -> (params, state);
+            loss_fn(params, state, batch) -> (loss, new_state).
+        optimizer: optax GradientTransformation.
+        eval_fn(params[, state], batch) -> metrics dict (defaults to
+            loss_fn in eval position).
+        """
         self._registered = True
         self._loss_fn = loss_fn
         self._eval_fn = eval_fn
         self._optimizer = optimizer
-        self.params = model_init(jax.random.key(seed))
+        self._stateful = stateful
+        if stateful:
+            self.params, self.model_state = model_init(jax.random.key(seed))
+        else:
+            self.params = model_init(jax.random.key(seed))
+            self.model_state = None
         self.opt_state = optimizer.init(self.params)
         _, self._unravel = ravel_pytree(self.params)
         self._build_steps()
@@ -75,29 +94,60 @@ class TrainingOperator:
     def _build_steps(self):
         loss_fn, optimizer = self._loss_fn, self._optimizer
         unravel = self._unravel
+        stateful = self._stateful
 
-        @jax.jit
-        def grad_step(params, batch):
-            loss, grads = jax.value_and_grad(loss_fn)(params, batch)
-            return loss, ravel_pytree(grads)[0]
+        # Fused path (single worker): grads + update in one jit, buffers
+        # donated so XLA updates params/opt_state in place; loss stays on
+        # device — the epoch loop issues pure async dispatches.
+        if stateful:
+            def fused(params, mstate, opt_state, batch):
+                (loss, new_mstate), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(params, mstate, batch)
+                updates, opt_state = optimizer.update(grads, opt_state,
+                                                      params)
+                params = jax.tree.map(lambda p, u: p + u, params, updates)
+                return params, new_mstate, opt_state, loss
+
+            def grad_step(params, mstate, batch):
+                (loss, new_mstate), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(params, mstate, batch)
+                return loss, new_mstate, ravel_pytree(grads)[0]
+
+            self._fused_step = jax.jit(fused, donate_argnums=(0, 1, 2))
+            self._grad_step = jax.jit(grad_step)
+        else:
+            def fused(params, mstate, opt_state, batch):
+                loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+                updates, opt_state = optimizer.update(grads, opt_state,
+                                                      params)
+                params = jax.tree.map(lambda p, u: p + u, params, updates)
+                return params, mstate, opt_state, loss
+
+            def grad_step(params, mstate, batch):
+                loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+                return loss, mstate, ravel_pytree(grads)[0]
+
+            self._fused_step = jax.jit(fused, donate_argnums=(0, 2))
+            self._grad_step = jax.jit(grad_step)
 
         def apply_step(params, opt_state, flat_grads):
             grads = unravel(flat_grads)
             updates, opt_state = optimizer.update(grads, opt_state, params)
             return jax.tree.map(lambda p, u: p + u, params, updates), opt_state
 
-        self._grad_step = grad_step
         self._apply_step = jax.jit(apply_step, donate_argnums=(0, 1))
 
-        if self._eval_fn is None:
+        if self._eval_fn is not None:
+            self._jit_eval = jax.jit(self._eval_fn)
+        elif stateful:
+            self._jit_eval = jax.jit(
+                lambda params, mstate, batch:
+                {"val_loss": loss_fn(params, mstate, batch)[0]})
+        else:
             self._jit_eval = jax.jit(
                 lambda params, batch: {"val_loss": loss_fn(params, batch)})
-        else:
-            self._jit_eval = jax.jit(self._eval_fn)
 
     def _allreduce_grads(self, flat_grads: jax.Array) -> np.ndarray:
-        if self.world_size == 1:
-            return flat_grads
         from ray_tpu.collective import collective as col
 
         avg = col.allreduce(np.asarray(flat_grads),
@@ -109,29 +159,49 @@ class TrainingOperator:
     # ------------------------------------------------------------------
 
     def train_batch(self, batch) -> dict:
-        loss, flat_grads = self._grad_step(self.params, batch)
-        flat_grads = self._allreduce_grads(flat_grads)
-        self.params, self.opt_state = self._apply_step(
-            self.params, self.opt_state, flat_grads)
+        """Sync path for step-at-a-time callers; returns a host float."""
+        loss = self._dispatch_batch(batch)
         self.global_step += 1
         return {"train_loss": float(loss)}
 
-    def train_epoch(self, num_steps: int | None = None) -> dict:
+    def _dispatch_batch(self, batch):
+        """Run one step, returning the (possibly device-resident) loss."""
+        if self.world_size == 1:
+            self.params, self.model_state, self.opt_state, loss = (
+                self._fused_step(self.params, self.model_state,
+                                 self.opt_state, batch))
+            return loss
+        loss, self.model_state, flat_grads = self._grad_step(
+            self.params, self.model_state, batch)
+        flat_grads = self._allreduce_grads(flat_grads)
+        self.params, self.opt_state = self._apply_step(
+            self.params, self.opt_state, flat_grads)
+        return loss
+
+    def train_epoch(self, num_steps: int | None = None,
+                    profile_dir: str | None = None) -> dict:
         if self._train_loader is None:
             raise RuntimeError("no train_loader registered")
-        t0 = time.perf_counter()
-        losses, samples = [], 0
-        it = iter(self._train_loader)
-        step = 0
-        for batch in it:
-            metrics = self.train_batch(batch)
-            losses.append(metrics["train_loss"])
-            samples += _batch_size(batch)
-            step += 1
-            if num_steps is not None and step >= num_steps:
-                break
+        if profile_dir:
+            jax.profiler.start_trace(profile_dir)
+        try:
+            t0 = time.perf_counter()
+            losses, samples = [], 0
+            step = 0
+            for batch in self._train_loader:
+                losses.append(self._dispatch_batch(batch))
+                self.global_step += 1
+                samples += _batch_size(batch)
+                step += 1
+                if num_steps is not None and step >= num_steps:
+                    break
+            # One sync for the whole epoch: the loop was async dispatch.
+            losses = [float(x) for x in losses]
+            dt = time.perf_counter() - t0
+        finally:
+            if profile_dir:
+                jax.profiler.stop_trace()
         self.epoch += 1
-        dt = time.perf_counter() - t0
         return {
             "epoch": self.epoch,
             "batch_count": len(losses),
@@ -147,7 +217,8 @@ class TrainingOperator:
         all_metrics: list[dict] = []
         samples = 0
         for step, batch in enumerate(self._val_loader):
-            m = self._jit_eval(self.params, batch)
+            m = (self._jit_eval(self.params, self.model_state, batch)
+                 if self._stateful else self._jit_eval(self.params, batch))
             all_metrics.append({k: float(v) for k, v in m.items()})
             samples += _batch_size(batch)
             if num_steps is not None and step + 1 >= num_steps:
@@ -162,17 +233,24 @@ class TrainingOperator:
     # ------------------------------------------------------------------
 
     def state_dict(self) -> dict:
+        def to_np(x):
+            return np.asarray(x) if isinstance(
+                x, (jnp.ndarray, np.ndarray)) else x
+
         return {
             "params": jax.tree.map(np.asarray, self.params),
-            "opt_state": jax.tree.map(
-                lambda x: np.asarray(x) if isinstance(
-                    x, (jnp.ndarray, np.ndarray)) else x, self.opt_state),
+            "model_state": (None if self.model_state is None
+                            else jax.tree.map(to_np, self.model_state)),
+            "opt_state": jax.tree.map(to_np, self.opt_state),
             "epoch": self.epoch,
             "global_step": self.global_step,
         }
 
     def load_state_dict(self, state: dict):
         self.params = jax.tree.map(jnp.asarray, state["params"])
+        if state.get("model_state") is not None:
+            self.model_state = jax.tree.map(jnp.asarray,
+                                            state["model_state"])
         self.opt_state = jax.tree.map(
             lambda ref, x: jnp.asarray(x) if isinstance(
                 x, np.ndarray) else x,

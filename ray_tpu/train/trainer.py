@@ -42,8 +42,8 @@ class TrainWorker(CollectiveActorMixin):
             group_name=self._group_name)
         return True
 
-    def train_epoch(self, num_steps=None):
-        return self.operator.train_epoch(num_steps)
+    def train_epoch(self, num_steps=None, profile_dir=None):
+        return self.operator.train_epoch(num_steps, profile_dir=profile_dir)
 
     def validate(self, num_steps=None):
         return self.operator.validate(num_steps)
@@ -69,7 +69,8 @@ class Trainer:
                  use_tpu: bool = False,
                  backend: str = "host",
                  max_retries: int = 3,
-                 collective_timeout: float = 30.0):
+                 collective_timeout: float = 30.0,
+                 setup_timeout: float = 600.0):
         self._operator_cls = training_operator_cls
         self._config = config or {}
         self._num_workers = num_workers
@@ -79,6 +80,10 @@ class Trainer:
         self._backend = backend
         self._max_retries = max_retries
         self._collective_timeout = collective_timeout
+        # First-compile on a cold TPU (esp. through a tunnel) can exceed
+        # two minutes; operator setup waits this long before declaring the
+        # worker wedged.
+        self._setup_timeout = setup_timeout
         self._generation = 0
         self._uid = uuid.uuid4().hex[:8]
         self.workers: list = []
@@ -111,11 +116,11 @@ class Trainer:
                 backend=self._backend, group_name=group_name,
                 timeout=self._collective_timeout)
         ray_tpu.get([w.setup_operator.remote() for w in self.workers],
-                    timeout=120)
+                    timeout=self._setup_timeout)
         self._active_workers = num_workers
         if self._last_state is not None:
             ray_tpu.get([w.load_state_dict.remote(self._last_state)
-                         for w in self.workers], timeout=120)
+                         for w in self.workers], timeout=self._setup_timeout)
 
     def _kill_workers(self):
         for w in self.workers:
@@ -153,13 +158,13 @@ class Trainer:
                 return True
         return False
 
-    def _run_with_retries(self, fn_name: str, num_steps):
+    def _run_with_retries(self, fn_name: str, num_steps, **kw):
         for attempt in range(self._max_retries + 1):
             try:
                 if not self.workers:
                     raise exc.WorkerCrashedError("worker group is empty")
                 return ray_tpu.get(
-                    [getattr(w, fn_name).remote(num_steps)
+                    [getattr(w, fn_name).remote(num_steps, **kw)
                      for w in self.workers],
                     timeout=600)
             except (exc.ActorDiedError, exc.WorkerCrashedError,
@@ -180,8 +185,9 @@ class Trainer:
                 # group left empty; next attempt resizes again
 
     def train(self, num_steps: int | None = None,
-              reduce_results: bool = True):
-        results = self._run_with_retries("train_epoch", num_steps)
+              reduce_results: bool = True, profile_dir: str | None = None):
+        kw = {"profile_dir": profile_dir} if profile_dir else {}
+        results = self._run_with_retries("train_epoch", num_steps, **kw)
         self._last_state = ray_tpu.get(self.workers[0].state_dict.remote(),
                                        timeout=120)
         return _reduce(results) if reduce_results else results

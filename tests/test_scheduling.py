@@ -1,0 +1,46 @@
+"""Load-aware spillback (reference: the hybrid scheduling policy's
+availability scoring, src/ray/raylet/scheduling/cluster_resource_scheduler.cc:217-320):
+a node that is feasible-by-totals but currently saturated must hand
+queued work to an idle node instead of hoarding it."""
+
+import time
+
+import ray_tpu
+from ray_tpu._private import global_state
+from ray_tpu._private.node import start_gcs
+
+
+def test_saturated_node_spills_to_idle_node(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.gcs_svc, cluster.gcs_address = start_gcs(
+        cluster.session_dir, cluster.config)
+    # Head gets a "pin" resource so the squatters provably land there.
+    head = cluster.add_node(num_cpus=2, resources={"pin": 2}, is_head=True)
+    cluster.add_node(num_cpus=2)
+    cluster.connect_driver()
+    head_id = head.node_id.binary()
+
+    @ray_tpu.remote(num_cpus=1, resources={"pin": 1})
+    class Squatter:
+        """Holds one head CPU forever."""
+
+        def ready(self):
+            return True
+
+    @ray_tpu.remote(num_cpus=1)
+    def where():
+        cw = global_state.require_core_worker()
+        time.sleep(0.2)
+        return cw.node_id.binary()
+
+    # Saturate the head's 2 CPUs (actors hold their lease).
+    squatters = [Squatter.remote() for _ in range(2)]
+    ray_tpu.get([s.ready.remote() for s in squatters], timeout=60)
+
+    # These tasks are feasible on the head by totals, but the head is
+    # saturated — load-aware spillback must land them on the idle node.
+    refs = [where.remote() for _ in range(4)]
+    nodes = ray_tpu.get(refs, timeout=60)
+    assert any(n != head_id for n in nodes), (
+        "saturated head hoarded feasible tasks; expected spillback to the "
+        "idle second node")

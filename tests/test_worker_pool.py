@@ -1,0 +1,53 @@
+"""Worker-pool regression tests (reference: src/ray/raylet/worker_pool.h
+and worker_pool_test.cc): a worker process that dies before registering
+must release its `starting` slot so leases don't deadlock."""
+
+import asyncio
+import subprocess
+import sys
+
+from ray_tpu.raylet.raylet import Raylet
+
+
+def _bare_raylet() -> Raylet:
+    r = Raylet.__new__(Raylet)
+    r.starting = 0
+    r.starting_tpu = 0
+    r._worker_waiters = []
+    r._starting_procs = []
+    r.idle = []
+    r.idle_tpu = []
+    return r
+
+
+def test_reap_releases_starting_slot_and_wakes_waiters():
+    r = _bare_raylet()
+    proc = subprocess.Popen([sys.executable, "-c", "raise SystemExit(3)"])
+    proc.wait()
+    r.starting_tpu = 1
+    r._starting_procs = [(proc, "tpu")]
+
+    async def run():
+        fut = asyncio.get_running_loop().create_future()
+        r._worker_waiters.append((fut, True))
+        r._reap_starting_workers()
+        assert r.starting_tpu == 0, "dead starting worker must free its slot"
+        assert r._starting_procs == []
+        # waiter is woken so its _pop_worker loop respawns
+        await asyncio.wait_for(fut, timeout=1)
+
+    asyncio.run(run())
+
+
+def test_reap_keeps_live_processes():
+    r = _bare_raylet()
+    proc = subprocess.Popen([sys.executable, "-c", "import time; time.sleep(30)"])
+    try:
+        r.starting = 1
+        r._starting_procs = [(proc, "cpu")]
+        r._reap_starting_workers()
+        assert r.starting == 1
+        assert len(r._starting_procs) == 1
+    finally:
+        proc.kill()
+        proc.wait()
