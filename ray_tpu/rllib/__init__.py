@@ -2,14 +2,22 @@
 reference: rllib/). JAX policies with jitted learner steps; CPU rollout
 actors feed the (TPU) learner."""
 
-from ray_tpu.rllib.agents import PPOTrainer, Trainer, build_trainer
+from ray_tpu.rllib.agents import (DQNTrainer, ImpalaTrainer, PPOTrainer,
+                                  Trainer, build_trainer)
 from ray_tpu.rllib.env import make_env, register_env
+from ray_tpu.rllib.execution import (LearnerThread, PrioritizedReplayBuffer,
+                                     ReplayBuffer)
 from ray_tpu.rllib.policy import JAXPolicy, Policy, SampleBatch
 
 __all__ = [
+    "DQNTrainer",
+    "ImpalaTrainer",
     "JAXPolicy",
+    "LearnerThread",
     "PPOTrainer",
     "Policy",
+    "PrioritizedReplayBuffer",
+    "ReplayBuffer",
     "SampleBatch",
     "Trainer",
     "build_trainer",

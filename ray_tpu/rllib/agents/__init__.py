@@ -1,4 +1,7 @@
+from ray_tpu.rllib.agents.dqn import DQNTrainer
+from ray_tpu.rllib.agents.impala import ImpalaTrainer
 from ray_tpu.rllib.agents.ppo import PPOTrainer
 from ray_tpu.rllib.agents.trainer import Trainer, build_trainer
 
-__all__ = ["PPOTrainer", "Trainer", "build_trainer"]
+__all__ = ["DQNTrainer", "ImpalaTrainer", "PPOTrainer", "Trainer",
+           "build_trainer"]

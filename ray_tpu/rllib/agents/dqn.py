@@ -1,0 +1,225 @@
+"""DQN family — Q-learning with replay + target network (reference:
+rllib/agents/dqn/dqn.py, dqn_torch_policy.py; algorithm: Mnih et al. 2015,
+double-DQN: van Hasselt 2015). One jitted TD step (loss + grads + Adam +
+TD errors for prioritized replay) instead of the reference's separate
+torch passes."""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.agents.trainer import COMMON_CONFIG, Trainer
+from ray_tpu.rllib.execution.replay_buffer import (PrioritizedReplayBuffer,
+                                                   ReplayBuffer)
+from ray_tpu.rllib.policy.jax_policy import _mlp_apply, _mlp_init
+from ray_tpu.rllib.policy.policy import Policy
+from ray_tpu.rllib.policy.sample_batch import SampleBatch
+
+DQN_CONFIG = {
+    **COMMON_CONFIG,
+    "num_workers": 0,
+    "rollout_fragment_length": 4,
+    "train_batch_size": 32,
+    "lr": 5e-4,
+    "buffer_size": 50_000,
+    "prioritized_replay": True,
+    "prioritized_replay_alpha": 0.6,
+    "prioritized_replay_beta": 0.4,
+    "learning_starts": 1000,
+    "target_network_update_freq": 500,
+    "double_q": True,
+    "exploration_initial_eps": 1.0,
+    "exploration_final_eps": 0.02,
+    "exploration_fraction": 0.1,   # of total_timesteps_anneal
+    "total_timesteps_anneal": 25_000,
+    "sgd_rounds_per_step": 1,
+}
+
+
+class DQNPolicy(Policy):
+    """Epsilon-greedy Q policy; discrete action spaces only."""
+
+    discrete = True
+
+    def __init__(self, observation_space, action_space, config: dict):
+        super().__init__(observation_space, action_space, config)
+        import optax
+
+        if not hasattr(action_space, "n"):
+            raise ValueError("DQN requires a discrete action space")
+        obs_dim = int(np.prod(observation_space.shape))
+        hiddens = list(config.get("fcnet_hiddens", [64, 64]))
+        n_act = int(action_space.n)
+        seed = config.get("seed") or 0
+        self.params = _mlp_init(jax.random.key(seed),
+                                [obs_dim] + hiddens + [n_act])
+        self.target_params = jax.tree.map(jnp.copy, self.params)
+        self._optimizer = optax.adam(config.get("lr", 5e-4))
+        self.opt_state = self._optimizer.init(self.params)
+        self.eps = float(config.get("exploration_initial_eps", 1.0))
+        self._rng = np.random.RandomState(seed + 1)
+        gamma = config.get("gamma", 0.99)
+        double_q = bool(config.get("double_q", True))
+        optimizer = self._optimizer
+
+        @jax.jit
+        def q_values(params, obs):
+            return _mlp_apply(params, obs)
+
+        @jax.jit
+        def td_step(params, target_params, opt_state, batch):
+            obs = batch[SampleBatch.OBS]
+            next_obs = batch[SampleBatch.NEXT_OBS]
+            actions = batch[SampleBatch.ACTIONS].astype(jnp.int32)
+            rewards = batch[SampleBatch.REWARDS]
+            not_done = 1.0 - batch[SampleBatch.DONES].astype(jnp.float32)
+            weights = batch.get("weights")
+
+            q_next_target = _mlp_apply(target_params, next_obs)
+            if double_q:
+                sel = jnp.argmax(_mlp_apply(params, next_obs), axis=-1)
+            else:
+                sel = jnp.argmax(q_next_target, axis=-1)
+            bootstrap = jnp.take_along_axis(
+                q_next_target, sel[:, None], axis=-1)[:, 0]
+            targets = rewards + gamma * not_done * bootstrap
+            targets = jax.lax.stop_gradient(targets)
+
+            def loss_fn(p):
+                q = jnp.take_along_axis(
+                    _mlp_apply(p, obs), actions[:, None], axis=-1)[:, 0]
+                td = q - targets
+                huber = jnp.where(jnp.abs(td) < 1.0, 0.5 * td * td,
+                                  jnp.abs(td) - 0.5)
+                if weights is not None:
+                    huber = huber * weights
+                return huber.mean(), td
+
+            (loss, td), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            params = jax.tree.map(lambda p, u: p + u, params, updates)
+            return params, opt_state, loss, td
+
+        self._q_values = q_values
+        self._td_step = td_step
+
+    # -- acting ----------------------------------------------------------
+
+    def compute_actions(self, obs_batch, explore=True):
+        obs = jnp.asarray(obs_batch, jnp.float32).reshape(len(obs_batch), -1)
+        q = np.asarray(self._q_values(self.params, obs))
+        actions = q.argmax(axis=-1)
+        if explore and self.eps > 0:
+            mask = self._rng.random_sample(len(actions)) < self.eps
+            actions = np.where(
+                mask, self._rng.randint(0, q.shape[-1], len(actions)),
+                actions)
+        return actions, {
+            SampleBatch.ACTION_LOGP: np.zeros(len(actions), np.float32),
+            SampleBatch.VF_PREDS: q.max(axis=-1),
+        }
+
+    # -- learning --------------------------------------------------------
+
+    def learn_on_batch(self, batch: SampleBatch) -> dict:
+        jb = {k: jnp.asarray(v) for k, v in batch.items()
+              if k != "batch_indexes" and v.dtype != object}
+        self.params, self.opt_state, loss, td = self._td_step(
+            self.params, self.target_params, self.opt_state, jb)
+        return {"loss": float(loss), "td_errors": np.asarray(td)}
+
+    def update_target(self):
+        self.target_params = jax.tree.map(jnp.copy, self.params)
+
+    def set_epsilon(self, eps: float):
+        self.eps = float(eps)
+        return True
+
+    def get_weights(self):
+        return {"q": jax.tree.map(np.asarray, self.params),
+                "eps": self.eps}
+
+    def set_weights(self, weights):
+        self.params = jax.tree.map(jnp.asarray, weights["q"])
+        self.eps = weights["eps"]
+
+
+class DQNTrainer(Trainer):
+    """reference: rllib/agents/dqn/dqn.py DQNTrainer execution plan
+    (store → sample → train → update target)."""
+
+    _default_config = DQN_CONFIG
+    _name = "DQN"
+
+    @staticmethod
+    def policy_builder(obs_space, action_space, config):
+        return DQNPolicy(obs_space, action_space, config)
+
+    def setup(self, config):
+        super().setup(config)
+        if config.get("prioritized_replay", True):
+            self._buffer = PrioritizedReplayBuffer(
+                config["buffer_size"],
+                alpha=config.get("prioritized_replay_alpha", 0.6),
+                seed=config.get("seed"))
+        else:
+            self._buffer = ReplayBuffer(config["buffer_size"],
+                                        seed=config.get("seed"))
+        self._timesteps = 0
+        self._last_target_update = 0
+
+    def _epsilon(self) -> float:
+        cfg = self.config
+        anneal = (cfg.get("total_timesteps_anneal", 25_000)
+                  * cfg.get("exploration_fraction", 0.1))
+        frac = min(1.0, self._timesteps / max(1, anneal))
+        e0 = cfg.get("exploration_initial_eps", 1.0)
+        e1 = cfg.get("exploration_final_eps", 0.02)
+        return e0 + frac * (e1 - e0)
+
+    def train_step(self) -> dict:
+        cfg = self.config
+        # Collect a fragment and stash it (store op).
+        batch = self.workers.sample(cfg.get("rollout_fragment_length", 4))
+        self._buffer.add_batch(batch)
+        self._timesteps += batch.count
+        eps = self._epsilon()
+        # Remote workers pick the epsilon up with the weight broadcast
+        # below (get_weights carries it).
+        self.workers.local_worker.policy.set_epsilon(eps)
+
+        metrics = {"timesteps_total": self._timesteps,
+                   "epsilon": round(eps, 4),
+                   "buffer_size": len(self._buffer)}
+        if len(self._buffer) < cfg.get("learning_starts", 1000):
+            return metrics
+
+        # Replay → TD step(s).
+        for _ in range(cfg.get("sgd_rounds_per_step", 1)):
+            if isinstance(self._buffer, PrioritizedReplayBuffer):
+                replay = self._buffer.sample(
+                    cfg.get("train_batch_size", 32),
+                    beta=cfg.get("prioritized_replay_beta", 0.4))
+            else:
+                replay = self._buffer.sample(cfg.get("train_batch_size", 32))
+            info = self.workers.local_worker.learn_on_batch(replay)
+            if isinstance(self._buffer, PrioritizedReplayBuffer):
+                self._buffer.update_priorities(replay["batch_indexes"],
+                                               info.pop("td_errors"))
+            else:
+                info.pop("td_errors", None)
+            metrics.update(info)
+
+        # Target network sync.
+        if (self._timesteps - self._last_target_update
+                >= cfg.get("target_network_update_freq", 500)):
+            self._last_target_update = self._timesteps
+            self.workers.local_worker.policy.update_target()
+        self.workers.sync_weights()
+        return metrics

@@ -1,0 +1,50 @@
+"""V-trace off-policy correction (IMPALA; reference:
+rllib/agents/impala/vtrace_torch.py — the algorithm, not the code: here
+it is a single backwards `lax.scan`, which XLA compiles into one fused
+loop on TPU instead of the reference's per-timestep python/torch loop).
+
+Shapes are time-major [T, B] (B = trajectory fragments)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def vtrace_returns(behaviour_logp, target_logp, discounts, rewards, values,
+                   bootstrap_value, clip_rho: float = 1.0,
+                   clip_pg_rho: float = 1.0):
+    """Compute v-trace targets vs and policy-gradient advantages.
+
+    Args (all [T, B] except bootstrap_value [B]):
+        behaviour_logp: log pi_b(a_t|x_t) from the actor that sampled.
+        target_logp:    log pi(a_t|x_t) under the learner's params.
+        discounts:      gamma * (1 - done_t).
+        rewards, values: r_t, V(x_t).
+        bootstrap_value: V(x_{T}) for the step after the fragment.
+    Returns (vs, pg_advantages), both [T, B], gradient-stopped.
+    """
+    rhos = jnp.exp(target_logp - behaviour_logp)
+    clipped_rhos = jnp.minimum(clip_rho, rhos)
+    cs = jnp.minimum(1.0, rhos)
+
+    values_t_plus_1 = jnp.concatenate(
+        [values[1:], bootstrap_value[None]], axis=0)
+    deltas = clipped_rhos * (
+        rewards + discounts * values_t_plus_1 - values)
+
+    def backward(acc, xs):
+        delta, discount, c = xs
+        acc = delta + discount * c * acc
+        return acc, acc
+
+    _, vs_minus_v = jax.lax.scan(
+        backward, jnp.zeros_like(bootstrap_value),
+        (deltas, discounts, cs), reverse=True)
+    vs = vs_minus_v + values
+
+    vs_t_plus_1 = jnp.concatenate([vs[1:], bootstrap_value[None]], axis=0)
+    clipped_pg_rhos = jnp.minimum(clip_pg_rho, rhos)
+    pg_advantages = clipped_pg_rhos * (
+        rewards + discounts * vs_t_plus_1 - values)
+    return jax.lax.stop_gradient(vs), jax.lax.stop_gradient(pg_advantages)

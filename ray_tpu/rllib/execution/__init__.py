@@ -1,0 +1,7 @@
+"""Execution building blocks (reference: rllib/execution/)."""
+
+from ray_tpu.rllib.execution.learner_thread import LearnerThread
+from ray_tpu.rllib.execution.replay_buffer import (PrioritizedReplayBuffer,
+                                                   ReplayBuffer)
+
+__all__ = ["LearnerThread", "PrioritizedReplayBuffer", "ReplayBuffer"]
