@@ -56,28 +56,59 @@ class TrainingOperator:
     def register(self, *, model_init: Callable[[jax.Array], Any],
                  loss_fn: Callable[..., jax.Array],
                  optimizer, seed: int = 0, stateful: bool = False,
-                 eval_fn: Callable[..., dict] | None = None):
+                 eval_fn: Callable[..., dict] | None = None,
+                 mesh=None, param_spec=None, batch_spec=None):
         """Register the functional model.
 
         stateful=False: model_init(rng) -> params;
             loss_fn(params, batch) -> scalar loss.
         stateful=True (models with mutable state, e.g. batchnorm):
-            model_init(rng) -> (params, state);
             loss_fn(params, state, batch) -> (loss, new_state).
         optimizer: optax GradientTransformation.
         eval_fn(params[, state], batch) -> metrics dict (defaults to
             loss_fn in eval position).
+
+        mesh: a jax Mesh (possibly GLOBAL, spanning worker processes via
+            parallel.multihost) — the step runs SPMD over it and gradient
+            combination is XLA's psum over the batch axes, NOT the HOST
+            collective backend. param_spec: PartitionSpec or pytree of
+            them for the params (default replicated); batch_spec:
+            PartitionSpec for batches (default P('dp'): rows over the
+            data axis).
         """
         self._registered = True
         self._loss_fn = loss_fn
         self._eval_fn = eval_fn
         self._optimizer = optimizer
         self._stateful = stateful
+        self._mesh = mesh
         if stateful:
             self.params, self.model_state = model_init(jax.random.key(seed))
         else:
             self.params = model_init(jax.random.key(seed))
             self.model_state = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            def to_sharding(spec):
+                return NamedSharding(mesh, spec if spec is not None else P())
+
+            if param_spec is None or isinstance(param_spec, P):
+                p_shard = to_sharding(param_spec)
+                self.params = jax.device_put(self.params, p_shard)
+            else:  # pytree of PartitionSpecs matching params
+                self.params = jax.tree.map(
+                    lambda p, s: jax.device_put(p, to_sharding(s)),
+                    self.params, param_spec,
+                    is_leaf=lambda x: isinstance(x, P))
+            if self.model_state is not None:
+                self.model_state = jax.device_put(self.model_state,
+                                                  to_sharding(None))
+            self._batch_sharding = to_sharding(
+                batch_spec if batch_spec is not None else P("dp"))
+        # After placement: optax init inherits the params' shardings
+        # (zeros_like preserves sharding), so optimizer state is laid out
+        # like the params without extra plumbing.
         self.opt_state = optimizer.init(self.params)
         _, self._unravel = ravel_pytree(self.params)
         self._build_steps()
@@ -164,8 +195,25 @@ class TrainingOperator:
         self.global_step += 1
         return {"train_loss": float(loss)}
 
+    def _place_batch(self, batch):
+        """Mesh path: lift a host-local batch onto the (global) mesh —
+        each process contributes its local rows; XLA's compiled
+        collectives combine across processes."""
+        if jax.process_count() > 1:
+            from ray_tpu.parallel import multihost
+
+            return multihost.shard_host_batch(batch, self._batch_sharding)
+        return jax.device_put(batch, self._batch_sharding)
+
     def _dispatch_batch(self, batch):
         """Run one step, returning the (possibly device-resident) loss."""
+        if self._mesh is not None:
+            # SPMD over the (global) mesh — no HOST allreduce.
+            batch = self._place_batch(batch)
+            self.params, self.model_state, self.opt_state, loss = (
+                self._fused_step(self.params, self.model_state,
+                                 self.opt_state, batch))
+            return loss
         if self.world_size == 1:
             self.params, self.model_state, self.opt_state, loss = (
                 self._fused_step(self.params, self.model_state,
@@ -217,6 +265,8 @@ class TrainingOperator:
         all_metrics: list[dict] = []
         samples = 0
         for step, batch in enumerate(self._val_loader):
+            if self._mesh is not None:
+                batch = self._place_batch(batch)
             m = (self._jit_eval(self.params, self.model_state, batch)
                  if self._stateful else self._jit_eval(self.params, batch))
             all_metrics.append({k: float(v) for k, v in m.items()})
@@ -234,11 +284,20 @@ class TrainingOperator:
 
     def state_dict(self) -> dict:
         def to_np(x):
-            return np.asarray(x) if isinstance(
-                x, (jnp.ndarray, np.ndarray)) else x
+            if not isinstance(x, (jnp.ndarray, np.ndarray)):
+                return x
+            # Cross-process (multihost) shards aren't addressable locally:
+            # gather them before converting (replicated arrays pass
+            # np.asarray directly).
+            if (isinstance(x, jax.Array) and not x.is_fully_addressable
+                    and not x.is_fully_replicated):
+                from jax.experimental import multihost_utils
+
+                x = multihost_utils.process_allgather(x)
+            return np.asarray(x)
 
         return {
-            "params": jax.tree.map(np.asarray, self.params),
+            "params": jax.tree.map(to_np, self.params),
             "model_state": (None if self.model_state is None
                             else jax.tree.map(to_np, self.model_state)),
             "opt_state": jax.tree.map(to_np, self.opt_state),

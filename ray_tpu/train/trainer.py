@@ -37,6 +37,14 @@ class TrainWorker(CollectiveActorMixin):
         self.operator = None
 
     def setup_operator(self):
+        if self._config.get("multihost"):
+            # Join the group's global jax runtime BEFORE the operator's
+            # first backend use; the operator then sees jax.devices() =
+            # the whole group and builds a global mesh.
+            from ray_tpu.parallel import multihost
+
+            multihost.initialize(self._group_name, self._world_size,
+                                 self._rank)
         self.operator = self._operator_cls(
             self._config, self._rank, self._world_size,
             group_name=self._group_name)
@@ -108,7 +116,9 @@ class Trainer:
                               group_name)
             for rank in range(num_workers)
         ]
-        if num_workers > 1:
+        if num_workers > 1 and not self._config.get("multihost"):
+            # multihost groups sync gradients through XLA collectives
+            # inside the jitted step — no HOST group needed.
             from ray_tpu.collective import collective as col
 
             col.create_collective_group(
