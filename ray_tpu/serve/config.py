@@ -8,12 +8,28 @@ import dataclasses
 
 
 @dataclasses.dataclass
+class AutoscalingConfig:
+    """Queue-depth autoscaling (reference: python/ray/serve/
+    autoscaling_policy.py:137 calculate_desired_num_replicas — scale so
+    each replica carries ~target_queued queued queries)."""
+
+    min_replicas: int = 1
+    max_replicas: int = 4
+    target_queued: float = 2.0       # queued queries per replica
+    downscale_delay_s: float = 5.0   # hold-down before shrinking
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
 class BackendConfig:
     num_replicas: int = 1
     max_batch_size: int | None = None     # None = no batching
     batch_wait_timeout: float = 0.01      # s to wait filling a batch
     max_concurrent_queries: int = 8       # in-flight cap per replica
     user_config: dict | None = None
+    autoscaling: dict | None = None       # AutoscalingConfig.to_dict()
 
     def __post_init__(self):
         if self.num_replicas < 0:
@@ -22,6 +38,8 @@ class BackendConfig:
             raise ValueError("max_batch_size must be >= 1")
         if self.max_concurrent_queries < 1:
             raise ValueError("max_concurrent_queries must be >= 1")
+        if isinstance(self.autoscaling, AutoscalingConfig):
+            self.autoscaling = self.autoscaling.to_dict()
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)

@@ -1,9 +1,19 @@
 """ServeController actor (reference: python/ray/serve/controller.py:34 +
 backend_state.py reconciliation): owns the desired state — backends,
 endpoints, replica sets — and reconciles actual replica actors toward it.
-Config versions let routers/proxies poll-refresh (the long_poll.py idea)."""
+
+Routers/proxies stay in sync via LONG-POLL (reference: serve/long_poll.py:26
+LongPollHost): `listen_for_change(version)` is an async actor method that
+parks until the config version advances and then returns one full snapshot
+— zero controller RPCs on the request path. Queue-depth autoscaling
+(reference: autoscaling_policy.py:137) piggybacks on the same traffic:
+routers report queue lengths with each poll cycle and the controller
+resizes replica sets toward target_queued per replica."""
 
 from __future__ import annotations
+
+import math
+import time
 
 import ray_tpu
 from ray_tpu.serve.config import BackendConfig
@@ -18,6 +28,33 @@ class ServeController:
         # name -> {"backend": str, "route": str|None, "methods": [str]}
         self.endpoints: dict[str, dict] = {}
         self.version = 0
+        # endpoint -> latest reported router queue length
+        self._queue_lens: dict[str, float] = {}
+        self._last_downscale_ok: dict[str, float] = {}
+        self._last_autoscale = 0.0
+        # Long-poll parking: listeners wait on this event (on the actor's
+        # async loop); sync mutators fire it thread-safely via the loop.
+        self._change_event = None
+        self._loop = None
+
+    def _notify_change(self):
+        """Wake parked listen_for_change calls; safe from any thread."""
+        loop = self._loop
+        if loop is None:
+            return
+
+        def _fire():
+            import asyncio
+
+            ev = self._change_event
+            self._change_event = asyncio.Event()
+            if ev is not None:
+                ev.set()
+
+        try:
+            loop.call_soon_threadsafe(_fire)
+        except RuntimeError:
+            pass
 
     # -- backends --------------------------------------------------------
 
@@ -34,6 +71,7 @@ class ServeController:
         }
         self._reconcile(name)
         self.version += 1
+        self._notify_change()
         return True
 
     def delete_backend(self, name: str):
@@ -54,6 +92,7 @@ class ServeController:
             except Exception:
                 pass
         self.version += 1
+        self._notify_change()
         return True
 
     def update_backend_config(self, name: str, config: dict):
@@ -66,6 +105,7 @@ class ServeController:
                     for r in rec["replicas"]]
             ray_tpu.get(refs, timeout=60)
         self.version += 1
+        self._notify_change()
         return True
 
     def get_backend_config(self, name: str) -> dict:
@@ -107,11 +147,13 @@ class ServeController:
             "methods": [m.upper() for m in (methods or ["GET"])],
         }
         self.version += 1
+        self._notify_change()
         return True
 
     def delete_endpoint(self, name: str):
         out = self.endpoints.pop(name, None) is not None
         self.version += 1
+        self._notify_change()
         return out
 
     def list_endpoints(self) -> dict:
@@ -135,3 +177,84 @@ class ServeController:
             "config": dict(rec["config"]),
             "replicas": list(rec["replicas"]),
         }
+
+    # -- long poll (reference: serve/long_poll.py:26) --------------------
+
+    def _snapshot(self) -> dict:
+        return {
+            "version": self.version,
+            "routes": {
+                ep["route"]: {"endpoint": name, "methods": ep["methods"]}
+                for name, ep in self.endpoints.items() if ep.get("route")
+            },
+            "endpoints": {name: self.get_routing_state(name)
+                          for name in self.endpoints},
+        }
+
+    async def listen_for_change(self, cur_version: int,
+                                timeout_s: float = 10.0):
+        """Park until the config version advances past cur_version, then
+        return a full snapshot; None on timeout (client just re-polls).
+        Async actor method: concurrent listeners interleave on the actor's
+        event loop while sync mutators keep running on the dispatcher and
+        wake them via _notify_change — true parking, no poll loop."""
+        import asyncio
+
+        if self._loop is None:
+            self._loop = asyncio.get_running_loop()
+            self._change_event = asyncio.Event()
+        deadline = time.monotonic() + timeout_s
+        while self.version == cur_version:
+            ev = self._change_event
+            if self.version != cur_version:  # re-check after grabbing ev
+                break
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return None
+            try:
+                await asyncio.wait_for(ev.wait(), remaining)
+            except asyncio.TimeoutError:
+                return None
+        return self._snapshot()
+
+    # -- autoscaling (reference: autoscaling_policy.py:137) --------------
+
+    def report_queue_len(self, endpoint: str, queued: int):
+        """Routers report their queue depth each poll cycle; the report
+        traffic is also the autoscaler's clock."""
+        self._queue_lens[endpoint] = float(queued)
+        self._maybe_autoscale()
+        return True
+
+    def _maybe_autoscale(self):
+        now = time.monotonic()
+        if now - self._last_autoscale < 0.5:
+            return
+        self._last_autoscale = now
+        for name, rec in self.backends.items():
+            auto = rec["config"].get("autoscaling")
+            if not auto:
+                continue
+            queued = sum(q for ep, q in self._queue_lens.items()
+                         if self.endpoints.get(ep, {}).get("backend") == name)
+            cur = len(rec["replicas"])
+            target = auto.get("target_queued", 2.0) or 2.0
+            desired = max(auto.get("min_replicas", 1),
+                          min(auto.get("max_replicas", 4),
+                              max(1, math.ceil(queued / target))))
+            if desired > cur:
+                self._resize(name, desired)
+                self._last_downscale_ok[name] = (
+                    now + auto.get("downscale_delay_s", 5.0))
+            elif desired < cur:
+                # Hold-down: only shrink after the backlog has stayed low
+                # past the delay window (reference smooths the same way).
+                if now >= self._last_downscale_ok.get(name, 0.0):
+                    self._resize(name, desired)
+
+    def _resize(self, name: str, n: int):
+        rec = self._backend(name)
+        rec["config"]["num_replicas"] = n
+        self._reconcile(name)
+        self.version += 1
+        self._notify_change()

@@ -1,11 +1,15 @@
 """HTTP proxy actor (reference: python/ray/serve/http_proxy.py:165
 HTTPProxyActor — uvicorn/starlette there, aiohttp here). Routes
-`route -> endpoint` from the controller; JSON bodies in/out."""
+`route -> endpoint` pushed from the controller via long-poll
+(reference: serve/long_poll.py:26): the request path touches no
+controller RPC — it reads a locally-cached route table that a single
+background thread keeps fresh."""
 
 from __future__ import annotations
 
 import json
 import threading
+import time
 
 
 class HTTPProxy:
@@ -21,25 +25,36 @@ class HTTPProxy:
         self._port = port
         self._actual_port = None
         self._ready = threading.Event()
+        self._synced = threading.Event()
+        self._closed = False
+        self._poller = threading.Thread(target=self._poll_loop, daemon=True)
+        self._poller.start()
         self._thread = threading.Thread(target=self._serve, daemon=True)
         self._thread.start()
         self._ready.wait(timeout=10)
+        self._synced.wait(timeout=10)
 
-    def _refresh_routes(self):
+    def _poll_loop(self):
+        """Long-poll the controller: one parked RPC instead of a
+        get_version per HTTP request."""
         import ray_tpu
 
-        with self._state_lock:
-            version = ray_tpu.get(self._controller.get_version.remote(),
-                                  timeout=30)
-            if version == self._version:
-                return
-            endpoints = ray_tpu.get(self._controller.list_endpoints.remote(),
-                                    timeout=30)
-            self._routes = {
-                ep["route"]: {"endpoint": name, "methods": ep["methods"]}
-                for name, ep in endpoints.items() if ep.get("route")
-            }
-            self._version = version
+        while not self._closed:
+            try:
+                snap = ray_tpu.get(
+                    self._controller.listen_for_change.remote(
+                        self._version, 10.0),
+                    timeout=40)
+            except Exception:
+                time.sleep(0.5)
+                continue
+            if snap is None:
+                self._synced.set()  # controller alive, nothing changed
+                continue
+            with self._state_lock:
+                self._routes = dict(snap["routes"])
+                self._version = snap["version"]
+            self._synced.set()
 
     def _router_for(self, endpoint: str):
         # Executor threads race here; the lock keeps it to one Router
@@ -65,7 +80,6 @@ class HTTPProxy:
             def _call():
                 import ray_tpu
 
-                self._refresh_routes()
                 route = self._routes.get(request.path)
                 if route is None:
                     return 404, {"error": f"no route {request.path}"}

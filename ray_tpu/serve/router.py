@@ -45,6 +45,8 @@ class Router:
         self._completer = threading.Thread(target=self._completion_loop,
                                            daemon=True)
         self._completer.start()
+        self._poller = threading.Thread(target=self._poll_loop, daemon=True)
+        self._poller.start()
 
     # -- state sync ------------------------------------------------------
 
@@ -56,12 +58,32 @@ class Router:
             timeout=30)
         self._state_time = time.monotonic()
 
-    def _maybe_refresh(self):
-        if time.monotonic() - self._state_time > self._refresh_interval:
+    def _poll_loop(self):
+        """Long-poll push of routing state (reference: long_poll.py:26) +
+        queue-depth reporting for the controller's autoscaler (reference:
+        autoscaling_policy.py:137). The dispatch path never talks to the
+        controller."""
+        import ray_tpu
+
+        while not self._closed:
             try:
-                self._refresh()
+                with self._lock:
+                    qlen = len(self._queue)
+                ray_tpu.get(self._controller.report_queue_len.remote(
+                    self._endpoint, qlen), timeout=30)
+                snap = ray_tpu.get(
+                    self._controller.listen_for_change.remote(
+                        self._state["version"] if self._state else -1, 2.0),
+                    timeout=30)
             except Exception:
-                pass
+                time.sleep(0.5)
+                continue
+            if snap is None:
+                continue
+            st = snap["endpoints"].get(self._endpoint)
+            if st is not None:
+                self._state = st
+                self._wake.set()
 
     # -- client surface --------------------------------------------------
 
@@ -107,7 +129,6 @@ class Router:
             self._wake.wait(timeout=0.05)
             self._wake.clear()
             while not self._closed:
-                self._maybe_refresh()
                 cfg = self._state["config"]
                 max_bs = cfg["max_batch_size"] or 1
                 with self._lock:

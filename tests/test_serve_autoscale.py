@@ -1,0 +1,78 @@
+"""Serve autoscaling + long-poll (reference:
+python/ray/serve/autoscaling_policy.py:137 queue-depth scaling,
+serve/long_poll.py:26 push-based config sync)."""
+
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.serve.config import AutoscalingConfig, BackendConfig
+
+
+@pytest.fixture
+def serve_client(ray_start_regular):
+    client = serve.start()
+    try:
+        yield client
+    finally:
+        serve.shutdown()
+
+
+def _replicas(client, name):
+    return client.get_backend_config(name).num_replicas
+
+
+def test_scale_up_under_load_then_down(serve_client):
+    client = serve_client
+
+    def slow(data):
+        time.sleep(0.3)
+        return "ok"
+
+    client.create_backend("slow", slow, config=BackendConfig(
+        num_replicas=1, max_concurrent_queries=1,
+        autoscaling=AutoscalingConfig(
+            min_replicas=1, max_replicas=3, target_queued=1.0,
+            downscale_delay_s=2.0).to_dict()))
+    client.create_endpoint("slow", backend="slow")
+    handle = client.get_handle("slow")
+
+    # Pile up queries from threads (assign blocks until dispatch).
+    refs, errs = [], []
+
+    def fire():
+        try:
+            refs.append(handle.remote(None))
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    threads = [threading.Thread(target=fire) for _ in range(12)]
+    for t in threads:
+        t.start()
+
+    # Queue depth is reported by the router's poll loop; the controller
+    # must scale 1 -> 3 while the backlog drains.
+    deadline = time.monotonic() + 30
+    peak = 1
+    while time.monotonic() < deadline:
+        peak = max(peak, _replicas(client, "slow"))
+        if peak >= 3:
+            break
+        time.sleep(0.2)
+    assert peak >= 3, f"never scaled up (peak={peak})"
+
+    for t in threads:
+        t.join(timeout=60)
+    assert not errs
+    assert [ray_tpu.get(r, timeout=60) for r in refs] == ["ok"] * len(refs)
+
+    # Idle: after the hold-down it must shrink back to min_replicas.
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if _replicas(client, "slow") == 1:
+            break
+        time.sleep(0.3)
+    assert _replicas(client, "slow") == 1, "never scaled back down"
