@@ -80,6 +80,7 @@ class Connection:
         self._pending: dict[int, asyncio.Future] = {}
         self._push_handler: Callable[[str, Any], Awaitable[None]] | None = None
         self._send_lock = asyncio.Lock()
+        self._undrained = 0
         self._closed = False
         self._reader_task = asyncio.create_task(self._read_loop())
         # Opaque per-connection state slot for servers (e.g. worker identity).
@@ -159,7 +160,16 @@ class Connection:
         data = _pack(msg)
         async with self._send_lock:
             self._writer.write(data)
-            await self._writer.drain()
+            # drain() per frame costs a syscall-sized stall on every small
+            # control message (it was the top cost in the actor-call
+            # microbenchmark). Small frames skip it, but only up to an
+            # un-drained budget — an unbounded skip would let a one-way
+            # flood (e.g. worker log lines) grow the transport buffer
+            # without backpressure.
+            self._undrained += len(data)
+            if len(data) > 65536 or self._undrained > (1 << 20):
+                await self._writer.drain()
+                self._undrained = 0
 
     async def call(self, method: str, data: Any = None, timeout: float | None = None):
         msgid = next(self._msgid)

@@ -109,8 +109,69 @@ def main(seconds_per_case: float = 2.0) -> list[dict]:
     timeit("n:n actor calls async", actors_async, multiplier=100,
            results=results)
 
+    _serve_qps(results)
+
     ray_tpu.shutdown()
     return results
+
+
+def _serve_qps(results: list[dict]):
+    """Serve noop throughput (reference: serve release bench, ~3-4k qps
+    noop via HTTP). Measured through the handle (router batching path)
+    and through the HTTP proxy."""
+    from ray_tpu import serve
+
+    client = serve.start(http=True)
+    client.create_backend("noop", lambda _=None: "ok", config={
+        "num_replicas": 2, "max_batch_size": 32,
+        "batch_wait_timeout": 0.001, "max_concurrent_queries": 8})
+    client.create_endpoint("noop", backend="noop", route="/noop")
+    handle = client.get_handle("noop")
+    ray_tpu.get(handle.remote(None))  # warm the path
+
+    # qps is a CONCURRENT-load metric (the reference measures with wrk):
+    # router.assign intentionally blocks each caller until its batch is
+    # dispatched, so drive it from a client thread pool.
+    from concurrent.futures import ThreadPoolExecutor
+
+    pool = ThreadPoolExecutor(max_workers=16)
+
+    def one_handle_call(_):
+        return ray_tpu.get(handle.remote(None), timeout=30)
+
+    def handle_call():
+        list(pool.map(one_handle_call, range(64)))
+
+    timeit("serve handle noop calls", handle_call, multiplier=64,
+           results=results)
+
+    # Keep-alive connections (urllib reconnects per request, which would
+    # measure TCP handshakes, not the proxy).
+    import http.client
+    import threading as _threading
+
+    tls = _threading.local()
+
+    def one_http_call(_):
+        conn = getattr(tls, "conn", None)
+        if conn is None:
+            conn = http.client.HTTPConnection("127.0.0.1",
+                                              client.http_port)
+            tls.conn = conn
+        try:
+            conn.request("GET", "/noop")
+            conn.getresponse().read()
+        except (http.client.HTTPException, OSError):
+            tls.conn = None
+            raise
+
+    def http_call():
+        list(pool.map(one_http_call, range(64)))
+
+    timeit("serve http noop qps", http_call, multiplier=64,
+           results=results)
+    pool.shutdown()
+    serve.shutdown()
 
 
 if __name__ == "__main__":
@@ -119,7 +180,12 @@ if __name__ == "__main__":
     parser = argparse.ArgumentParser()
     parser.add_argument("--json", action="store_true",
                         help="also print one JSON line with all results")
+    parser.add_argument("--out", default=None,
+                        help="write results JSON to this path")
     args = parser.parse_args()
     out = main()
     if args.json:
         print(json.dumps(out))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(out, f, indent=1)
