@@ -80,16 +80,27 @@ def init(address: str | None = None, *, num_cpus: float | None = None,
             raise ConnectionError(f"no alive nodes in cluster at {address}")
         head = next((n for n in nodes if n.get("is_head")), nodes[0])
         raylet_address = head["address"]
-        session_dir = kwargs.get("session_dir") or "/tmp/ray_tpu/attached"
         import os
 
-        os.makedirs(session_dir, exist_ok=True)
-        store_root = kwargs.get("store_root")
-        if store_root is None:
-            import asyncio as _a
+        # Attach to the raylet's own session/store when it's on this host
+        # (the `ray-tpu start` two-shell flow): shared-memory objects are
+        # then zero-copy between driver and workers.
+        async def _info():
+            conn = await _rpc.connect(raylet_address, name="probe")
+            info = await conn.call("cluster_info", {})
+            await conn.close()
+            return info
 
-            # the raylet's cluster_info tells us its store root? round-1:
-            # drivers connecting remotely use their own scratch store.
+        try:
+            info = asyncio.run(_info())
+        except Exception:
+            info = {}
+        session_dir = kwargs.get("session_dir") or info.get("session_dir")
+        store_root = kwargs.get("store_root") or info.get("store_root")
+        if not (session_dir and os.path.isdir(session_dir)):
+            session_dir = "/tmp/ray_tpu/attached"
+        os.makedirs(session_dir, exist_ok=True)
+        if not (store_root and os.path.isdir(store_root)):
             store_root = os.path.join(session_dir, "driver_store")
 
     CoreWorker(

@@ -634,13 +634,27 @@ class Raylet:
         self.local_objects[oid] = {"size": size, "pinned": True, "spilled": None}
         self.store_used += size
         await self._wake_object_waiters(oid)
+        # Location registration + spill check ride a background task: the
+        # putting worker shouldn't pay a GCS round trip per large put
+        # (remote pulls retry until the directory catches up anyway).
         if self.gcs is not None:
-            try:
-                await self.gcs.call("add_object_location", {
-                    "object_id": oid, "node_id": self.node_id.binary()})
-            except Exception:
-                pass
-        await self._maybe_spill()
+            async def _register():
+                try:
+                    await self.gcs.call("add_object_location", {
+                        "object_id": oid, "node_id": self.node_id.binary()})
+                except Exception:
+                    pass
+                try:
+                    await self._maybe_spill()
+                except Exception:
+                    # Spill failures (disk full, perms) must be visible,
+                    # not an unretrieved-task exception; the next seal
+                    # retries.
+                    logger.exception("object spill failed")
+
+            asyncio.create_task(_register())
+        else:
+            await self._maybe_spill()
         return True
 
     async def _wake_object_waiters(self, oid: bytes):
@@ -833,6 +847,9 @@ class Raylet:
             "num_workers": len(self.workers),
             "store_used": self.store_used,
             "num_local_objects": len(self.local_objects),
+            # Same-host drivers attach to this store directly (zero-copy).
+            "session_dir": self.session_dir,
+            "store_root": self.store_root,
         }
 
     # ------------------------------------------------------------------

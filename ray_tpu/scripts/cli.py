@@ -1,0 +1,256 @@
+"""ray-tpu CLI — out-of-process cluster lifecycle (reference:
+python/ray/scripts/scripts.py — `ray start` :439, `ray stop` :582,
+`ray status` :1412, `ray memory` :1389, `ray microbenchmark` :1346).
+
+Two-shell flow:
+    shell A:  ray-tpu start --head
+    shell B:  RAY_TPU_ADDRESS=<printed addr> python my_driver.py
+              (driver calls ray_tpu.init(address="auto"))
+    shell A:  ray-tpu stop
+
+Cluster bookkeeping lives in <tmpdir>/cluster.json so stop/status/memory
+find the processes without arguments."""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import signal
+import sys
+import time
+
+
+def _tmpdir() -> str:
+    return os.environ.get("RAY_TPU_TMPDIR", "/tmp/ray_tpu")
+
+
+def _cluster_file() -> str:
+    return os.path.join(_tmpdir(), "cluster.json")
+
+
+def _load_cluster() -> dict | None:
+    try:
+        with open(_cluster_file()) as f:
+            return json.load(f)
+    except (FileNotFoundError, json.JSONDecodeError):
+        return None
+
+
+def _save_cluster(rec: dict):
+    os.makedirs(_tmpdir(), exist_ok=True)
+    tmp = _cluster_file() + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(rec, f, indent=1)
+    os.rename(tmp, _cluster_file())
+
+
+def _rpc_call(address: str, method: str, data=None):
+    from ray_tpu._private import rpc
+
+    async def _go():
+        conn = await rpc.connect(address, name="cli", timeout=5)
+        try:
+            return await conn.call(method, data or {}, timeout=10)
+        finally:
+            await conn.close()
+
+    return asyncio.run(_go())
+
+
+# ---------------------------------------------------------------------------
+# start / stop
+# ---------------------------------------------------------------------------
+
+def cmd_start(args) -> int:
+    from ray_tpu._private.config import Config, set_config
+    from ray_tpu._private.node import new_session_dir, start_gcs, start_raylet
+
+    config = Config.load(json.loads(args.system_config)
+                         if args.system_config else None)
+    set_config(config)
+    pids: list[int] = []
+
+    if args.head:
+        session_dir = new_session_dir()
+        gcs_svc, gcs_address = start_gcs(session_dir, config,
+                                         port=args.port or config.gcs_port)
+        pids.append(gcs_svc.proc.pid)
+    else:
+        if not args.address:
+            print("error: worker nodes need --address <gcs host:port>",
+                  file=sys.stderr)
+            return 2
+        gcs_address = args.address
+        rec = _load_cluster()
+        session_dir = (rec or {}).get("session_dir") or new_session_dir()
+
+    raylet_svc, raylet_addr, node_id, _store = start_raylet(
+        session_dir, gcs_address, config,
+        num_cpus=args.num_cpus, num_tpus=args.num_tpus or 0,
+        resources=json.loads(args.resources) if args.resources else None,
+        is_head=args.head)
+    pids.append(raylet_svc.proc.pid)
+
+    rec = _load_cluster() if not args.head else None
+    if rec is None:
+        rec = {"gcs_address": gcs_address, "session_dir": session_dir,
+               "pids": []}
+    rec["pids"].extend(pids)
+    _save_cluster(rec)
+
+    role = "head" if args.head else "worker node"
+    print(f"started {role}: node {node_id.hex()[:8]} raylet {raylet_addr}")
+    print(f"GCS address: {gcs_address}")
+    print(f"session dir: {session_dir}")
+    print()
+    print("connect a driver with:")
+    print(f"    export RAY_TPU_ADDRESS={gcs_address}")
+    print("    python -c 'import ray_tpu; ray_tpu.init(address=\"auto\")'")
+    return 0
+
+
+def cmd_stop(args) -> int:
+    rec = _load_cluster()
+    if rec is None:
+        print("no cluster record found; nothing to stop")
+        return 0
+    killed = 0
+    for pid in rec.get("pids", []):
+        try:
+            os.killpg(os.getpgid(pid), signal.SIGTERM)
+            killed += 1
+        except (ProcessLookupError, PermissionError):
+            try:
+                os.kill(pid, signal.SIGTERM)
+                killed += 1
+            except (ProcessLookupError, PermissionError):
+                pass
+    time.sleep(0.5)
+    for pid in rec.get("pids", []):
+        try:
+            os.killpg(os.getpgid(pid), signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+    try:
+        os.unlink(_cluster_file())
+    except FileNotFoundError:
+        pass
+    print(f"stopped {killed} process group(s)")
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# status / memory
+# ---------------------------------------------------------------------------
+
+def _gcs_address(args) -> str | None:
+    if getattr(args, "address", None):
+        return args.address
+    if os.environ.get("RAY_TPU_ADDRESS"):
+        return os.environ["RAY_TPU_ADDRESS"]
+    rec = _load_cluster()
+    return rec["gcs_address"] if rec else None
+
+
+def _fmt_resources(raw: dict) -> str:
+    from ray_tpu._private.common import ResourceSet
+
+    d = ResourceSet.from_raw(raw).to_dict()
+    return ", ".join(f"{k}={v:g}" for k, v in sorted(d.items()))
+
+
+def cmd_status(args) -> int:
+    """reference: scripts.py:1412 `ray status` — node table + resources."""
+    addr = _gcs_address(args)
+    if not addr:
+        print("no cluster found (no --address, RAY_TPU_ADDRESS, or record)",
+              file=sys.stderr)
+        return 1
+    nodes = _rpc_call(addr, "get_all_nodes")
+    avail = _rpc_call(addr, "get_available_resources")
+    print(f"cluster at {addr}: {len(nodes)} node(s)")
+    for n in nodes:
+        a = avail.get(n["node_id"], {})
+        head = " (head)" if n.get("is_head") else ""
+        print(f"  node {n['node_id'].hex()[:8]}{head} @ {n['address']} "
+              f"[{n.get('hostname', '')}]")
+        print(f"    total:     {_fmt_resources(n['resources'])}")
+        print(f"    available: {_fmt_resources(a) if a else '(no heartbeat)'}")
+    return 0
+
+
+def cmd_memory(args) -> int:
+    """reference: scripts.py:1389 `ray memory` — object store usage."""
+    addr = _gcs_address(args)
+    if not addr:
+        print("no cluster found", file=sys.stderr)
+        return 1
+    nodes = _rpc_call(addr, "get_all_nodes")
+    total_used = total_objects = 0
+    for n in nodes:
+        try:
+            info = _rpc_call(n["address"], "cluster_info")
+        except Exception as e:
+            print(f"  node {n['node_id'].hex()[:8]}: unreachable ({e})")
+            continue
+        used = info["store_used"]
+        cnt = info["num_local_objects"]
+        total_used += used
+        total_objects += cnt
+        print(f"  node {n['node_id'].hex()[:8]} @ {n['address']}: "
+              f"{cnt} object(s), {used / 1e6:.1f} MB in store, "
+              f"{info['num_workers']} worker(s)")
+    print(f"total: {total_objects} object(s), {total_used / 1e6:.1f} MB")
+    return 0
+
+
+def cmd_microbenchmark(args) -> int:
+    from ray_tpu import microbenchmark
+
+    out = microbenchmark.main()
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(out, f, indent=1)
+    return 0
+
+
+# ---------------------------------------------------------------------------
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="ray-tpu", description="ray_tpu cluster CLI")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("start", help="start a head or worker node")
+    p.add_argument("--head", action="store_true")
+    p.add_argument("--address", help="GCS address to join (worker nodes)")
+    p.add_argument("--port", type=int, default=0, help="GCS port (head)")
+    p.add_argument("--num-cpus", type=float, default=None)
+    p.add_argument("--num-tpus", type=float, default=None)
+    p.add_argument("--resources", help="JSON dict of custom resources")
+    p.add_argument("--system-config", help="JSON dict of config overrides")
+    p.set_defaults(fn=cmd_start)
+
+    p = sub.add_parser("stop", help="stop the recorded cluster")
+    p.set_defaults(fn=cmd_stop)
+
+    p = sub.add_parser("status", help="node table + resources")
+    p.add_argument("--address", default=None)
+    p.set_defaults(fn=cmd_status)
+
+    p = sub.add_parser("memory", help="object-store usage per node")
+    p.add_argument("--address", default=None)
+    p.set_defaults(fn=cmd_memory)
+
+    p = sub.add_parser("microbenchmark", help="run the core benchmark suite")
+    p.add_argument("--out", default=None)
+    p.set_defaults(fn=cmd_microbenchmark)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

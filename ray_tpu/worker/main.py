@@ -41,6 +41,11 @@ def main():
         store_root=args.store_root,
         config=get_config(),
     )
+    # print()/stderr from task code streams to the driver console
+    # (reference: log_monitor.py:48 republishing).
+    from ray_tpu._private.log_utils import install_stdout_forwarder
+
+    install_stdout_forwarder(cw)
     logging.getLogger("ray_tpu.worker").info(
         "worker %s registered with raylet %s",
         cw.worker_id.hex()[:8], args.raylet_address)

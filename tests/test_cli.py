@@ -1,0 +1,86 @@
+"""CLI + log plumbing (reference: python/ray/scripts/scripts.py `ray
+start`/`status`/`memory`/`stop`; log streaming: log_monitor.py:48)."""
+
+import os
+import re
+import subprocess
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _cli(args, env, timeout=60):
+    return subprocess.run(
+        [sys.executable, "-m", "ray_tpu.scripts.cli", *args],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=timeout)
+
+
+def test_cli_start_status_memory_stop(tmp_path):
+    env = dict(os.environ)
+    env["RAY_TPU_TMPDIR"] = str(tmp_path)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+
+    out = _cli(["start", "--head", "--num-cpus", "2"], env)
+    assert out.returncode == 0, out.stderr
+    m = re.search(r"GCS address: (\S+)", out.stdout)
+    assert m, out.stdout
+    gcs_address = m.group(1)
+
+    try:
+        # The two-shell flow: a separate driver process connects by
+        # address and runs work on the CLI-started cluster.
+        driver = subprocess.run(
+            [sys.executable, "-c", f"""
+import ray_tpu
+ray_tpu.init(address={gcs_address!r})
+
+@ray_tpu.remote
+def f(x):
+    return x * 2
+
+assert ray_tpu.get(f.remote(21)) == 42
+print("DRIVER_OK")
+"""],
+            env=env, cwd=REPO, capture_output=True, text=True, timeout=120)
+        assert "DRIVER_OK" in driver.stdout, (
+            driver.stdout[-1500:] + driver.stderr[-1500:])
+
+        out = _cli(["status"], env)
+        assert out.returncode == 0, out.stderr
+        assert "1 node(s)" in out.stdout and "(head)" in out.stdout
+
+        out = _cli(["memory"], env)
+        assert out.returncode == 0, out.stderr
+        assert "worker(s)" in out.stdout
+    finally:
+        out = _cli(["stop"], env)
+    assert out.returncode == 0
+    assert not os.path.exists(tmp_path / "cluster.json")
+
+    # The cluster must actually be gone: a status probe now fails.
+    out = _cli(["status", "--address", gcs_address], env, timeout=30)
+    assert out.returncode != 0
+
+
+def test_worker_prints_stream_to_driver(ray_start_regular, capfd):
+    @ray_tpu.remote
+    def chatty():
+        print("MARKER_FROM_WORKER_7c3")
+        return 1
+
+    assert ray_tpu.get(chatty.remote(), timeout=60) == 1
+    deadline = time.monotonic() + 10
+    seen = ""
+    while time.monotonic() < deadline:
+        seen += capfd.readouterr().err
+        if "MARKER_FROM_WORKER_7c3" in seen:
+            break
+        time.sleep(0.2)
+    assert "MARKER_FROM_WORKER_7c3" in seen
+    assert "(pid=" in seen
