@@ -12,6 +12,7 @@ from ray_tpu.actor import ActorClass, ActorHandle, exit_actor
 from ray_tpu.api import (
     available_resources,
     cancel,
+    cluster_metrics,
     cluster_resources,
     get,
     get_actor,
@@ -22,6 +23,7 @@ from ray_tpu.api import (
     put,
     remote,
     shutdown,
+    timeline,
     wait,
 )
 from ray_tpu.object_ref import ObjectRef
@@ -33,6 +35,7 @@ __all__ = [
     "__version__",
     "available_resources",
     "cancel",
+    "cluster_metrics",
     "cluster_resources",
     "exceptions",
     "exit_actor",
@@ -45,5 +48,6 @@ __all__ = [
     "put",
     "remote",
     "shutdown",
+    "timeline",
     "wait",
 ]

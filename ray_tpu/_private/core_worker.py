@@ -140,6 +140,13 @@ class CoreWorker:
         self._shutdown = False
         self._exiting = False
 
+        # profiling (reference: core_worker profiling.h:28 — spans batched
+        # to the GCS profile table; api.timeline() renders them)
+        from ray_tpu._private.profiling import ProfileBuffer
+
+        self._profile = ProfileBuffer(component_type=mode)
+        self._last_profile_flush = 0.0
+
         # connections
         self.raylet: rpc.Connection | None = None
         self.gcs: rpc.Connection | None = None
@@ -150,6 +157,7 @@ class CoreWorker:
         self._connect(raylet_address, gcs_address)
         serialization.set_context(None, None)
         global_state.set_core_worker(self)
+        self._io.submit(self._profile_flush_loop())
 
     # ------------------------------------------------------------------
     # bootstrap
@@ -945,6 +953,61 @@ class CoreWorker:
         client.subscribed = True
         await self.gcs.call("subscribe", {"channel": f"actor:{actor_id.hex()}"})
 
+    async def _flush_profile_now(self, force: bool = False):
+        # Rate-limited: thousands of tiny tasks/s must not turn into
+        # thousands of GCS notifies/s (the 2s loop catches the rest).
+        now = time.monotonic()
+        if not force and now - self._last_profile_flush < 0.25:
+            return
+        self._last_profile_flush = now
+        events = self._profile.drain()
+        if not events or self.gcs is None:
+            return
+        try:
+            await self.gcs.notify("add_profile_events", {
+                "component_type": self._profile.component_type,
+                "component_id": self._profile.component_id,
+                "node_id": (self.node_id.binary()
+                            if self.node_id else None),
+                "events": events,
+            })
+        except Exception:
+            pass
+
+    async def _profile_flush_loop(self):
+        """Batch-push recorded spans to the GCS profile table (reference:
+        profiling.h Profiler flush thread). The periodic tick is the
+        fallback; task completion schedules an immediate flush so
+        timeline() right after a run sees the tail."""
+        while not self._shutdown:
+            await asyncio.sleep(2.0)
+            await self._flush_profile_now(force=True)
+
+    def get_profile_events(self) -> list[dict]:
+        """All profile batches recorded cluster-wide (driver surface)."""
+        return self._io.run(self.gcs.call("get_profile_events", {}))
+
+    def get_cluster_metrics(self) -> dict:
+        """GCS + per-raylet metric snapshots, merged."""
+        out = {"gcs": self._io.run(self.gcs.call("get_metrics", {}))}
+
+        async def _node_metrics():
+            nodes = await self.gcs.call("get_all_nodes", {})
+
+            async def one(n):
+                try:
+                    conn = await self._peer(n["address"])
+                    return n["node_id"].hex()[:8], await conn.call(
+                        "get_metrics", {})
+                except Exception:
+                    return None
+
+            got = await asyncio.gather(*(one(n) for n in nodes))
+            return dict(p for p in got if p is not None)
+
+        out["raylets"] = self._io.run(_node_metrics())
+        return out
+
     def publish_log(self, line: str, stream: str):
         """Worker-side: forward one output line to subscribed drivers
         (reference: log_monitor.py:48 republishing, worker stdout/stderr
@@ -1267,6 +1330,12 @@ class CoreWorker:
             _ASYNC_TASK_ID.reset(token)
 
     def _execute_task(self, spec) -> dict:
+        with self._profile.profile("task", {"name": spec.get("name", "?")}):
+            reply = self._execute_task_inner(spec)
+        self._io.submit(self._flush_profile_now())
+        return reply
+
+    def _execute_task_inner(self, spec) -> dict:
         task_id = TaskID(spec["task_id"])
         self._task_ctx.task_id = task_id
         # Sticky (not reset in finally): output from background threads the

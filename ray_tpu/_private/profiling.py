@@ -1,0 +1,78 @@
+"""Task/event profiling -> cluster timeline (reference:
+src/ray/core_worker/profiling.h:28 ProfileEvent batches pushed to the GCS
+profile table; python/ray/state.py:946 timeline() chrome-trace export).
+
+Workers record spans into a bounded local buffer; the core worker flushes
+batches to the GCS, and `ray_tpu.timeline()` renders everything as a
+chrome://tracing / Perfetto JSON document."""
+
+from __future__ import annotations
+
+import collections
+import os
+import threading
+import time
+
+
+class ProfileBuffer:
+    def __init__(self, component_type: str, maxlen: int = 20_000):
+        self.component_type = component_type
+        self.component_id = os.getpid()
+        self._events: collections.deque = collections.deque(maxlen=maxlen)
+        self._lock = threading.Lock()
+
+    def record(self, event_type: str, start: float, end: float,
+               extra: dict | None = None):
+        with self._lock:
+            self._events.append({
+                "event_type": event_type,
+                "start_time": start,
+                "end_time": end,
+                "extra_data": extra or {},
+            })
+
+    def drain(self) -> list[dict]:
+        with self._lock:
+            out = list(self._events)
+            self._events.clear()
+        return out
+
+    def profile(self, event_type: str, extra: dict | None = None):
+        return _Span(self, event_type, extra)
+
+
+class _Span:
+    def __init__(self, buf: ProfileBuffer, event_type: str, extra):
+        self._buf = buf
+        self._event_type = event_type
+        self._extra = extra
+
+    def __enter__(self):
+        self._start = time.time()
+        return self
+
+    def __exit__(self, *exc):
+        self._buf.record(self._event_type, self._start, time.time(),
+                         self._extra)
+        return False
+
+
+def to_chrome_trace(events: list[dict]) -> list[dict]:
+    """GCS profile-table rows -> chrome-trace 'X' (complete) events
+    (reference: state.py:946 timeline)."""
+    trace = []
+    for batch in events:
+        pid = f"{batch['component_type']} {batch.get('node_id', b'').hex()[:8] if isinstance(batch.get('node_id'), bytes) else ''}".strip()
+        for ev in batch["events"]:
+            trace.append({
+                "cat": ev["event_type"],
+                "name": ev.get("extra_data", {}).get(
+                    "name", ev["event_type"]),
+                "ph": "X",
+                "ts": ev["start_time"] * 1e6,
+                "dur": (ev["end_time"] - ev["start_time"]) * 1e6,
+                "pid": pid,
+                "tid": batch["component_id"],
+                "args": ev.get("extra_data", {}),
+            })
+    return trace

@@ -1,0 +1,102 @@
+"""Process-local metrics: counters, gauges, histograms (reference:
+src/ray/stats/metric.h Count/Gauge/Histogram + metric_defs.cc).
+
+Each runtime process (gcs, raylet, worker, driver) keeps one registry;
+raylets and the GCS expose theirs over RPC ("get_metrics"), aggregated by
+`ray-tpu metrics` / api.cluster_metrics(). No external metrics daemon: the
+control-plane RPC layer is the export path (the reference pushes to
+OpenCensus/Prometheus exporters instead)."""
+
+from __future__ import annotations
+
+import threading
+from bisect import bisect_right
+
+
+class Metric:
+    def __init__(self, name: str, description: str = ""):
+        self.name = name
+        self.description = description
+        _REGISTRY.register(self)
+
+
+class Count(Metric):
+    """Monotonic counter (reference: metric.h Count)."""
+
+    def __init__(self, name: str, description: str = ""):
+        self._value = 0.0
+        self._lock = threading.Lock()
+        super().__init__(name, description)
+
+    def inc(self, by: float = 1.0):
+        with self._lock:
+            self._value += by
+
+    def snapshot(self):
+        return {"type": "count", "value": self._value}
+
+
+class Gauge(Metric):
+    """Last-set value (reference: metric.h Gauge)."""
+
+    def __init__(self, name: str, description: str = ""):
+        self._value = 0.0
+        super().__init__(name, description)
+
+    def set(self, value: float):
+        self._value = float(value)
+
+    def snapshot(self):
+        return {"type": "gauge", "value": self._value}
+
+
+class Histogram(Metric):
+    """Fixed-boundary histogram (reference: metric.h Histogram)."""
+
+    def __init__(self, name: str, boundaries: list[float],
+                 description: str = ""):
+        self.boundaries = sorted(boundaries)
+        self._counts = [0] * (len(self.boundaries) + 1)
+        self._sum = 0.0
+        self._n = 0
+        self._lock = threading.Lock()
+        super().__init__(name, description)
+
+    def observe(self, value: float):
+        with self._lock:
+            self._counts[bisect_right(self.boundaries, value)] += 1
+            self._sum += value
+            self._n += 1
+
+    def snapshot(self):
+        return {"type": "histogram", "boundaries": self.boundaries,
+                "counts": list(self._counts), "sum": self._sum,
+                "count": self._n}
+
+
+class Registry:
+    def __init__(self):
+        self._metrics: dict[str, Metric] = {}
+        self._lock = threading.Lock()
+
+    def register(self, metric: Metric):
+        with self._lock:
+            self._metrics[metric.name] = metric
+
+    def get(self, name: str) -> Metric | None:
+        return self._metrics.get(name)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {name: m.snapshot() for name, m in self._metrics.items()}
+
+
+_REGISTRY = Registry()
+
+
+def registry() -> Registry:
+    return _REGISTRY
+
+
+def snapshot() -> dict:
+    return _REGISTRY.snapshot()

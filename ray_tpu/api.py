@@ -151,6 +151,30 @@ def shutdown():
         _global_node = None
 
 
+def timeline(filename: str | None = None) -> list[dict]:
+    """Chrome-trace timeline of recorded cluster profile events
+    (reference: python/ray/state.py:946 timeline(); load the output in
+    chrome://tracing or Perfetto). Spans are flushed from workers within
+    ~2s of recording (sooner after task completion) — a timeline taken
+    immediately after a very short run may lag a moment behind."""
+    import json
+
+    from ray_tpu._private.profiling import to_chrome_trace
+
+    cw = global_state.require_core_worker()
+    trace = to_chrome_trace(cw.get_profile_events())
+    if filename:
+        with open(filename, "w") as f:
+            json.dump(trace, f)
+    return trace
+
+
+def cluster_metrics() -> dict:
+    """Metric snapshots from the GCS and every raylet (reference:
+    src/ray/stats/metric.h export surface)."""
+    return global_state.require_core_worker().get_cluster_metrics()
+
+
 def remote(*args, **kwargs):
     """@remote decorator for functions and classes, with or without options:
 

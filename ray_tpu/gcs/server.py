@@ -64,6 +64,12 @@ class GcsServer:
         self.server = rpc.Server(self._handlers(), on_disconnect=self._on_disconnect,
                                  name="gcs")
         self._pending_actor_queue: list[bytes] = []
+        # Profile-event table (reference: the GCS profile table fed by
+        # core_worker profiling.h batches), bounded ring.
+        import collections as _collections
+
+        self.profile_events: _collections.deque = _collections.deque(
+            maxlen=200_000)
         if storage is not None:
             self._restore()
 
@@ -150,6 +156,9 @@ class GcsServer:
             "get_placement_group": self.h_get_placement_group,
             "get_named_placement_group": self.h_get_named_placement_group,
             "list_placement_groups": self.h_list_placement_groups,
+            "add_profile_events": self.h_add_profile_events,
+            "get_profile_events": self.h_get_profile_events,
+            "get_metrics": self.h_get_metrics,
             "ping": lambda conn, data: "pong",
         }
 
@@ -499,6 +508,33 @@ class GcsServer:
         for actor_id in queue:
             if self.actors.get(actor_id, {}).get("state") != DEAD:
                 await self._schedule_actor(actor_id)
+
+    # ---- profiling / metrics ----
+    async def h_add_profile_events(self, conn, d):
+        self.profile_events.append({
+            "component_type": d["component_type"],
+            "component_id": d["component_id"],
+            "node_id": d.get("node_id"),
+            "events": d["events"],
+        })
+        return True
+
+    async def h_get_profile_events(self, conn, d):
+        return list(self.profile_events)
+
+    async def h_get_metrics(self, conn, d):
+        """This process's metric registry + computed cluster gauges."""
+        from ray_tpu._private import stats
+
+        snap = stats.snapshot()
+        snap["gcs.nodes_alive"] = {"type": "gauge", "value": len(self.nodes)}
+        snap["gcs.actors_alive"] = {
+            "type": "gauge",
+            "value": sum(1 for r in self.actors.values()
+                         if r["state"] == ALIVE)}
+        snap["gcs.placement_groups"] = {
+            "type": "gauge", "value": len(self.placement_groups)}
+        return snap
 
     # ---- object directory ----
     async def h_add_object_location(self, conn, d):

@@ -79,6 +79,18 @@ class Raylet:
         # _pop_worker forever.
         self._starting_procs: list = []  # [(Popen, flavor)]
         self._warned_infeasible: set[tuple] = set()
+
+        # metrics (reference: src/ray/stats/metric_defs.cc raylet set)
+        from ray_tpu._private import stats
+
+        self.m_leases_granted = stats.Count(
+            "raylet.leases_granted_total", "worker leases granted")
+        self.m_spillbacks = stats.Count(
+            "raylet.spillbacks_total", "lease requests redirected away")
+        self.m_workers_started = stats.Count(
+            "raylet.workers_started_total", "worker processes spawned")
+        self.m_objects_pulled = stats.Count(
+            "raylet.objects_pulled_total", "objects pulled from peers")
         self.num_cpus = int(resources.get("CPU", os.cpu_count() or 1))
 
         # scheduling
@@ -121,6 +133,7 @@ class Raylet:
             "free_objects": self.h_free_objects,
             "pin_object": self.h_pin_object,
             "cluster_info": self.h_cluster_info,
+            "get_metrics": self.h_get_metrics,
             "actor_exiting": self.h_actor_exiting,
             # gcs-facing
             "create_actor": self.h_create_actor,
@@ -186,6 +199,7 @@ class Raylet:
         if errf is not subprocess.DEVNULL:
             errf.close()
         self._starting_procs.append((proc, "tpu" if tpu else "cpu"))
+        self.m_workers_started.inc()
         logger.info("started %s worker process pid=%d",
                     "tpu" if tpu else "cpu", proc.pid)
         return proc
@@ -374,23 +388,34 @@ class Raylet:
         view) instead of hoarding the task in the local queue
         (reference: availability-scored hybrid policy,
         cluster_resource_scheduler.cc:217-320)."""
-        import random
-
         if self.gcs is None or len(self.cluster_nodes) <= 1:
             return None
         try:
             avail_by_node = await self.gcs.call("get_available_resources", {})
         except Exception:
             return None
+        avail = {nid: ResourceSet.from_raw(raw)
+                 for nid, raw in avail_by_node.items()}
+        return self._pick_from_availability(spec, avail)
+
+    def _pick_from_availability(self, spec, avail: dict) -> str | None:
+        """Synchronous selection from a fetched availability view (callers
+        holding the view across multiple picks subtract as they assign)."""
+        import random
+
         need = ResourceSet.from_raw(spec["resources"])
         me = self.node_id.binary()
         cands = []
-        for node_id, raw in avail_by_node.items():
+        for node_id, rs in avail.items():
             if node_id == me or node_id not in self.cluster_nodes:
                 continue
-            if need.is_subset_of(ResourceSet.from_raw(raw)):
-                cands.append(self.cluster_nodes[node_id]["address"])
-        return random.choice(cands) if cands else None
+            if need.is_subset_of(rs):
+                cands.append(node_id)
+        if not cands:
+            return None
+        node_id = random.choice(cands)
+        avail[node_id].subtract(need)  # so N picks don't dogpile one slot
+        return self.cluster_nodes[node_id]["address"]
 
     def _warn_infeasible(self, spec):
         shape = tuple(sorted(spec.get("resources", {}).items()))
@@ -437,6 +462,7 @@ class Raylet:
         if not self._feasible_ever(spec):
             addr = self._pick_spillback(spec)
             if addr is not None:
+                self.m_spillbacks.inc()
                 return {"spillback": addr, "hops": hops + 1}
             # Infeasible everywhere: queue until the cluster changes.
             self._warn_infeasible(spec)
@@ -446,6 +472,7 @@ class Raylet:
             # when the whole cluster is saturated).
             addr = await self._pick_spillback_load_aware(spec)
             if addr is not None:
+                self.m_spillbacks.inc()
                 return {"spillback": addr, "hops": hops + 1}
         fut = asyncio.get_running_loop().create_future()
         self.pending_leases.append((spec, fut))
@@ -463,6 +490,7 @@ class Raylet:
             self._release(res, pg_key)
             raise
         self._lease_seq += 1
+        self.m_leases_granted.inc()
         lease_id = self._lease_seq.to_bytes(8, "big")
         worker.lease_id = lease_id
         worker.lease_resources = res
@@ -747,6 +775,7 @@ class Raylet:
             raise
         self.local_objects[oid] = {"size": size, "pinned": False, "spilled": None}
         self.store_used += size
+        self.m_objects_pulled.inc()
         await self._wake_object_waiters(oid)
 
     async def h_object_info(self, conn, d):
@@ -838,6 +867,20 @@ class Raylet:
     # cluster info
     # ------------------------------------------------------------------
 
+    async def h_get_metrics(self, conn, d):
+        from ray_tpu._private import stats
+
+        snap = stats.snapshot()
+        snap["raylet.num_workers"] = {"type": "gauge",
+                                      "value": len(self.workers)}
+        snap["raylet.store_used_bytes"] = {"type": "gauge",
+                                           "value": self.store_used}
+        snap["raylet.local_objects"] = {"type": "gauge",
+                                        "value": len(self.local_objects)}
+        snap["raylet.pending_leases"] = {"type": "gauge",
+                                         "value": len(self.pending_leases)}
+        return snap
+
     async def h_cluster_info(self, conn, d):
         return {
             "node_id": self.node_id.binary(),
@@ -872,6 +915,44 @@ class Raylet:
                 self._reap_starting_workers()
             except Exception:
                 logger.exception("starting-worker reap failed")
+            try:
+                await self._respill_pending()
+            except Exception:
+                logger.exception("pending-lease respill failed")
+
+    async def _respill_pending(self):
+        """Queued leases get re-offered to nodes that NOW have capacity
+        (a node joined or freed up since the lease queued) — without
+        this, work queued before an autoscaled node arrives would wait
+        on the saturated node forever (reference: the periodic
+        ScheduleAndDispatchTasks in cluster_task_manager.cc)."""
+        if not self.pending_leases or len(self.cluster_nodes) <= 1:
+            return
+        if self.gcs is None:
+            return
+        # ONE await up front; the scan below is synchronous, so it cannot
+        # interleave with _dispatch_pending / h_request_worker_lease (both
+        # mutate pending_leases on this loop) and drop their entries.
+        try:
+            raw = await self.gcs.call("get_available_resources", {})
+        except Exception:
+            return
+        avail = {nid: ResourceSet.from_raw(r) for nid, r in raw.items()}
+        still = []
+        for spec, fut in self.pending_leases:
+            if fut.done():
+                continue
+            if (self._bundle_key(spec) is not None
+                    or not self._feasible_ever(spec)):
+                still.append((spec, fut))
+                continue
+            addr = self._pick_from_availability(spec, avail)
+            if addr is not None:
+                self.m_spillbacks.inc()
+                fut.set_result({"spillback": addr, "hops": 1})
+            else:
+                still.append((spec, fut))
+        self.pending_leases = still
 
     async def heartbeat_loop(self):
         while True:

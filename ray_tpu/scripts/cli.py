@@ -206,6 +206,51 @@ def cmd_memory(args) -> int:
     return 0
 
 
+def cmd_metrics(args) -> int:
+    """reference: the `ray status -v` / metrics export surface
+    (src/ray/stats/metric.h)."""
+    addr = _gcs_address(args)
+    if not addr:
+        print("no cluster found", file=sys.stderr)
+        return 1
+
+    def show(title, snap):
+        print(title)
+        for name in sorted(snap):
+            m = snap[name]
+            if m["type"] == "histogram":
+                print(f"  {name}: n={m['count']} sum={m['sum']:.3f}")
+            else:
+                print(f"  {name}: {m['value']:g}")
+
+    show("gcs:", _rpc_call(addr, "get_metrics"))
+    for n in _rpc_call(addr, "get_all_nodes"):
+        try:
+            snap = _rpc_call(n["address"], "get_metrics")
+        except Exception as e:
+            print(f"node {n['node_id'].hex()[:8]}: unreachable ({e})")
+            continue
+        show(f"node {n['node_id'].hex()[:8]}:", snap)
+    return 0
+
+
+def cmd_timeline(args) -> int:
+    """reference: `ray timeline` (scripts.py) — chrome-trace dump."""
+    addr = _gcs_address(args)
+    if not addr:
+        print("no cluster found", file=sys.stderr)
+        return 1
+    from ray_tpu._private.profiling import to_chrome_trace
+
+    trace = to_chrome_trace(_rpc_call(addr, "get_profile_events"))
+    out = args.out or "timeline.json"
+    with open(out, "w") as f:
+        json.dump(trace, f)
+    print(f"wrote {len(trace)} events to {out} "
+          f"(open in chrome://tracing or Perfetto)")
+    return 0
+
+
 def cmd_microbenchmark(args) -> int:
     from ray_tpu import microbenchmark
 
@@ -243,6 +288,15 @@ def main(argv=None) -> int:
     p = sub.add_parser("memory", help="object-store usage per node")
     p.add_argument("--address", default=None)
     p.set_defaults(fn=cmd_memory)
+
+    p = sub.add_parser("metrics", help="metric snapshots from gcs + raylets")
+    p.add_argument("--address", default=None)
+    p.set_defaults(fn=cmd_metrics)
+
+    p = sub.add_parser("timeline", help="dump chrome-trace profile timeline")
+    p.add_argument("--address", default=None)
+    p.add_argument("--out", default=None)
+    p.set_defaults(fn=cmd_timeline)
 
     p = sub.add_parser("microbenchmark", help="run the core benchmark suite")
     p.add_argument("--out", default=None)
