@@ -11,12 +11,20 @@ from ray_tpu.tune.sample import (
     sample_from,
     uniform,
 )
+from ray_tpu.tune.logger import CSVLogger, JSONLogger, UnifiedLogger
+from ray_tpu.tune.placement_groups import PlacementGroupFactory
+from ray_tpu.tune.progress_reporter import CLIReporter
 from ray_tpu.tune.trainable import Trainable, report
 from ray_tpu.tune.tune import ExperimentAnalysis, run
 
 __all__ = [
+    "CLIReporter",
+    "CSVLogger",
     "ExperimentAnalysis",
+    "JSONLogger",
+    "PlacementGroupFactory",
     "Trainable",
+    "UnifiedLogger",
     "choice",
     "grid_search",
     "loguniform",

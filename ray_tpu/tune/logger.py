@@ -1,0 +1,87 @@
+"""Trial result loggers (reference: python/ray/tune/logger.py CSVLogger,
+JsonLogger, UnifiedLogger): every reported result lands in the trial's
+directory under local_dir as progress.csv + result.json lines, plus
+params.json once."""
+
+from __future__ import annotations
+
+import csv
+import json
+import os
+
+
+class Logger:
+    def __init__(self, trial_dir: str, config: dict):
+        self.trial_dir = trial_dir
+        self.config = config
+        os.makedirs(trial_dir, exist_ok=True)
+
+    def on_result(self, result: dict):
+        raise NotImplementedError
+
+    def close(self):
+        pass
+
+
+def _scalars(result: dict) -> dict:
+    return {k: v for k, v in result.items()
+            if isinstance(v, (int, float, str, bool)) or v is None}
+
+
+class CSVLogger(Logger):
+    """reference: logger.py CSVLogger — progress.csv, header from the
+    first result."""
+
+    def __init__(self, trial_dir: str, config: dict):
+        super().__init__(trial_dir, config)
+        self._file = open(os.path.join(trial_dir, "progress.csv"), "w",
+                          newline="")
+        self._writer = None
+
+    def on_result(self, result: dict):
+        row = _scalars(result)
+        if self._writer is None:
+            self._writer = csv.DictWriter(self._file,
+                                          fieldnames=sorted(row))
+            self._writer.writeheader()
+        self._writer.writerow({k: row.get(k) for k in self._writer.fieldnames})
+        self._file.flush()
+
+    def close(self):
+        self._file.close()
+
+
+class JSONLogger(Logger):
+    """reference: logger.py JsonLogger — result.json (one JSON per line)
+    + params.json."""
+
+    def __init__(self, trial_dir: str, config: dict):
+        super().__init__(trial_dir, config)
+        with open(os.path.join(trial_dir, "params.json"), "w") as f:
+            json.dump(_scalars(config), f)
+        self._file = open(os.path.join(trial_dir, "result.json"), "w")
+
+    def on_result(self, result: dict):
+        self._file.write(json.dumps(_scalars(result)) + "\n")
+        self._file.flush()
+
+    def close(self):
+        self._file.close()
+
+
+DEFAULT_LOGGERS = (CSVLogger, JSONLogger)
+
+
+class UnifiedLogger(Logger):
+    def __init__(self, trial_dir: str, config: dict,
+                 loggers=DEFAULT_LOGGERS):
+        super().__init__(trial_dir, config)
+        self._loggers = [cls(trial_dir, config) for cls in loggers]
+
+    def on_result(self, result: dict):
+        for lg in self._loggers:
+            lg.on_result(result)
+
+    def close(self):
+        for lg in self._loggers:
+            lg.close()

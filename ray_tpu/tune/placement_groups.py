@@ -1,0 +1,35 @@
+"""PG-backed trial resources (reference: python/ray/tune/utils/
+placement_groups.py PlacementGroupFactory): a trial declares bundles +
+strategy; the runner reserves a placement group per trial, starts the
+trainable actor inside bundle 0, and returns the group when the trial
+stops."""
+
+from __future__ import annotations
+
+
+class PlacementGroupFactory:
+    def __init__(self, bundles: list[dict], strategy: str = "PACK"):
+        if not bundles:
+            raise ValueError("need at least one bundle")
+        self.bundles = [dict(b) for b in bundles]
+        self.strategy = strategy
+
+    @property
+    def head_bundle(self) -> dict:
+        return dict(self.bundles[0])
+
+    def create(self, timeout: float = 60.0):
+        from ray_tpu.util.placement_group import placement_group
+
+        pg = placement_group(self.bundles, strategy=self.strategy)
+        if not pg.wait(timeout):
+            from ray_tpu.util.placement_group import remove_placement_group
+
+            remove_placement_group(pg)
+            raise TimeoutError(
+                f"placement group {self.bundles} not ready in {timeout}s")
+        return pg
+
+    def __repr__(self):
+        return (f"PlacementGroupFactory({self.bundles}, "
+                f"strategy={self.strategy!r})")

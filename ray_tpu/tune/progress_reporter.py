@@ -1,0 +1,42 @@
+"""CLIReporter — periodic trial table on the console (reference:
+python/ray/tune/progress_reporter.py CLIReporter)."""
+
+from __future__ import annotations
+
+import sys
+import time
+
+
+class CLIReporter:
+    def __init__(self, metric_columns: list[str] | None = None,
+                 max_report_frequency: float = 5.0, out=None):
+        self.metric_columns = metric_columns or []
+        self._freq = max_report_frequency
+        self._last = 0.0
+        self._out = out or sys.stderr
+
+    def should_report(self, done: bool = False) -> bool:
+        if done or time.monotonic() - self._last >= self._freq:
+            self._last = time.monotonic()
+            return True
+        return False
+
+    def report(self, trials, done: bool = False):
+        counts: dict[str, int] = {}
+        for t in trials:
+            counts[t.status] = counts.get(t.status, 0) + 1
+        summary = ", ".join(f"{k}:{v}" for k, v in sorted(counts.items()))
+        print(f"== tune status: {len(trials)} trials ({summary})",
+              file=self._out)
+        cols = ["trial", "status", "iter"] + self.metric_columns
+        print("  " + "  ".join(f"{c:>14}" for c in cols), file=self._out)
+        for t in trials[:20]:
+            vals = [t.trial_id[-8:], t.status, str(t.iteration)]
+            vals += [f"{t.last_result.get(m, ''):.4g}"
+                     if isinstance(t.last_result.get(m), (int, float))
+                     else str(t.last_result.get(m, ""))
+                     for m in self.metric_columns]
+            print("  " + "  ".join(f"{v:>14}" for v in vals),
+                  file=self._out)
+        if len(trials) > 20:
+            print(f"  ... and {len(trials) - 20} more", file=self._out)

@@ -1,7 +1,9 @@
 """Trial schedulers (reference: python/ray/tune/schedulers/)."""
 
 from ray_tpu.tune.schedulers.asha import ASHAScheduler, AsyncHyperBandScheduler
+from ray_tpu.tune.schedulers.hyperband import HyperBandScheduler
 from ray_tpu.tune.schedulers.median_stopping import MedianStoppingRule
+from ray_tpu.tune.schedulers.pb2 import PB2
 from ray_tpu.tune.schedulers.pbt import PopulationBasedTraining
 from ray_tpu.tune.schedulers.scheduler import FIFOScheduler, TrialScheduler
 
@@ -9,7 +11,9 @@ __all__ = [
     "ASHAScheduler",
     "AsyncHyperBandScheduler",
     "FIFOScheduler",
+    "HyperBandScheduler",
     "MedianStoppingRule",
+    "PB2",
     "PopulationBasedTraining",
     "TrialScheduler",
 ]

@@ -1,0 +1,147 @@
+"""Synchronous HyperBand (reference: python/ray/tune/schedulers/
+hyperband.py HyperBandScheduler; Li et al. 2016).
+
+Trials fill brackets; each bracket successively halves at milestones
+r, r*eta, r*eta^2, ... ≤ max_t: when every live trial in a bracket has
+reached the current milestone (trials PAUSE as they arrive), the bottom
+(1 - 1/eta) are stopped and the top 1/eta resume. Unlike ASHA (asha.py),
+halving is a barrier — no promotion on stale comparisons."""
+
+from __future__ import annotations
+
+import math
+
+from ray_tpu.tune.schedulers.scheduler import TrialScheduler
+
+
+class _Bracket:
+    def __init__(self, initial_t: int, max_t: int, eta: float, size: int):
+        self.milestone = initial_t
+        self.max_t = max_t
+        self.eta = eta
+        self.capacity = size
+        self.trial_ids: list[str] = []
+        self.paused_scores: dict[str, float] = {}
+        self.dropped: set[str] = set()
+
+    @property
+    def full(self) -> bool:
+        return len(self.trial_ids) >= self.capacity
+
+    def live_ids(self) -> set[str]:
+        return set(self.trial_ids) - self.dropped
+
+    def ready_to_halve(self) -> bool:
+        live = self.live_ids()
+        return bool(live) and live <= set(self.paused_scores)
+
+    def halve(self) -> tuple[set[str], set[str]]:
+        """-> (resume_ids, stop_ids); advances the milestone."""
+        live = sorted(self.live_ids(), key=self.paused_scores.get,
+                      reverse=True)
+        keep = max(1, int(len(live) / self.eta))
+        resume, stop = set(live[:keep]), set(live[keep:])
+        self.dropped |= stop
+        self.paused_scores = {}
+        self.milestone = int(self.milestone * self.eta)
+        return resume, stop
+
+
+class HyperBandScheduler(TrialScheduler):
+    def __init__(self, metric: str | None = None, mode: str = "max",
+                 time_attr: str = "training_iteration",
+                 max_t: int = 81, reduction_factor: float = 3.0):
+        self._metric = metric
+        self._mode = mode
+        self._time_attr = time_attr
+        self._max_t = max_t
+        self._eta = reduction_factor
+        self._brackets: list[_Bracket] = []
+        self._trial_bracket: dict[str, _Bracket] = {}
+        self._s_next = self._s_max = int(
+            math.log(max_t) / math.log(reduction_factor))
+        self._resumable: set[str] = set()
+
+    def set_search_properties(self, metric, mode):
+        if self._metric is None:
+            self._metric = metric
+        if mode:
+            self._mode = mode
+        return True
+
+    def _signed(self, result):
+        if self._metric not in result:
+            return None
+        v = float(result[self._metric])
+        return v if self._mode == "max" else -v
+
+    def _new_bracket(self) -> _Bracket:
+        s = self._s_next
+        self._s_next = self._s_next - 1 if self._s_next > 0 else self._s_max
+        n = int(math.ceil((self._s_max + 1) / (s + 1) * self._eta ** s))
+        r = max(1, int(self._max_t * self._eta ** (-s)))
+        return _Bracket(initial_t=r, max_t=self._max_t, eta=self._eta,
+                        size=n)
+
+    def on_trial_add(self, runner, trial):
+        if not self._brackets or self._brackets[-1].full:
+            self._brackets.append(self._new_bracket())
+        bracket = self._brackets[-1]
+        bracket.trial_ids.append(trial.trial_id)
+        self._trial_bracket[trial.trial_id] = bracket
+
+    def on_trial_result(self, runner, trial, result):
+        bracket = self._trial_bracket.get(trial.trial_id)
+        if bracket is None:
+            return self.CONTINUE
+        t = result.get(self._time_attr, 0)
+        if t >= bracket.max_t:
+            return self.STOP
+        if t < bracket.milestone:
+            return self.CONTINUE
+        value = self._signed(result)
+        if value is None:
+            return self.CONTINUE
+        bracket.paused_scores[trial.trial_id] = value
+        if bracket.ready_to_halve():
+            resume, stop = bracket.halve()
+            resume.discard(trial.trial_id)  # this one continues inline
+            self._resumable |= resume
+            for other in runner.trials:
+                if other.trial_id in stop and other.status in (
+                        "RUNNING", "PAUSED", "PENDING"):
+                    if other is not trial:
+                        runner._stop_trial(other, "TERMINATED")
+            if trial.trial_id in stop:
+                return self.STOP
+            return self.CONTINUE
+        return self.PAUSE
+
+    def on_trial_complete(self, runner, trial, result):
+        self._cleanup(trial)
+
+    def on_trial_error(self, runner, trial):
+        # A dead trial must not block its bracket's barrier forever.
+        bracket = self._trial_bracket.get(trial.trial_id)
+        if bracket is not None:
+            bracket.dropped.add(trial.trial_id)
+        self._cleanup(trial)
+
+    def _cleanup(self, trial):
+        bracket = self._trial_bracket.pop(trial.trial_id, None)
+        if bracket is not None:
+            bracket.paused_scores.pop(trial.trial_id, None)
+            bracket.dropped.add(trial.trial_id)
+        self._resumable.discard(trial.trial_id)
+
+    def choose_trial_to_run(self, runner):
+        from ray_tpu.tune.trial import PAUSED, PENDING
+
+        for trial in runner.trials:
+            if trial.status == PAUSED and trial.trial_id in self._resumable:
+                self._resumable.discard(trial.trial_id)
+                return trial
+        for trial in runner.trials:
+            if trial.status == PENDING:
+                return trial
+        return None

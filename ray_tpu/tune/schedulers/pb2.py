@@ -1,0 +1,124 @@
+"""PB2 — Population Based Bandits (reference: python/ray/tune/schedulers/
+pb2.py; Parker-Holder et al. 2020).
+
+PBT's exploit step, but explore selects new hyperparameters by a
+GP-bandit: fit a Gaussian process on (hyperparams, time) -> score-change
+history and pick the UCB-maximizing point inside the search bounds.
+The reference leans on GPy; here the GP is ~40 lines of numpy (RBF
+kernel, jittered Cholesky), which is all PB2 needs."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ray_tpu.tune.schedulers.pbt import PopulationBasedTraining
+
+
+class _GP:
+    """RBF-kernel GP regression with fixed hyperparameters."""
+
+    def __init__(self, lengthscale: float = 0.3, signal: float = 1.0,
+                 noise: float = 1e-2):
+        self.ls = lengthscale
+        self.sig = signal
+        self.noise = noise
+
+    def _k(self, a: np.ndarray, b: np.ndarray) -> np.ndarray:
+        d2 = ((a[:, None, :] - b[None, :, :]) ** 2).sum(-1)
+        return self.sig * np.exp(-0.5 * d2 / self.ls ** 2)
+
+    def fit(self, x: np.ndarray, y: np.ndarray):
+        self.x = x
+        k = self._k(x, x) + self.noise * np.eye(len(x))
+        self.l_chol = np.linalg.cholesky(k)
+        self.alpha = np.linalg.solve(
+            self.l_chol.T, np.linalg.solve(self.l_chol, y))
+
+    def predict(self, xq: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        ks = self._k(xq, self.x)
+        mean = ks @ self.alpha
+        v = np.linalg.solve(self.l_chol, ks.T)
+        var = np.clip(self.sig - (v ** 2).sum(0), 1e-8, None)
+        return mean, np.sqrt(var)
+
+
+class PB2(PopulationBasedTraining):
+    """hyperparam_bounds: {key: (low, high)} continuous ranges."""
+
+    def __init__(self, metric: str | None = None, mode: str = "max",
+                 perturbation_interval: int = 5,
+                 hyperparam_bounds: dict | None = None,
+                 quantile_fraction: float = 0.25,
+                 log_scale: bool = True,
+                 seed: int | None = None):
+        if not hyperparam_bounds:
+            raise ValueError("PB2 requires hyperparam_bounds")
+        super().__init__(metric=metric, mode=mode,
+                         perturbation_interval=perturbation_interval,
+                         hyperparam_mutations={},
+                         quantile_fraction=quantile_fraction,
+                         seed=seed)
+        self._bounds = {k: (float(lo), float(hi))
+                        for k, (lo, hi) in hyperparam_bounds.items()}
+        self._log = log_scale
+        self._np_rng = np.random.RandomState(seed)
+        # rows: (normalized hp vector, t, score before), score after
+        self._history: list[tuple[np.ndarray, float, float]] = []
+        self._prev_score: dict[str, tuple[float, dict]] = {}
+
+    # -- data collection -------------------------------------------------
+
+    def _vec(self, config: dict) -> np.ndarray:
+        out = []
+        for k, (lo, hi) in self._bounds.items():
+            v = float(config.get(k, lo))
+            if self._log and lo > 0:
+                out.append((np.log(v) - np.log(lo))
+                           / max(1e-12, np.log(hi) - np.log(lo)))
+            else:
+                out.append((v - lo) / max(1e-12, hi - lo))
+        return np.clip(np.array(out), 0.0, 1.0)
+
+    def _unvec(self, z: np.ndarray) -> dict:
+        out = {}
+        for zi, (k, (lo, hi)) in zip(z, self._bounds.items()):
+            if self._log and lo > 0:
+                out[k] = float(np.exp(
+                    np.log(lo) + zi * (np.log(hi) - np.log(lo))))
+            else:
+                out[k] = float(lo + zi * (hi - lo))
+        return out
+
+    def on_trial_result(self, runner, trial, result):
+        value = self._signed(result)
+        if value is not None:
+            prev = self._prev_score.get(trial.trial_id)
+            if prev is not None:
+                prev_val, prev_cfg = prev
+                self._history.append(
+                    (self._vec(prev_cfg), value - prev_val, 0.0))
+            self._prev_score[trial.trial_id] = (value, dict(trial.config))
+        return super().on_trial_result(runner, trial, result)
+
+    # -- GP-bandit explore (the PB2 difference) --------------------------
+
+    def _explore(self, config: dict) -> dict:
+        new = dict(config)
+        n_dims = len(self._bounds)
+        cands = self._np_rng.random_sample((64, n_dims))
+        if len(self._history) >= 4:
+            x = np.stack([h[0] for h in self._history[-100:]])
+            y = np.array([h[1] for h in self._history[-100:]])
+            std = y.std()
+            y = (y - y.mean()) / (std + 1e-8)
+            gp = _GP()
+            try:
+                gp.fit(x, y)
+                mean, sd = gp.predict(cands)
+                best = cands[int(np.argmax(mean + 1.0 * sd))]  # UCB, k=1
+            except np.linalg.LinAlgError:
+                best = cands[0]
+        else:
+            best = cands[0]
+        new.update(self._unvec(best))
+        return new

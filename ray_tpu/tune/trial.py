@@ -28,6 +28,7 @@ class Trial:
         self.error: str | None = None
         self.actor = None          # handle while RUNNING/PAUSED-with-actor
         self.inflight = None       # pending train.remote() ref
+        self.pg = None             # PlacementGroup when PG-backed
 
     @property
     def iteration(self) -> int:

@@ -55,9 +55,14 @@ class TrialRunner:
                  metric: str | None = None, mode: str = "max",
                  stop: dict | None = None,
                  max_concurrent_trials: int = 0,
-                 resources_per_trial: dict | None = None,
+                 resources_per_trial=None,
                  checkpoint_freq: int = 0,
-                 max_failures: int = 0):
+                 max_failures: int = 0,
+                 local_dir: str | None = None,
+                 loggers=None,
+                 progress_reporter=None):
+        from ray_tpu.tune.placement_groups import PlacementGroupFactory
+
         self._trainable_cls = trainable_cls
         self._pickled_cls = cloudpickle.dumps(trainable_cls)
         self._search = search_alg
@@ -66,13 +71,41 @@ class TrialRunner:
         self._mode = mode
         self._stop = stop or {}
         self._max_concurrent = max_concurrent_trials
-        self._resources = dict(resources_per_trial or {"CPU": 1})
+        # dict resources, or a PlacementGroupFactory (reference:
+        # tune/utils/placement_groups.py) reserving a group per trial.
+        self._pg_factory = (resources_per_trial
+                            if isinstance(resources_per_trial,
+                                          PlacementGroupFactory) else None)
+        if self._pg_factory is not None:
+            self._resources = dict(self._pg_factory.head_bundle)
+        else:
+            self._resources = dict(resources_per_trial or {"CPU": 1})
         self._checkpoint_freq = checkpoint_freq
         self._max_failures = max_failures
         self._failures: dict[str, int] = {}
+        self._local_dir = local_dir
+        self._logger_classes = loggers
+        self._loggers: dict[str, object] = {}
+        self._reporter = progress_reporter
         self.trials: list[Trial] = []
         self._search.set_search_properties(metric, mode, None)
         self._scheduler.set_search_properties(metric, mode)
+
+    def _logger_for(self, trial: Trial):
+        if self._local_dir is None and self._logger_classes is None:
+            return None
+        lg = self._loggers.get(trial.trial_id)
+        if lg is None:
+            import os
+
+            from ray_tpu.tune.logger import DEFAULT_LOGGERS, UnifiedLogger
+
+            base = self._local_dir or "/tmp/ray_tpu_results"
+            lg = UnifiedLogger(
+                os.path.join(base, trial.trial_id), trial.config,
+                loggers=self._logger_classes or DEFAULT_LOGGERS)
+            self._loggers[trial.trial_id] = lg
+        return lg
 
     # -- trial lifecycle -------------------------------------------------
 
@@ -89,7 +122,15 @@ class TrialRunner:
     def _start_trial(self, trial: Trial):
         actor_cls = ray_tpu.remote(resources=dict(self._resources))(
             _TrainableActor)
-        trial.actor = actor_cls.remote(self._pickled_cls, dict(trial.config))
+        if self._pg_factory is not None:
+            trial.pg = self._pg_factory.create()
+            trial.actor = actor_cls.options(
+                placement_group=trial.pg,
+                placement_group_bundle_index=0).remote(
+                self._pickled_cls, dict(trial.config))
+        else:
+            trial.actor = actor_cls.remote(self._pickled_cls,
+                                           dict(trial.config))
         if trial.checkpoint is not None:
             trial.actor.restore.remote(trial.checkpoint)
         trial.status = RUNNING
@@ -104,6 +145,19 @@ class TrialRunner:
             except Exception:
                 pass
             trial.actor = None
+        pg = getattr(trial, "pg", None)
+        if pg is not None:
+            from ray_tpu.util.placement_group import remove_placement_group
+
+            try:
+                remove_placement_group(pg)
+            except Exception:
+                pass
+            trial.pg = None
+        if status in (TERMINATED, ERROR):
+            lg = self._loggers.pop(trial.trial_id, None)
+            if lg is not None:
+                lg.close()
 
     def _pause_trial(self, trial: Trial):
         if trial.last_checkpoint_iter != trial.iteration:
@@ -142,7 +196,25 @@ class TrialRunner:
                 trial = self._next_trial()
                 if trial is None:
                     break
-            self._start_trial(trial)
+            try:
+                self._start_trial(trial)
+            except Exception as e:
+                # e.g. the trial's placement group can't be reserved right
+                # now: count it as a trial failure, keep the experiment
+                # (and its other trials) alive.
+                self._failures[trial.trial_id] = (
+                    self._failures.get(trial.trial_id, 0) + 1)
+                if self._failures[trial.trial_id] > self._max_failures:
+                    trial.error = f"start failed: {e}"
+                    self._stop_trial(trial, ERROR)
+                    self._scheduler.on_trial_error(self, trial)
+                    self._search.on_trial_complete(trial.trial_id, None,
+                                                   error=True)
+                else:
+                    logger.warning("trial %s failed to start (%s); "
+                                   "will retry", trial.trial_id, e)
+                    self._stop_trial(trial, PENDING)
+                break
             slots -= 1
         running = self._running()
         if not running:
@@ -174,6 +246,9 @@ class TrialRunner:
             return
         trial.last_result = result
         trial.results.append(result)
+        lg = self._logger_for(trial)
+        if lg is not None:
+            lg.on_result(result)
         self._search.on_trial_result(trial.trial_id, result)
         if (self._checkpoint_freq
                 and trial.iteration % self._checkpoint_freq == 0):
@@ -223,8 +298,15 @@ class TrialRunner:
     def run(self):
         while not self.is_finished():
             self.step()
+            if self._reporter is not None and self._reporter.should_report():
+                self._reporter.report(self.trials)
         # final sweep: make sure nothing is left running
         for trial in self.trials:
             if trial.status in (RUNNING, PAUSED, PENDING):
                 self._stop_trial(trial, TERMINATED)
+        for lg in self._loggers.values():
+            lg.close()
+        self._loggers.clear()
+        if self._reporter is not None:
+            self._reporter.report(self.trials, done=True)
         time.sleep(0.05)

@@ -68,9 +68,11 @@ class ExperimentAnalysis:
 def run(run_or_experiment, *, config: dict | None = None,
         num_samples: int = 1, metric: str | None = None, mode: str = "max",
         search_alg=None, scheduler=None, stop: dict | None = None,
-        resources_per_trial: dict | None = None,
+        resources_per_trial=None,
         max_concurrent_trials: int = 0, checkpoint_freq: int = 0,
         max_failures: int = 0, verbose: int = 1,
+        local_dir: str | None = None, loggers=None,
+        progress_reporter=None,
         raise_on_failed_trial: bool = True) -> ExperimentAnalysis:
     """Run a hyperparameter sweep (reference: tune/tune.py:71).
 
@@ -99,6 +101,9 @@ def run(run_or_experiment, *, config: dict | None = None,
         resources_per_trial=resources_per_trial,
         checkpoint_freq=checkpoint_freq,
         max_failures=max_failures,
+        local_dir=local_dir,
+        loggers=loggers,
+        progress_reporter=progress_reporter,
     )
     runner.run()
     errored = [t for t in runner.trials if t.status == "ERROR"]
