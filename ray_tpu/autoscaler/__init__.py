@@ -1,0 +1,9 @@
+"""Cluster autoscaler (reference: python/ray/autoscaler/_private/
+autoscaler.py StandardAutoscaler + node_provider.py NodeProvider)."""
+
+from ray_tpu.autoscaler.autoscaler import StandardAutoscaler
+from ray_tpu.autoscaler.node_provider import (LocalNodeProvider,
+                                              NodeProvider, TPUPodProvider)
+
+__all__ = ["LocalNodeProvider", "NodeProvider", "StandardAutoscaler",
+           "TPUPodProvider"]

@@ -1,0 +1,5 @@
+"""Distributed ML dataset (reference: python/ray/util/data/__init__.py)."""
+
+from ray_tpu.util.data.dataset import MLDataset, from_iterators, from_items
+
+__all__ = ["MLDataset", "from_items", "from_iterators"]

@@ -1,0 +1,118 @@
+"""MLDataset — sharded batch dataset over ParallelIterator (reference:
+python/ray/util/data/dataset.py:10 MLDataset: a ParallelIterator of
+record batches with batch-size-aware repartitioning and per-shard
+consumption for training workers).
+
+TPU-fit: batches are the unit (numpy-friendly columnar dicts or arrays);
+a training worker takes its shard with get_shard(rank) and feeds its
+host's input pipeline — shards never pass through the driver."""
+
+from __future__ import annotations
+
+from typing import Callable, Iterable
+
+from ray_tpu.util import iter as par_iter
+
+
+class MLDataset:
+    """A ParallelIterator whose items are BATCHES of records."""
+
+    def __init__(self, it: par_iter.ParallelIterator, batch_size: int):
+        self._it = it
+        self.batch_size = batch_size
+
+    # -- construction ----------------------------------------------------
+
+    @staticmethod
+    def from_parallel_it(it: par_iter.ParallelIterator,
+                         batch_size: int) -> "MLDataset":
+        return MLDataset(it, batch_size)
+
+    # -- transforms (all lazy, per-shard) --------------------------------
+
+    def transform(self, fn: Callable) -> "MLDataset":
+        """Map over whole batches (reference: dataset.py transform)."""
+        return MLDataset(self._it.for_each(fn), self.batch_size)
+
+    def map(self, fn: Callable) -> "MLDataset":
+        """Map over individual records inside each batch."""
+        return self.transform(lambda batch: [fn(x) for x in batch])
+
+    def filter(self, fn: Callable) -> "MLDataset":
+        return self.transform(
+            lambda batch: [x for x in batch if fn(x)])
+
+    def batch(self, batch_size: int) -> "MLDataset":
+        """Re-chunk records into batches of `batch_size`."""
+        flat = self._it.flatten()
+        return MLDataset(flat.batch(batch_size), batch_size)
+
+    def local_shuffle(self, shuffle_buffer_size: int,
+                      seed: int | None = None) -> "MLDataset":
+        return MLDataset(
+            self._it.local_shuffle(shuffle_buffer_size, seed),
+            self.batch_size)
+
+    def union(self, other: "MLDataset") -> "MLDataset":
+        return MLDataset(self._it.union(other._it), self.batch_size)
+
+    # -- consumption -----------------------------------------------------
+
+    def num_shards(self) -> int:
+        return self._it.num_shards()
+
+    def get_shard(self, shard_index: int) -> Iterable:
+        """Iterate one shard's batches (a training worker's slice)."""
+        return self._it.get_shard(shard_index)
+
+    def gather_sync(self):
+        return self._it.gather_sync()
+
+    def gather_async(self):
+        return self._it.gather_async()
+
+    def take(self, n: int) -> list:
+        return self._it.take(n)
+
+    def to_torch(self, feature_columns, label_column):
+        """Batches become (features, label) tensor pairs for torch
+        training loops (reference: dataset.py to_torch; torch is CPU-only
+        in this image)."""
+
+        def conv(batch):
+            import torch
+
+            xs = torch.stack([
+                torch.as_tensor([float(row[c]) for c in feature_columns])
+                for row in batch])
+            ys = torch.as_tensor([row[label_column] for row in batch])
+            return xs.float(), ys
+
+        return self.transform(conv)
+
+    def __repr__(self):
+        return (f"MLDataset(shards={self._it.num_shards()}, "
+                f"batch_size={self.batch_size})")
+
+
+def from_items(items: list, num_shards: int = 2, batch_size: int = 32,
+               repeat: bool = False) -> MLDataset:
+    """reference: util/data/__init__.py from_items (wraps iterators)."""
+    if repeat:
+        def make(shard_items):
+            def gen():
+                while True:
+                    yield from shard_items
+            return gen
+    else:
+        def make(shard_items):
+            return lambda: iter(shard_items)
+
+    shards = [items[i::num_shards] for i in range(num_shards)]
+    it = par_iter.from_iterators([make(s) for s in shards])
+    return MLDataset(it.batch(batch_size), batch_size)
+
+
+def from_iterators(generators: list, batch_size: int = 32) -> MLDataset:
+    it = par_iter.from_iterators(generators)
+    return MLDataset(it.batch(batch_size), batch_size)

@@ -211,6 +211,27 @@ class ParallelIterator:
                     inflight[actor.next_items.remote(self._prefetch)] = actor
         return LocalIterator(gen)
 
+    def get_shard(self, shard_index: int) -> LocalIterator:
+        """One shard's items, pulled straight from that shard's actor
+        (reference: iter.py get_shard — a training worker consumes its
+        slice without the other shards passing through the driver)."""
+        if not 0 <= shard_index < len(self._sources):
+            raise IndexError(f"shard {shard_index} out of "
+                             f"{len(self._sources)}")
+
+        def gen():
+            actor = self.actors[shard_index]
+            ray_tpu.get(actor.reset.remote(), timeout=60)
+            while True:
+                items = ray_tpu.get(
+                    actor.next_items.remote(self._prefetch), timeout=300)
+                for item in items:
+                    if isinstance(item, str) and item == _SENTINEL:
+                        return
+                    yield item
+
+        return LocalIterator(gen)
+
     def take(self, n: int) -> list:
         return self.gather_sync().take(n)
 

@@ -1,0 +1,22 @@
+"""joblib backend: scikit-learn style Parallel() over the cluster
+(reference: python/ray/util/joblib/__init__.py register_ray +
+ray_backend.py RayBackend).
+
+    from ray_tpu.util.joblib import register_ray
+    register_ray()
+    with joblib.parallel_backend("ray_tpu"):
+        joblib.Parallel()(joblib.delayed(f)(x) for x in xs)
+"""
+
+from __future__ import annotations
+
+
+def register_ray():
+    from joblib.parallel import register_parallel_backend
+
+    from ray_tpu.util.joblib.backend import RayTpuBackend
+
+    register_parallel_backend("ray_tpu", RayTpuBackend)
+
+
+__all__ = ["register_ray"]

@@ -1,0 +1,67 @@
+"""joblib ParallelBackend running batches as cluster tasks (reference:
+python/ray/util/joblib/ray_backend.py RayBackend — there built on
+multiprocessing.Pool; here each joblib batch is one remote task, which is
+both simpler and spillback/reconstruction-aware for free)."""
+
+from __future__ import annotations
+
+from joblib._parallel_backends import ParallelBackendBase
+
+import ray_tpu
+
+
+class _Future:
+    """joblib expects a concurrent.futures-ish result holder."""
+
+    def __init__(self, ref):
+        self._ref = ref
+
+    def get(self, timeout=None):
+        return ray_tpu.get(self._ref, timeout=timeout)
+
+
+class RayTpuBackend(ParallelBackendBase):
+    supports_timeout = True
+    default_n_jobs = -1
+
+    def configure(self, n_jobs=1, parallel=None, **kwargs):
+        self.parallel = parallel
+        if not ray_tpu.is_initialized():
+            ray_tpu.init(ignore_reinit_error=True)
+        return self.effective_n_jobs(n_jobs)
+
+    def effective_n_jobs(self, n_jobs):
+        if n_jobs == 0:
+            raise ValueError("n_jobs == 0 is not supported")
+        cpus = int(ray_tpu.cluster_resources().get("CPU", 1))
+        if n_jobs is None or n_jobs < 0:
+            return max(1, cpus)
+        return min(n_jobs, max(1, cpus))
+
+    def apply_async(self, func, callback=None):
+        @ray_tpu.remote
+        def _run_batch(pickled):
+            import cloudpickle
+
+            return cloudpickle.loads(pickled)()
+
+        import cloudpickle
+
+        ref = _run_batch.remote(cloudpickle.dumps(func))
+        fut = _Future(ref)
+        if callback is not None:
+            import threading
+
+            def _wait():
+                try:
+                    callback(fut.get())
+                except Exception:
+                    pass
+
+            threading.Thread(target=_wait, daemon=True).start()
+        return fut
+
+    def abort_everything(self, ensure_ready=True):
+        if ensure_ready:
+            self.configure(n_jobs=self.parallel.n_jobs,
+                           parallel=self.parallel)

@@ -1,0 +1,93 @@
+"""Autoscaler reconciliation over real node processes (reference:
+python/ray/autoscaler/_private/autoscaler.py:51; provider surface:
+node_provider.py:12)."""
+
+import time
+
+import ray_tpu
+from ray_tpu._private import global_state
+from ray_tpu._private.node import start_gcs
+from ray_tpu.autoscaler import (LocalNodeProvider, StandardAutoscaler,
+                                TPUPodProvider)
+
+
+def test_scale_up_on_pending_and_down_when_idle(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.gcs_svc, cluster.gcs_address = start_gcs(
+        cluster.session_dir, cluster.config)
+    cluster.add_node(num_cpus=1, resources={"pin": 1}, is_head=True)
+    cluster.connect_driver()
+
+    provider = LocalNodeProvider(cluster.gcs_address, cluster.session_dir)
+    scaler = StandardAutoscaler(
+        provider, gcs_address=cluster.gcs_address,
+        min_workers=0, max_workers=2, idle_timeout_s=1.0,
+        worker_node_config={"num_cpus": 2})
+
+    @ray_tpu.remote(num_cpus=1, resources={"pin": 1})
+    class Squatter:
+        def ready(self):
+            return True
+
+    @ray_tpu.remote(num_cpus=1)
+    def work():
+        time.sleep(0.3)
+        return global_state.require_core_worker().node_id.binary()
+
+    s = Squatter.remote()
+    ray_tpu.get(s.ready.remote(), timeout=60)
+    refs = [work.remote() for _ in range(4)]  # head saturated -> pending
+
+    time.sleep(0.7)  # let leases queue
+    stats = scaler.update()
+    assert stats["launched"] >= 1, "no scale-up despite pending work"
+    assert provider.non_terminated_nodes()
+
+    nodes = ray_tpu.get(refs, timeout=120)
+    head_id = cluster.head_node.node_id.binary()
+    assert any(n != head_id for n in nodes), (
+        "work never reached the autoscaled node")
+
+    # Idle: after idle_timeout the worker node is reaped.
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        stats = scaler.update()
+        if not provider.non_terminated_nodes():
+            break
+        time.sleep(0.5)
+    assert not provider.non_terminated_nodes(), "idle node never reaped"
+
+
+def test_tpu_pod_provider_offline_control_flow():
+    class FakeTPUClient:
+        def __init__(self):
+            self.created = []
+            self.deleted = []
+
+        def create_queued_resource(self, **kw):
+            self.created.append(kw)
+
+        def delete_queued_resource(self, name):
+            self.deleted.append(name)
+
+        def list_queued_resources(self):
+            return [{"name": kw["name"], "state": "ACTIVE"}
+                    for kw in self.created
+                    if kw["name"] not in self.deleted]
+
+    client = FakeTPUClient()
+    provider = TPUPodProvider(client=client)
+    (nid,) = provider.create_node({"accelerator_type": "v5e-16",
+                                   "zone": "us-central2-b"})
+    assert client.created[0]["accelerator_type"] == "v5e-16"
+    assert provider.non_terminated_nodes() == [nid]
+    assert provider.node_tags(nid)["accelerator_type"] == "v5e-16"
+    provider.terminate_node(nid)
+    assert provider.non_terminated_nodes() == []
+
+    bare = TPUPodProvider()
+    try:
+        bare.create_node({"accelerator_type": "v5e-16"})
+        raise AssertionError("expected RuntimeError without a client")
+    except RuntimeError:
+        pass

@@ -1,0 +1,54 @@
+"""MLDataset + joblib backend (reference: python/ray/util/data/dataset.py,
+python/ray/util/joblib/)."""
+
+import ray_tpu
+from ray_tpu.util.data import MLDataset, from_items
+
+
+def test_mldataset_batching_and_transforms(ray_start_regular):
+    ds = from_items(list(range(20)), num_shards=2, batch_size=4)
+    assert ds.num_shards() == 2
+    batches = list(ds.gather_sync())
+    assert sorted(x for b in batches for x in b) == list(range(20))
+    assert all(len(b) <= 4 for b in batches)
+
+    doubled = ds.map(lambda x: x * 2)
+    total = sum(x for b in doubled.gather_sync() for x in b)
+    assert total == 2 * sum(range(20))
+
+    evens = ds.filter(lambda x: x % 2 == 0)
+    assert sorted(x for b in evens.gather_sync() for x in b) == list(
+        range(0, 20, 2))
+
+    rebatched = ds.batch(5)
+    sizes = [len(b) for b in rebatched.gather_sync()]
+    assert all(s == 5 for s in sizes)
+
+
+def test_mldataset_get_shard(ray_start_regular):
+    ds = from_items(list(range(12)), num_shards=3, batch_size=2)
+    seen = []
+    for rank in range(3):
+        for batch in ds.get_shard(rank):
+            seen.extend(batch)
+    assert sorted(seen) == list(range(12))
+
+
+def test_mldataset_to_torch(ray_start_regular):
+    rows = [{"a": i, "b": 2 * i, "y": i % 2} for i in range(8)]
+    ds = from_items(rows, num_shards=2, batch_size=4)
+    pairs = list(ds.to_torch(["a", "b"], "y").gather_sync())
+    assert pairs and all(x.shape[1] == 2 for x, _ in pairs)
+    assert sum(int(y.sum()) for _, y in pairs) == 4
+
+
+def test_joblib_backend(ray_start_regular):
+    import joblib
+
+    from ray_tpu.util.joblib import register_ray
+
+    register_ray()
+    with joblib.parallel_backend("ray_tpu"):
+        out = joblib.Parallel(n_jobs=4)(
+            joblib.delayed(lambda x: x * x)(i) for i in range(12))
+    assert out == [i * i for i in range(12)]
