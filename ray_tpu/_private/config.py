@@ -28,6 +28,9 @@ class Config:
     max_direct_call_object_size: int = 100 * 1024
     # Default shared-memory store capacity per node (bytes).
     object_store_memory: int = 2 * 1024**3
+    # "files" = file-per-object mmap store; "native" = the C++ shared-arena
+    # slab allocator (native/store/store.cc), built on demand with g++.
+    object_store_backend: str = "files"
     # Chunk size for node-to-node object transfer.
     object_transfer_chunk_size: int = 5 * 1024**2
     # Spill directory ("" = session dir /spill).

@@ -37,7 +37,7 @@ from ray_tpu._private import common, global_state, rpc, serialization
 from ray_tpu._private.config import Config
 from ray_tpu._private.ids import ActorID, JobID, NodeID, ObjectID, TaskID, WorkerID
 from ray_tpu._private.memstore import IN_PLASMA, MemoryStore
-from ray_tpu._private.object_store import LocalObjectStore
+from ray_tpu._private.object_store import make_store
 from ray_tpu.object_ref import ObjectRef
 
 logger = logging.getLogger("ray_tpu.core_worker")
@@ -105,7 +105,7 @@ class CoreWorker:
         self.node_id: NodeID | None = None
 
         self.memstore = MemoryStore()
-        self.store = LocalObjectStore(store_root)
+        self.store = make_store(store_root, config)
         self._io = rpc.EventLoopThread()
         self._lock = threading.RLock()
 

@@ -156,6 +156,21 @@ class LocalObjectStore:
         return out
 
 
+def make_store(root: str, config=None):
+    """Backend factory: the python file-per-object store, or the C++
+    shared-arena slab store (native/store) when configured. Raylet and
+    workers on one node must agree (both read the same config)."""
+    backend = "files"
+    if config is not None:
+        backend = getattr(config, "object_store_backend", "files")
+    if backend == "native":
+        from ray_tpu.native.store import NativeObjectStore
+
+        capacity = getattr(config, "object_store_memory", 1 << 30)
+        return NativeObjectStore(root, capacity=capacity)
+    return LocalObjectStore(root)
+
+
 def default_store_root(session_dir: str) -> str:
     """Prefer /dev/shm (true shared memory) when available."""
     shm = "/dev/shm"

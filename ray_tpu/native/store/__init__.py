@@ -1,0 +1,4 @@
+from ray_tpu.native.store.native_store import (NativeObjectStore,
+                                               native_store_available)
+
+__all__ = ["NativeObjectStore", "native_store_available"]

@@ -29,7 +29,7 @@ from ray_tpu._private import rpc
 from ray_tpu._private.common import ResourceSet
 from ray_tpu._private.config import Config, get_config, set_config
 from ray_tpu._private.ids import NodeID, ObjectID
-from ray_tpu._private.object_store import LocalObjectStore
+from ray_tpu._private.object_store import make_store
 
 logger = logging.getLogger("ray_tpu.raylet")
 
@@ -59,7 +59,7 @@ class Raylet:
         self.labels = labels
         self.total = ResourceSet(resources)
         self.available = self.total.copy()
-        self.store = LocalObjectStore(store_root)
+        self.store = make_store(store_root, config)
         self.store_root = store_root
 
         # worker pool — two flavors: plain CPU workers (TPU-plugin env
@@ -827,6 +827,11 @@ class Raylet:
     async def _maybe_spill(self):
         """Spill cold unpinned objects to disk above the usage threshold
         (reference: local_object_manager.h SpillObjects)."""
+        if getattr(self.store, "ARENA_BACKED", False):
+            # Arena blocks are reused after delete; evicting behind a
+            # zero-copy reader would corrupt it. Owner-driven frees are
+            # the only deleter for the native backend.
+            return
         limit = int(self.config.object_store_memory
                     * self.config.object_spilling_threshold)
         if self.store_used <= limit:
