@@ -1,0 +1,3 @@
+from ray_tpu.dashboard.dashboard import Dashboard
+
+__all__ = ["Dashboard"]

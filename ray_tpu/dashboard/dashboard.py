@@ -1,0 +1,180 @@
+"""Cluster dashboard — HTTP views over the control plane (reference:
+python/ray/dashboard + the new_dashboard agent/head split; here a single
+aiohttp process reading the GCS + raylets over the existing RPC layer).
+
+Endpoints:
+    /            tiny HTML overview (auto-refreshing)
+    /api/nodes   node table incl. per-node availability
+    /api/actors  actor table (id, state, name, node, restarts)
+    /api/metrics gcs + per-raylet metric snapshots
+    /api/objects per-node object store usage
+    /api/timeline chrome-trace JSON of recorded profile spans
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+from ray_tpu._private import rpc
+from ray_tpu._private.common import ResourceSet
+
+_PAGE = """<!doctype html><meta http-equiv=refresh content=2>
+<title>ray_tpu dashboard</title>
+<style>body{font-family:monospace;margin:2em}table{border-collapse:collapse}
+td,th{border:1px solid #999;padding:4px 8px;text-align:left}</style>
+<h2>ray_tpu cluster</h2><div id=c>loading…</div>
+<script>
+// Escape EVERYTHING interpolated into innerHTML: actor/class names are
+// user-controlled (the reference dashboard had exactly this XSS class).
+const esc=v=>String(v).replace(/[&<>"']/g,
+  c=>({'&':'&amp;','<':'&lt;','>':'&gt;','"':'&quot;',"'":'&#39;'}[c]));
+fetch('/api/nodes').then(r=>r.json()).then(ns=>{
+ let h='<h3>nodes</h3><table><tr><th>node</th><th>address</th><th>head</th>'
+   +'<th>total</th><th>available</th></tr>';
+ for(const n of ns){h+=`<tr><td>${esc(n.node_id)}</td>`
+   +`<td>${esc(n.address)}</td><td>${esc(n.is_head)}</td>`
+   +`<td>${esc(JSON.stringify(n.total))}</td>`
+   +`<td>${esc(JSON.stringify(n.available))}</td></tr>`}
+ h+='</table>';
+ fetch('/api/actors').then(r=>r.json()).then(as_=>{
+  h+='<h3>actors</h3><table><tr><th>actor</th><th>class</th><th>state</th>'
+    +'<th>name</th><th>restarts</th></tr>';
+  for(const a of as_){h+=`<tr><td>${esc(a.actor_id)}</td>`
+    +`<td>${esc(a.class_name)}</td><td>${esc(a.state)}</td>`
+    +`<td>${esc(a.name)}</td><td>${esc(a.num_restarts)}</td></tr>`}
+  h+='</table>';document.getElementById('c').innerHTML=h})})
+</script>"""
+
+
+class Dashboard:
+    """Serves cluster state pulled from the GCS address."""
+
+    def __init__(self, gcs_address: str, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.gcs_address = gcs_address
+        self.host = host
+        self.port = port
+        self._site_port = None
+
+    async def _gcs(self, method: str, data=None):
+        conn = await rpc.connect(self.gcs_address, name="dashboard")
+        try:
+            return await conn.call(method, data or {}, timeout=10)
+        finally:
+            await conn.close()
+
+    async def _raylet(self, address: str, method: str, data=None):
+        conn = await rpc.connect(address, name="dashboard")
+        try:
+            return await conn.call(method, data or {}, timeout=10)
+        finally:
+            await conn.close()
+
+    # -- endpoint payloads ----------------------------------------------
+
+    async def nodes(self) -> list[dict]:
+        nodes = await self._gcs("get_all_nodes")
+        avail = await self._gcs("get_available_resources")
+        out = []
+        for n in nodes:
+            out.append({
+                "node_id": n["node_id"].hex()[:12],
+                "address": n["address"],
+                "hostname": n.get("hostname", ""),
+                "is_head": bool(n.get("is_head")),
+                "total": ResourceSet.from_raw(n["resources"]).to_dict(),
+                "available": ResourceSet.from_raw(
+                    avail.get(n["node_id"], {})).to_dict(),
+            })
+        return out
+
+    async def actors(self) -> list[dict]:
+        actors = await self._gcs("list_actors")
+        return [{
+            "actor_id": a["actor_id"].hex()[:12],
+            "class_name": a.get("class_name", ""),
+            "state": a["state"],
+            "name": a.get("name", ""),
+            "node": (a["node_id"].hex()[:12] if a.get("node_id") else ""),
+            "num_restarts": a.get("num_restarts", 0),
+        } for a in actors]
+
+    async def metrics(self) -> dict:
+        out = {"gcs": await self._gcs("get_metrics")}
+        nodes = await self._gcs("get_all_nodes")
+
+        async def one(n):
+            try:
+                return (n["node_id"].hex()[:12],
+                        await self._raylet(n["address"], "get_metrics"))
+            except Exception:
+                return None
+
+        got = await asyncio.gather(*(one(n) for n in nodes))
+        out["raylets"] = dict(p for p in got if p)
+        return out
+
+    async def objects(self) -> list[dict]:
+        nodes = await self._gcs("get_all_nodes")
+        out = []
+        for n in nodes:
+            try:
+                info = await self._raylet(n["address"], "cluster_info")
+            except Exception:
+                continue
+            out.append({"node_id": n["node_id"].hex()[:12],
+                        "num_objects": info["num_local_objects"],
+                        "store_used_bytes": info["store_used"],
+                        "num_workers": info["num_workers"]})
+        return out
+
+    async def timeline(self) -> list[dict]:
+        from ray_tpu._private.profiling import to_chrome_trace
+
+        return to_chrome_trace(await self._gcs("get_profile_events"))
+
+    # -- server ----------------------------------------------------------
+
+    async def run(self, ready_cb=None):
+        from aiohttp import web
+
+        def jroute(fn):
+            async def handler(request):
+                return web.json_response(await fn())
+            return handler
+
+        app = web.Application()
+        app.router.add_get("/", lambda r: web.Response(
+            text=_PAGE, content_type="text/html"))
+        app.router.add_get("/api/nodes", jroute(self.nodes))
+        app.router.add_get("/api/actors", jroute(self.actors))
+        app.router.add_get("/api/metrics", jroute(self.metrics))
+        app.router.add_get("/api/objects", jroute(self.objects))
+        app.router.add_get("/api/timeline", jroute(self.timeline))
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, self.host, self.port)
+        await site.start()
+        self._site_port = site._server.sockets[0].getsockname()[1]
+        if ready_cb:
+            ready_cb(self._site_port)
+        while True:
+            await asyncio.sleep(3600)
+
+
+def main():
+    import argparse
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--gcs-address", required=True)
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=8265)
+    args = parser.parse_args()
+    dash = Dashboard(args.gcs_address, args.host, args.port)
+    asyncio.run(dash.run(ready_cb=lambda p: print(
+        f"dashboard at http://{args.host}:{p}", flush=True)))
+
+
+if __name__ == "__main__":
+    main()

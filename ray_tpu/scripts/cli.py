@@ -251,6 +251,20 @@ def cmd_timeline(args) -> int:
     return 0
 
 
+def cmd_dashboard(args) -> int:
+    """reference: `ray dashboard` / the dashboard head process."""
+    addr = _gcs_address(args)
+    if not addr:
+        print("no cluster found", file=sys.stderr)
+        return 1
+    from ray_tpu.dashboard import Dashboard
+
+    dash = Dashboard(addr, args.host, args.port)
+    asyncio.run(dash.run(ready_cb=lambda p: print(
+        f"dashboard at http://{args.host}:{p}", flush=True)))
+    return 0
+
+
 def cmd_microbenchmark(args) -> int:
     from ray_tpu import microbenchmark
 
@@ -297,6 +311,12 @@ def main(argv=None) -> int:
     p.add_argument("--address", default=None)
     p.add_argument("--out", default=None)
     p.set_defaults(fn=cmd_timeline)
+
+    p = sub.add_parser("dashboard", help="serve the cluster dashboard")
+    p.add_argument("--address", default=None)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8265)
+    p.set_defaults(fn=cmd_dashboard)
 
     p = sub.add_parser("microbenchmark", help="run the core benchmark suite")
     p.add_argument("--out", default=None)

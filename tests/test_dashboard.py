@@ -1,0 +1,64 @@
+"""Dashboard endpoints over a live cluster (reference: the reference's
+dashboard head serving node/actor/metric state)."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import ray_tpu
+from ray_tpu import api as _api
+from ray_tpu.dashboard import Dashboard
+
+
+def test_dashboard_endpoints(ray_start_regular):
+    @ray_tpu.remote
+    class Marker:
+        def ping(self):
+            return "pong"
+
+    m = Marker.options(name="dash_marker").remote()
+    assert ray_tpu.get(m.ping.remote(), timeout=60) == "pong"
+
+    gcs_address = _api._global_node.gcs_address
+    dash = Dashboard(gcs_address)
+    port_holder = {}
+    ready = threading.Event()
+
+    def _serve():
+        import asyncio
+
+        def cb(p):
+            port_holder["port"] = p
+            ready.set()
+
+        try:
+            asyncio.run(dash.run(ready_cb=cb))
+        except Exception:
+            pass
+
+    t = threading.Thread(target=_serve, daemon=True)
+    t.start()
+    assert ready.wait(15)
+    base = f"http://127.0.0.1:{port_holder['port']}"
+
+    def get_json(path):
+        with urllib.request.urlopen(base + path, timeout=10) as r:
+            return json.loads(r.read())
+
+    nodes = get_json("/api/nodes")
+    assert len(nodes) == 1 and nodes[0]["is_head"]
+    assert nodes[0]["total"].get("CPU") == 4
+
+    actors = get_json("/api/actors")
+    assert any(a["name"] == "dash_marker" and a["state"] == "ALIVE"
+               for a in actors)
+
+    metrics = get_json("/api/metrics")
+    assert "gcs" in metrics and metrics["raylets"]
+
+    objects = get_json("/api/objects")
+    assert objects and objects[0]["num_workers"] >= 1
+
+    with urllib.request.urlopen(base + "/", timeout=10) as r:
+        assert b"ray_tpu cluster" in r.read()
