@@ -2,6 +2,8 @@
 reference: python/ray/util/sgd/)."""
 
 from ray_tpu.train.operator import TrainingOperator
+from ray_tpu.train.torch_operator import TorchTrainingOperator
 from ray_tpu.train.trainer import Trainer, TrainWorker
 
-__all__ = ["Trainer", "TrainWorker", "TrainingOperator"]
+__all__ = ["TorchTrainingOperator", "Trainer", "TrainWorker",
+           "TrainingOperator"]

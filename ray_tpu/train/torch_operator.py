@@ -1,0 +1,170 @@
+"""TorchTrainingOperator — the second-framework trainer path (reference:
+python/ray/util/sgd/torch/training_operator.py:50 — this is the analog of
+the reference's torch-native operator, so torch users can move over
+without rewriting to jax; CPU torch in this image, gradient plane =
+ray_tpu.collective HOST backend as one flat bucket).
+
+Same Trainer-facing surface as the jax TrainingOperator (train_epoch /
+validate / state_dict / load_state_dict), so `Trainer(TorchOpSubclass,
+...)` just works, including elastic resize."""
+
+from __future__ import annotations
+
+import time
+from typing import Iterable
+
+import numpy as np
+
+
+class TorchTrainingOperator:
+    """Subclass, implement setup(), call self.register(...)."""
+
+    def __init__(self, config: dict, world_rank: int, world_size: int,
+                 group_name: str | None = None):
+        self.config = config or {}
+        self.world_rank = world_rank
+        self.world_size = world_size
+        self._group_name = group_name
+        self._registered = False
+        self._train_loader = None
+        self._val_loader = None
+        self.epoch = 0
+        self.global_step = 0
+        self.setup(self.config)
+        if not self._registered:
+            raise RuntimeError(
+                "TorchTrainingOperator.setup() must call self.register(...)")
+
+    # -- user surface ----------------------------------------------------
+
+    def setup(self, config: dict):
+        raise NotImplementedError
+
+    def register(self, *, model, optimizer, criterion,
+                 scheduler=None):
+        """model: nn.Module; optimizer: torch optimizer over its params;
+        criterion(output, target) -> loss; scheduler: optional LR sched
+        stepped per epoch."""
+        import torch
+
+        self._registered = True
+        self.model = model
+        self.optimizer = optimizer
+        self.criterion = criterion
+        self.scheduler = scheduler
+        self._torch = torch
+
+    def register_data(self, *, train_loader: Iterable | None = None,
+                      validation_loader: Iterable | None = None):
+        self._train_loader = train_loader
+        self._val_loader = validation_loader
+
+    # -- gradient plane --------------------------------------------------
+
+    def _allreduce_grads(self):
+        """Average gradients across workers as ONE flat numpy bucket
+        (reference: DistributedTorchRunner's DDP allreduce — here over the
+        HOST collective group the Trainer created)."""
+        if self.world_size == 1:
+            return
+        from ray_tpu.collective import collective as col
+
+        torch = self._torch
+        grads = [p.grad for p in self.model.parameters()
+                 if p.grad is not None]
+        if not grads:
+            return
+        flat = torch.cat([g.reshape(-1) for g in grads]).numpy()
+        summed = col.allreduce(flat, group_name=self._group_name)
+        flat = torch.from_numpy(np.asarray(summed) / self.world_size)
+        off = 0
+        for g in grads:
+            n = g.numel()
+            g.copy_(flat[off:off + n].reshape(g.shape))
+            off += n
+
+    # -- loops (same shape as the jax operator) --------------------------
+
+    def train_batch(self, batch) -> dict:
+        torch = self._torch
+        features, target = batch
+        features = torch.as_tensor(np.asarray(features))
+        target = torch.as_tensor(np.asarray(target))
+        self.model.train()
+        self.optimizer.zero_grad()
+        output = self.model(features)
+        loss = self.criterion(output, target)
+        loss.backward()
+        self._allreduce_grads()
+        self.optimizer.step()
+        self.global_step += 1
+        return {"train_loss": float(loss.detach())}
+
+    def train_epoch(self, num_steps: int | None = None,
+                    profile_dir: str | None = None) -> dict:
+        if self._train_loader is None:
+            raise RuntimeError("no train_loader registered")
+        t0 = time.perf_counter()
+        losses, samples = [], 0
+        for step, batch in enumerate(self._train_loader):
+            losses.append(self.train_batch(batch)["train_loss"])
+            samples += len(batch[0])
+            if num_steps is not None and step + 1 >= num_steps:
+                break
+        if self.scheduler is not None:
+            self.scheduler.step()
+        dt = time.perf_counter() - t0
+        self.epoch += 1
+        return {
+            "epoch": self.epoch,
+            "batch_count": len(losses),
+            "num_samples": samples,
+            "train_loss": float(np.mean(losses)) if losses else float("nan"),
+            "last_train_loss": losses[-1] if losses else float("nan"),
+            "samples_per_s": samples / dt if dt > 0 else 0.0,
+        }
+
+    def validate(self, num_steps: int | None = None) -> dict:
+        if self._val_loader is None:
+            raise RuntimeError("no validation_loader registered")
+        torch = self._torch
+        self.model.eval()
+        losses, samples = [], 0
+        with torch.no_grad():
+            for step, (features, target) in enumerate(self._val_loader):
+                features = torch.as_tensor(np.asarray(features))
+                target = torch.as_tensor(np.asarray(target))
+                loss = self.criterion(self.model(features), target)
+                losses.append(float(loss))
+                samples += len(features)
+                if num_steps is not None and step + 1 >= num_steps:
+                    break
+        return {"val_loss": float(np.mean(losses)) if losses else
+                float("nan"), "num_samples": samples}
+
+    # -- checkpointing ---------------------------------------------------
+
+    def state_dict(self) -> dict:
+        return {
+            "model": {k: v.numpy() for k, v in
+                      self.model.state_dict().items()},
+            # Optimizer moments + scheduler counters must survive elastic
+            # resize / save-load (reference: training_operator state_dict
+            # includes them) or Adam momentum and the LR schedule reset.
+            "optimizer": self.optimizer.state_dict(),
+            "scheduler": (self.scheduler.state_dict()
+                          if self.scheduler is not None else None),
+            "epoch": self.epoch,
+            "global_step": self.global_step,
+        }
+
+    def load_state_dict(self, state: dict):
+        torch = self._torch
+        self.model.load_state_dict(
+            {k: torch.as_tensor(v) for k, v in state["model"].items()})
+        if state.get("optimizer") is not None:
+            self.optimizer.load_state_dict(state["optimizer"])
+        if state.get("scheduler") is not None and self.scheduler is not None:
+            self.scheduler.load_state_dict(state["scheduler"])
+        self.epoch = state["epoch"]
+        self.global_step = state["global_step"]

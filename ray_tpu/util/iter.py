@@ -221,7 +221,7 @@ class ParallelIterator:
 
         def gen():
             actor = self.actors[shard_index]
-            ray_tpu.get(actor.reset.remote(), timeout=60)
+            ray_tpu.get(actor.reset.remote(), timeout=300)
             while True:
                 items = ray_tpu.get(
                     actor.next_items.remote(self._prefetch), timeout=300)

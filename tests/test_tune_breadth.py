@@ -90,12 +90,19 @@ def test_pg_backed_trials(ray_start_shared):
             [{"CPU": 1}, {"CPU": 1}], strategy="PACK"),
         max_concurrent_trials=2)
     assert all(t.status == "TERMINATED" for t in analysis.trials)
-    # groups are returned after the run: nothing left reserved
+    # groups are returned after the run: nothing left reserved (bundle
+    # returns are async — poll until the resources settle)
+    import time
+
     import ray_tpu
 
-    avail = ray_tpu.available_resources()
     total = ray_tpu.cluster_resources()
-    assert avail.get("CPU") == total.get("CPU")
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        if ray_tpu.available_resources().get("CPU") == total.get("CPU"):
+            break
+        time.sleep(0.3)
+    assert ray_tpu.available_resources().get("CPU") == total.get("CPU")
 
 
 def test_cli_reporter_prints_table(ray_start_shared, capsys):
