@@ -1,0 +1,3 @@
+from ray_tpu.rllib.models.catalog import ModelCatalog
+
+__all__ = ["ModelCatalog"]

@@ -1,0 +1,113 @@
+"""ModelCatalog — pick + build a policy network for a space (reference:
+rllib/models/catalog.py:167 ModelCatalog.get_model_v2 and the
+fcnet/visionnet defaults). jax-functional: each model is an
+(init(key) -> params, apply(params, obs) -> out) pair; flat observation
+spaces get the fcnet, image-shaped (H, W, C) spaces the conv stack."""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+MODEL_DEFAULTS: dict = {
+    # reference: rllib/models/catalog.py MODEL_DEFAULTS
+    "fcnet_hiddens": [64, 64],
+    "fcnet_activation": "tanh",
+    "conv_filters": [(16, 4, 2), (32, 4, 2), (64, 3, 1)],  # (out, k, stride)
+    "conv_activation": "relu",
+}
+
+_ACTS = {"tanh": jnp.tanh, "relu": jax.nn.relu,
+         "swish": jax.nn.swish, "linear": lambda x: x}
+
+
+def _fc_init(key, sizes):
+    params = []
+    for n_in, n_out in zip(sizes[:-1], sizes[1:]):
+        k, key = jax.random.split(key)
+        params.append({"w": jax.random.normal(k, (n_in, n_out))
+                       / math.sqrt(n_in),
+                       "b": jnp.zeros(n_out)})
+    return params
+
+
+def _fc_apply(params, x, act, final_linear=True):
+    for i, layer in enumerate(params):
+        x = x @ layer["w"] + layer["b"]
+        if i < len(params) - 1 or not final_linear:
+            x = act(x)
+    return x
+
+
+class ModelCatalog:
+    @staticmethod
+    def get_model_config(config: dict | None = None) -> dict:
+        return {**MODEL_DEFAULTS, **(config or {})}
+
+    @staticmethod
+    def get_model(obs_space, num_outputs: int, config: dict | None = None):
+        """-> (init(key) -> params, apply(params, obs[B,...]) -> [B,out])"""
+        cfg = ModelCatalog.get_model_config(config)
+        shape = tuple(obs_space.shape)
+        if len(shape) == 3:
+            return ModelCatalog._convnet(shape, num_outputs, cfg)
+        return ModelCatalog._fcnet(int(np.prod(shape)), num_outputs, cfg)
+
+    # -- fcnet (reference: models/catalog.py fcnet path) -----------------
+
+    @staticmethod
+    def _fcnet(obs_dim: int, num_outputs: int, cfg: dict):
+        sizes = [obs_dim] + list(cfg["fcnet_hiddens"]) + [num_outputs]
+        act = _ACTS[cfg["fcnet_activation"]]
+
+        def init(key):
+            return {"fc": _fc_init(key, sizes)}
+
+        def apply(params, obs):
+            x = obs.reshape(obs.shape[0], -1)
+            return _fc_apply(params["fc"], x, act)
+
+        return init, apply
+
+    # -- visionnet (reference: models/catalog.py vision path) ------------
+
+    @staticmethod
+    def _convnet(shape: tuple, num_outputs: int, cfg: dict):
+        h, w, c = shape
+        filters = list(cfg["conv_filters"])
+        act = _ACTS[cfg["conv_activation"]]
+
+        def init(key):
+            params = {"conv": []}
+            c_in = c
+            hh, ww = h, w
+            for out_c, k, s in filters:
+                kk, key = jax.random.split(key)
+                fan_in = k * k * c_in
+                params["conv"].append({
+                    "w": jax.random.normal(kk, (k, k, c_in, out_c))
+                    / math.sqrt(fan_in),
+                    "b": jnp.zeros(out_c),
+                })
+                hh = (hh - k) // s + 1
+                ww = (ww - k) // s + 1
+                c_in = out_c
+            flat = hh * ww * c_in
+            kk, key = jax.random.split(key)
+            params["head"] = _fc_init(kk, [flat, 256, num_outputs])
+            return params
+
+        def apply(params, obs):
+            x = obs.astype(jnp.float32)
+            for layer, (_out, k, s) in zip(params["conv"], filters):
+                x = jax.lax.conv_general_dilated(
+                    x, layer["w"], window_strides=(s, s), padding="VALID",
+                    dimension_numbers=("NHWC", "HWIO", "NHWC"))
+                x = act(x + layer["b"])
+            x = x.reshape(x.shape[0], -1)
+            return _fc_apply(params["head"], x, act)
+
+        return init, apply

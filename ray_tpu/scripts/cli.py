@@ -18,6 +18,7 @@ import asyncio
 import json
 import os
 import signal
+import subprocess
 import sys
 import time
 
@@ -93,16 +94,44 @@ def cmd_start(args) -> int:
         is_head=args.head)
     pids.append(raylet_svc.proc.pid)
 
+    client_port = None
+    if args.head and args.client_server_port is not None:
+        # Ray-Client analog: remote drivers connect here with no local
+        # runtime (reference: `ray start --ray-client-server-port`).
+        # Spawned like the other services (_spawn: config overrides via
+        # child_env, TPU-plugin env stripped) and health-checked via the
+        # ready file, which also reports the actual port for --port 0.
+        import uuid as _uuid
+
+        from ray_tpu._private.node import _spawn, _wait_ready
+
+        ready = os.path.join(session_dir,
+                             f"client_ready_{_uuid.uuid4().hex[:6]}")
+        svc = _spawn([
+            sys.executable, "-m", "ray_tpu.util.client.server",
+            "--address", gcs_address,
+            "--port", str(args.client_server_port),
+            "--ready-file", ready,
+        ], config, "client_server")
+        client_port = int(_wait_ready(ready, svc.proc, "client_server",
+                                      timeout=60))
+        pids.append(svc.proc.pid)
+
     rec = _load_cluster() if not args.head else None
     if rec is None:
         rec = {"gcs_address": gcs_address, "session_dir": session_dir,
                "pids": []}
     rec["pids"].extend(pids)
+    if client_port is not None:
+        rec["client_server_port"] = client_port
     _save_cluster(rec)
 
     role = "head" if args.head else "worker node"
     print(f"started {role}: node {node_id.hex()[:8]} raylet {raylet_addr}")
     print(f"GCS address: {gcs_address}")
+    if client_port is not None:
+        print(f"client server port: {client_port} "
+              f"(ray_tpu.util.client.connect('<host>:{client_port}'))")
     print(f"session dir: {session_dir}")
     print()
     print("connect a driver with:")
@@ -290,6 +319,8 @@ def main(argv=None) -> int:
     p.add_argument("--num-tpus", type=float, default=None)
     p.add_argument("--resources", help="JSON dict of custom resources")
     p.add_argument("--system-config", help="JSON dict of config overrides")
+    p.add_argument("--client-server-port", type=int, default=None,
+                   help="also serve ray-client connections on this port")
     p.set_defaults(fn=cmd_start)
 
     p = sub.add_parser("stop", help="stop the recorded cluster")

@@ -1,0 +1,16 @@
+"""Ray-Client analog: drive a cluster from a process with NO local
+runtime (reference: python/ray/util/client/ARCHITECTURE.md — gRPC proxy
+holding server-side references; here the same architecture over the
+framework's own RPC layer).
+
+    from ray_tpu.util import client
+    ctx = client.connect("host:port")          # ray-tpu client server
+    @ctx.remote
+    def f(x): return x * x
+    ctx.get(f.remote(4))                       # -> 16
+    ctx.disconnect()
+"""
+
+from ray_tpu.util.client.client import ClientContext, connect
+
+__all__ = ["ClientContext", "connect"]

@@ -1,0 +1,224 @@
+"""Client-side API of the Ray-Client analog (reference:
+python/ray/util/client/__init__.py RayAPIStub + worker.py Worker): a
+thin synchronous facade over one RPC connection — NO local runtime, no
+jax, no cluster processes. ObjectRefs and actor handles are opaque
+server-side ids; they pickle as persistent ids inside task args so the
+server rehydrates them to its pinned real objects."""
+
+from __future__ import annotations
+
+import asyncio
+import io
+import pickle
+import threading
+
+import cloudpickle
+
+from ray_tpu._private import rpc
+
+
+class ClientObjectRef:
+    def __init__(self, ctx: "ClientContext", rid: bytes):
+        self._ctx = ctx
+        self._id = rid
+
+    def binary(self) -> bytes:
+        return self._id
+
+    def hex(self) -> str:
+        return self._id.hex()
+
+    def __del__(self):
+        ctx = self._ctx
+        if ctx is not None and not ctx._closed:
+            ctx._release(self._id)
+
+    def __repr__(self):
+        return f"ClientObjectRef({self._id.hex()[:12]})"
+
+
+class _ClientPickler(cloudpickle.Pickler):
+    """Refs/handles travel as persistent ids, not by value."""
+
+    def persistent_id(self, obj):
+        if isinstance(obj, ClientObjectRef):
+            return ("ref", obj._id)
+        if isinstance(obj, ClientActorHandle):
+            return ("actor", obj._actor_id)
+        return None
+
+
+class ClientRemoteFunction:
+    def __init__(self, ctx: "ClientContext", fn_id: bytes, name: str):
+        self._ctx = ctx
+        self._fn_id = fn_id
+        self._name = name
+
+    def remote(self, *args, **kwargs):
+        refs = self._ctx._call("task", {
+            "fn_id": self._fn_id,
+            "args": self._ctx._encode_args(args, kwargs),
+        })["refs"]
+        out = [ClientObjectRef(self._ctx, r) for r in refs]
+        return out[0] if len(out) == 1 else out
+
+
+class _ClientMethod:
+    def __init__(self, handle: "ClientActorHandle", name: str):
+        self._handle = handle
+        self._name = name
+
+    def remote(self, *args, **kwargs):
+        ctx = self._handle._ctx
+        refs = ctx._call("actor_call", {
+            "actor_id": self._handle._actor_id,
+            "method": self._name,
+            "args": ctx._encode_args(args, kwargs),
+        })["refs"]
+        out = [ClientObjectRef(ctx, r) for r in refs]
+        return out[0] if len(out) == 1 else out
+
+
+class ClientActorHandle:
+    def __init__(self, ctx: "ClientContext", actor_id: bytes):
+        self._ctx = ctx
+        self._actor_id = actor_id
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return _ClientMethod(self, name)
+
+    def __repr__(self):
+        return f"ClientActorHandle({self._actor_id.hex()[:12]})"
+
+
+class ClientActorClass:
+    def __init__(self, ctx: "ClientContext", cls, options: dict):
+        self._ctx = ctx
+        self._pickled = cloudpickle.dumps(cls)
+        self._options = options
+
+    def options(self, **opts):
+        return ClientActorClass.__new_from(self, opts)
+
+    @staticmethod
+    def __new_from(parent, opts):
+        new = ClientActorClass.__new__(ClientActorClass)
+        new._ctx = parent._ctx
+        new._pickled = parent._pickled
+        new._options = {**parent._options, **opts}
+        return new
+
+    def remote(self, *args, **kwargs):
+        out = self._ctx._call("create_actor", {
+            "cls": self._pickled,
+            "options": self._options,
+            "args": self._ctx._encode_args(args, kwargs),
+        })
+        return ClientActorHandle(self._ctx, out["actor_id"])
+
+
+class ClientContext:
+    """The `ray_tpu`-shaped surface a connected client drives."""
+
+    def __init__(self, address: str, timeout: float = 10.0):
+        self._loop = rpc.EventLoopThread(name="ray_tpu-client")
+        self._conn = self._loop.run(
+            rpc.connect(address, name="client", timeout=timeout))
+        self._closed = False
+        self._release_buf: list[bytes] = []
+        self._release_lock = threading.Lock()
+
+    # -- plumbing --------------------------------------------------------
+
+    def _call(self, method: str, data: dict):
+        if self._closed:
+            raise ConnectionError("client is disconnected")
+        return self._loop.run(self._conn.call(method, data, timeout=600))
+
+    def _encode_args(self, args, kwargs) -> bytes:
+        buf = io.BytesIO()
+        _ClientPickler(buf, protocol=pickle.DEFAULT_PROTOCOL).dump(
+            (args, kwargs))
+        return buf.getvalue()
+
+    def _release(self, rid: bytes):
+        # Batched + best-effort: __del__ may run at interpreter teardown.
+        try:
+            with self._release_lock:
+                self._release_buf.append(rid)
+                if len(self._release_buf) < 64:
+                    return
+                batch, self._release_buf = self._release_buf, []
+            self._loop.submit(self._conn.call("release", {"refs": batch}))
+        except Exception:
+            pass
+
+    # -- API -------------------------------------------------------------
+
+    def remote(self, *args, **kwargs):
+        """@ctx.remote decorator for functions and classes (mirrors
+        ray_tpu.remote, including option form)."""
+        if len(args) == 1 and not kwargs and callable(args[0]):
+            return self._make_remote(args[0], {})
+        if args:
+            raise TypeError("@remote takes keyword options only")
+
+        def decorator(obj):
+            return self._make_remote(obj, kwargs)
+
+        return decorator
+
+    def _make_remote(self, obj, opts):
+        import inspect
+
+        if inspect.isclass(obj):
+            return ClientActorClass(self, obj, opts)
+        out = self._call("register_function", {
+            "function": cloudpickle.dumps(obj), "options": opts})
+        return ClientRemoteFunction(self, out["fn_id"],
+                                    getattr(obj, "__name__", "fn"))
+
+    def put(self, value) -> ClientObjectRef:
+        out = self._call("put", {"data": cloudpickle.dumps(value)})
+        return ClientObjectRef(self, out["ref"])
+
+    def get(self, refs, timeout: float | None = None):
+        single = isinstance(refs, ClientObjectRef)
+        rlist = [refs] if single else list(refs)
+        out = self._call("get", {"refs": [r._id for r in rlist],
+                                 "timeout": timeout})
+        if "error" in out:
+            raise cloudpickle.loads(out["error"])
+        values = cloudpickle.loads(out["values"])
+        return values[0] if single else values
+
+    def wait(self, refs, *, num_returns: int = 1,
+             timeout: float | None = None):
+        by_id = {r._id: r for r in refs}
+        out = self._call("wait", {"refs": list(by_id),
+                                  "num_returns": num_returns,
+                                  "timeout": timeout})
+        return ([by_id[r] for r in out["ready"]],
+                [by_id[r] for r in out["not_ready"]])
+
+    def kill(self, handle: ClientActorHandle):
+        self._call("kill_actor", {"actor_id": handle._actor_id})
+
+    def cluster_resources(self) -> dict:
+        return self._call("cluster_resources", {})
+
+    def disconnect(self):
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self._loop.run(self._conn.close())
+        except Exception:
+            pass
+        self._loop.stop()
+
+
+def connect(address: str, timeout: float = 10.0) -> ClientContext:
+    return ClientContext(address, timeout=timeout)

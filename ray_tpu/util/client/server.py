@@ -1,0 +1,229 @@
+"""Client server — the cluster-side half of the Ray-Client analog
+(reference: python/ray/util/client/server/server.py RayletServicer):
+holds a real driver CoreWorker, executes proxied API calls, and PINS the
+ObjectRefs / actor handles each client creates so the owner-side
+refcounts survive while the remote client holds them; everything a
+client pinned is released when it disconnects.
+
+Run on (or near) the head node:
+    python -m ray_tpu.util.client.server --address <gcs> --port 10001
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import itertools
+import logging
+
+import cloudpickle
+
+logger = logging.getLogger("ray_tpu.client_server")
+
+
+class _ClientState:
+    def __init__(self):
+        self.refs: dict[bytes, object] = {}       # ref_id -> ObjectRef
+        self.actors: dict[bytes, object] = {}     # actor_id -> handle
+        self.functions: dict[bytes, object] = {}  # fn_id -> RemoteFunction
+
+
+class ClientServer:
+    def __init__(self):
+        import ray_tpu
+        from ray_tpu._private import rpc
+
+        self._ray = ray_tpu
+        self._seq = itertools.count(1)
+        self._clients: dict[object, _ClientState] = {}  # conn -> state
+        self.server = rpc.Server(self._handlers(),
+                                 on_disconnect=self._on_disconnect,
+                                 name="client-server")
+
+    def _handlers(self):
+        return {
+            "put": self.h_put,
+            "get": self.h_get,
+            "wait": self.h_wait,
+            "register_function": self.h_register_function,
+            "task": self.h_task,
+            "create_actor": self.h_create_actor,
+            "actor_call": self.h_actor_call,
+            "kill_actor": self.h_kill_actor,
+            "release": self.h_release,
+            "cluster_resources": self.h_cluster_resources,
+            "ping": lambda conn, d: "pong",
+        }
+
+    # -- bookkeeping -----------------------------------------------------
+
+    def _state(self, conn) -> _ClientState:
+        st = self._clients.get(conn)
+        if st is None:
+            st = self._clients[conn] = _ClientState()
+        return st
+
+    async def _on_disconnect(self, conn):
+        st = self._clients.pop(conn, None)
+        if st is None:
+            return
+        logger.info("client disconnected; releasing %d refs, %d actors",
+                    len(st.refs), len(st.actors))
+        for handle in st.actors.values():
+            try:
+                self._ray.kill(handle)
+            except Exception:
+                pass
+        st.refs.clear()
+
+    def _track_refs(self, st: _ClientState, refs) -> list[bytes]:
+        out = []
+        for ref in refs:
+            rid = ref.id().binary()
+            st.refs[rid] = ref
+            out.append(rid)
+        return out
+
+    def _decode_args(self, st: _ClientState, blob: bytes):
+        """Unpickle (args, kwargs); client-side refs/handles arrive as
+        persistent ids and rehydrate to the server's pinned objects."""
+        import io
+        import pickle
+
+        class _Unpickler(pickle.Unpickler):
+            def persistent_load(self_, pid):
+                kind, key = pid
+                if kind == "ref":
+                    return st.refs[key]
+                if kind == "actor":
+                    return st.actors[key]
+                raise pickle.UnpicklingError(f"unknown pid {kind!r}")
+
+        return _Unpickler(io.BytesIO(blob)).load()
+
+    # -- API surface -----------------------------------------------------
+
+    async def h_put(self, conn, d):
+        st = self._state(conn)
+        value = cloudpickle.loads(d["data"])
+        loop = asyncio.get_running_loop()
+        ref = await loop.run_in_executor(None, self._ray.put, value)
+        return {"ref": self._track_refs(st, [ref])[0]}
+
+    async def h_get(self, conn, d):
+        st = self._state(conn)
+        refs = [st.refs[r] for r in d["refs"]]
+        loop = asyncio.get_running_loop()
+        try:
+            values = await loop.run_in_executor(
+                None, lambda: self._ray.get(refs,
+                                            timeout=d.get("timeout")))
+        except Exception as e:
+            return {"error": cloudpickle.dumps(e)}
+        return {"values": cloudpickle.dumps(values)}
+
+    async def h_wait(self, conn, d):
+        st = self._state(conn)
+        refs = [st.refs[r] for r in d["refs"]]
+        loop = asyncio.get_running_loop()
+        ready, not_ready = await loop.run_in_executor(
+            None, lambda: self._ray.wait(
+                refs, num_returns=d.get("num_returns", 1),
+                timeout=d.get("timeout")))
+        return {"ready": [r.id().binary() for r in ready],
+                "not_ready": [r.id().binary() for r in not_ready]}
+
+    async def h_register_function(self, conn, d):
+        st = self._state(conn)
+        fn = cloudpickle.loads(d["function"])
+        opts = d.get("options") or {}
+        fn_id = next(self._seq).to_bytes(8, "big")
+        st.functions[fn_id] = self._ray.remote(**opts)(fn) if opts \
+            else self._ray.remote(fn)
+        return {"fn_id": fn_id}
+
+    async def h_task(self, conn, d):
+        st = self._state(conn)
+        rf = st.functions[d["fn_id"]]
+        args, kwargs = self._decode_args(st, d["args"])
+        loop = asyncio.get_running_loop()
+        out = await loop.run_in_executor(
+            None, lambda: rf.remote(*args, **kwargs))
+        refs = out if isinstance(out, list) else [out]
+        return {"refs": self._track_refs(st, refs)}
+
+    async def h_create_actor(self, conn, d):
+        st = self._state(conn)
+        cls = cloudpickle.loads(d["cls"])
+        opts = d.get("options") or {}
+        args, kwargs = self._decode_args(st, d["args"])
+        actor_cls = self._ray.remote(**opts)(cls) if opts \
+            else self._ray.remote(cls)
+        loop = asyncio.get_running_loop()
+        handle = await loop.run_in_executor(
+            None, lambda: actor_cls.remote(*args, **kwargs))
+        aid = handle._actor_id.binary()
+        st.actors[aid] = handle
+        return {"actor_id": aid}
+
+    async def h_actor_call(self, conn, d):
+        st = self._state(conn)
+        handle = st.actors[d["actor_id"]]
+        args, kwargs = self._decode_args(st, d["args"])
+        method = getattr(handle, d["method"])
+        loop = asyncio.get_running_loop()
+        out = await loop.run_in_executor(
+            None, lambda: method.remote(*args, **kwargs))
+        refs = out if isinstance(out, list) else [out]
+        return {"refs": self._track_refs(st, refs)}
+
+    async def h_kill_actor(self, conn, d):
+        st = self._state(conn)
+        handle = st.actors.pop(d["actor_id"], None)
+        if handle is not None:
+            self._ray.kill(handle)
+        return True
+
+    async def h_release(self, conn, d):
+        st = self._state(conn)
+        for rid in d["refs"]:
+            st.refs.pop(rid, None)
+        return True
+
+    async def h_cluster_resources(self, conn, d):
+        return self._ray.cluster_resources()
+
+    async def run(self, port: int, ready_file: str | None = None,
+                  host: str = "0.0.0.0"):
+        import os
+
+        # Remote drivers are the whole point: bind all interfaces unless
+        # told otherwise (reference: ray client server binds 0.0.0.0).
+        actual = await self.server.start_tcp(host=host, port=port)
+        logger.info("client server on %s:%d", host, actual)
+        if ready_file:
+            tmp = ready_file + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(str(actual))
+            os.rename(tmp, ready_file)
+        while True:
+            await asyncio.sleep(3600)
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--address", required=True,
+                        help="GCS address of the cluster to front")
+    parser.add_argument("--port", type=int, default=10001)
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--ready-file", default=None)
+    args = parser.parse_args()
+    import ray_tpu
+
+    ray_tpu.init(address=args.address)
+    srv = ClientServer()
+    asyncio.run(srv.run(args.port, args.ready_file, host=args.host))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,105 @@
+"""Ray-Client analog: a separate process with NO local runtime drives the
+cluster through the client server (reference:
+python/ray/util/client/ARCHITECTURE.md; server_test idioms)."""
+
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from ray_tpu.util import client as rc
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def client_server(ray_start_regular, tmp_path):
+    from ray_tpu import api as _api
+
+    gcs = _api._global_node.gcs_address
+    ready = tmp_path / "cs_ready"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu.util.client.server",
+         "--address", gcs, "--port", "0", "--ready-file", str(ready)],
+        cwd=REPO)
+    deadline = time.monotonic() + 60
+    while not ready.exists():
+        assert proc.poll() is None, "client server died"
+        assert time.monotonic() < deadline, "client server not ready"
+        time.sleep(0.05)
+    port = ready.read_text().strip()
+    try:
+        yield f"127.0.0.1:{port}"
+    finally:
+        proc.kill()
+        proc.wait()
+
+
+def test_client_tasks_objects_actors(client_server):
+    ctx = rc.connect(client_server)
+    try:
+        @ctx.remote
+        def square(x):
+            return x * x
+
+        assert ctx.get(square.remote(7)) == 49
+        refs = [square.remote(i) for i in range(8)]
+        assert ctx.get(refs) == [i * i for i in range(8)]
+
+        # objects: put / get / pass-by-ref into tasks
+        big = ctx.put(np.arange(100_000))
+
+        @ctx.remote
+        def total(arr):
+            return int(arr.sum())
+
+        assert ctx.get(total.remote(big)) == sum(range(100_000))
+
+        # wait
+        ready, not_ready = ctx.wait(refs, num_returns=len(refs),
+                                    timeout=30)
+        assert len(ready) == 8 and not not_ready
+
+        # actors end-to-end, handle passed back into a task arg
+        @ctx.remote
+        class Counter:
+            def __init__(self, start):
+                self.v = start
+
+            def add(self, n):
+                self.v += n
+                return self.v
+
+        c = Counter.remote(10)
+        assert ctx.get(c.add.remote(5)) == 15
+
+        @ctx.remote
+        def bump(counter):
+            # runs ON the cluster with a real handle
+            import ray_tpu
+
+            return ray_tpu.get(counter.add.remote(1))
+
+        assert ctx.get(bump.remote(c)) == 16
+        ctx.kill(c)
+
+        assert ctx.cluster_resources().get("CPU") == 4
+    finally:
+        ctx.disconnect()
+
+
+def test_client_error_propagation(client_server):
+    ctx = rc.connect(client_server)
+    try:
+        @ctx.remote
+        def boom():
+            raise ValueError("kaboom")
+
+        with pytest.raises(Exception) as ei:
+            ctx.get(boom.remote())
+        assert "kaboom" in str(ei.value)
+    finally:
+        ctx.disconnect()

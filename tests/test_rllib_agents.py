@@ -166,3 +166,29 @@ def test_impala_learns_cartpole(ray_start_shared):
     assert best > 60, f"IMPALA failed to learn CartPole (best={best})"
     assert steps_per_s > 0
     assert trained > 3000
+
+
+def test_model_catalog_fcnet_and_convnet():
+    """reference: rllib/models/catalog.py:167 — space-driven model pick."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.rllib.models import ModelCatalog
+
+    class Box:
+        def __init__(self, shape):
+            self.shape = shape
+
+    init, apply = ModelCatalog.get_model(Box((7,)), 4)
+    params = init(jax.random.key(0))
+    out = apply(params, jnp.ones((5, 7)))
+    assert out.shape == (5, 4)
+
+    init, apply = ModelCatalog.get_model(Box((42, 42, 3)), 6)
+    params = init(jax.random.key(0))
+    obs = jnp.ones((2, 42, 42, 3))
+    out = apply(params, obs)
+    assert out.shape == (2, 6)
+    # trainable end-to-end: grads flow through the conv stack
+    g = jax.grad(lambda p: apply(p, obs).sum())(params)
+    assert jnp.abs(g["conv"][0]["w"]).sum() > 0
