@@ -1,0 +1,9 @@
+"""ray_tpu.streaming — dataflow pipelines over actor stages (the
+streaming-engine capability the reference ships as ray/streaming:
+StreamingContext -> DataStream.map/flat_map/filter/key_by/reduce/sink
+compiled to parallel stage actors with hash partitioning, credit-based
+backpressure, and EOS-propagated completion)."""
+
+from ray_tpu.streaming.streaming import DataStream, StreamingContext
+
+__all__ = ["DataStream", "StreamingContext"]

@@ -1,0 +1,295 @@
+"""Streaming dataflow on actors.
+
+Role parity with the reference's streaming engine (reference:
+streaming/python — StreamingContext, DataStream, KeyDataStream,
+word-count e2e in its tests), redesigned for this runtime instead of the
+reference's C++ DataWriter/DataReader channels:
+
+- logical graph: chained operators, each with its own parallelism;
+- physical graph: one actor per operator instance; records flow as
+  BATCHES through direct actor calls (the object plane IS the channel);
+- partitioning: round-robin for stateless edges, hash-of-key after
+  key_by (so each reducer instance owns a key shard);
+- backpressure: each pusher keeps at most `max_inflight` unacked batch
+  calls per downstream instance (credit window over ray_tpu.wait);
+- completion: sources emit EOS; every stage forwards EOS downstream
+  once ALL of its upstream instances finished; reducers flush their
+  per-key state on EOS (so finite pipelines behave like batch jobs);
+- results: sink() collects into sink actors the driver drains at the
+  end of run().
+"""
+
+from __future__ import annotations
+
+import cloudpickle
+
+import ray_tpu
+
+_EOS = "__ray_tpu_stream_eos__"
+
+
+def _stable_hash(key) -> int:
+    """Partitioning hash that is stable ACROSS PROCESSES (python's hash()
+    is per-process randomized for strings — stage actors are separate
+    workers, so it must never be used for routing)."""
+    import pickle
+    import zlib
+
+    if isinstance(key, int):
+        return key & 0x7FFFFFFF
+    return zlib.crc32(pickle.dumps(key, protocol=4))
+
+
+def _sliced_source(src, index: int, parallelism: int):
+    """Parallel generator sources: each instance re-evaluates the source
+    callable and reads its stride, so gen_fn MUST be deterministic and
+    repeatable (one-shot sources — queues, sockets — need parallelism 1;
+    collections are sliced driver-side instead)."""
+    def gen():
+        import itertools
+
+        return itertools.islice(src(), index, None, parallelism)
+
+    return gen
+
+
+class _StageActor:
+    """One parallel instance of one operator."""
+
+    def __init__(self, op_pickled: bytes, index: int, num_upstream: int,
+                 stall_timeout: float = 300.0):
+        kind, fn = cloudpickle.loads(op_pickled)
+        self._kind = kind
+        self._fn = fn
+        self._index = index
+        self._eos_left = num_upstream
+        self._downstream = None          # list[handle] | None
+        self._partitioned = False
+        self._max_inflight = 8
+        self._stall_timeout = stall_timeout
+        self._inflight = {}              # id(handle) -> [refs]
+        self._state = {}                 # reduce: key -> aggregate
+        self._out = []                   # sink: collected records
+        self._rr = -1
+
+    def connect(self, downstream, partitioned: bool):
+        self._downstream = list(downstream)
+        self._partitioned = partitioned
+        return True
+
+    # -- pushing with credit-based backpressure --------------------------
+
+    def _push(self, target, batch):
+        key = id(target)
+        refs = self._inflight.setdefault(key, [])
+        while len(refs) >= self._max_inflight:
+            ready, rest = ray_tpu.wait(refs, num_returns=1,
+                                       timeout=self._stall_timeout)
+            if not ready:
+                raise TimeoutError("downstream stage stalled")
+            # Surface downstream failures NOW: an errored ack raises here
+            # and the exception cascades back through the chain to run()
+            # instead of silently dropping data.
+            ray_tpu.get(ready)
+            self._inflight[key] = refs = rest
+        refs.append(target.process.remote(batch))
+
+    def _emit(self, records):
+        if not records or self._downstream is None:
+            return
+        if self._partitioned:
+            buckets: dict[int, list] = {}
+            n = len(self._downstream)
+            for rec in records:
+                buckets.setdefault(_stable_hash(rec[0]) % n, []).append(rec)
+            for i, batch in buckets.items():
+                self._push(self._downstream[i], batch)
+        else:
+            # round-robin by batch
+            self._rr = (self._rr + 1) % len(self._downstream)
+            self._push(self._downstream[self._rr], records)
+
+    def _flush_and_forward_eos(self):
+        if self._kind == "reduce" and self._downstream is not None:
+            items = list(self._state.items())
+            for i in range(0, len(items), 256):
+                self._emit(items[i:i + 256])
+            self._state = {}
+        if self._downstream is not None:
+            for target in self._downstream:
+                # EOS must arrive AFTER the data already in flight: the
+                # per-target call order guarantees it.
+                self._push(target, _EOS)
+            for refs in self._inflight.values():
+                ray_tpu.get(refs, timeout=self._stall_timeout)
+            self._inflight = {}
+
+    # -- operator semantics ----------------------------------------------
+
+    def process(self, batch):
+        if isinstance(batch, str) and batch == _EOS:
+            self._eos_left -= 1
+            if self._eos_left == 0:
+                self._flush_and_forward_eos()
+            return True
+        kind, fn = self._kind, self._fn
+        if kind == "map":
+            out = [fn(x) for x in batch]
+        elif kind == "flat_map":
+            out = [y for x in batch for y in fn(x)]
+        elif kind == "filter":
+            out = [x for x in batch if fn(x)]
+        elif kind == "key_by":
+            out = [(fn(x), x) for x in batch]
+        elif kind == "reduce":
+            for key, value in batch:
+                if key in self._state:
+                    self._state[key] = fn(self._state[key], value)
+                else:
+                    self._state[key] = value
+            return True  # emits on EOS flush
+        elif kind == "sink":
+            for x in batch:
+                self._out.append(fn(x) if fn is not None else x)
+            return True
+        else:
+            raise ValueError(f"unknown operator kind {kind!r}")
+        self._emit(out)
+        return True
+
+    def drain_source(self, batch_size: int = 128):
+        """Source instances: pull from the user iterable and push."""
+        it = self._fn() if callable(self._fn) else iter(self._fn)
+        buf = []
+        for item in it:
+            buf.append(item)
+            if len(buf) >= batch_size:
+                self._emit(buf)
+                buf = []
+        if buf:
+            self._emit(buf)
+        self._flush_and_forward_eos()
+        return True
+
+    def collect(self):
+        out, self._out = self._out, []
+        return out
+
+
+class _Op:
+    def __init__(self, kind: str, fn, parallelism: int = 1):
+        self.kind = kind
+        self.fn = fn
+        self.parallelism = parallelism
+
+
+class DataStream:
+    """Lazy operator chain (reference: streaming DataStream /
+    KeyDataStream surface)."""
+
+    def __init__(self, ctx: "StreamingContext", ops: list[_Op]):
+        self._ctx = ctx
+        self._ops = ops
+
+    def _chain(self, op: _Op) -> "DataStream":
+        return DataStream(self._ctx, self._ops + [op])
+
+    def set_parallelism(self, n: int) -> "DataStream":
+        self._ops[-1].parallelism = n
+        return self
+
+    def map(self, fn) -> "DataStream":
+        return self._chain(_Op("map", fn))
+
+    def flat_map(self, fn) -> "DataStream":
+        return self._chain(_Op("flat_map", fn))
+
+    def filter(self, fn) -> "DataStream":
+        return self._chain(_Op("filter", fn))
+
+    def key_by(self, fn) -> "DataStream":
+        return self._chain(_Op("key_by", fn))
+
+    def reduce(self, fn) -> "DataStream":
+        return self._chain(_Op("reduce", fn))
+
+    def sink(self, fn=None) -> "StreamingContext":
+        self._ctx._pipelines.append(self._ops + [_Op("sink", fn)])
+        return self._ctx
+
+
+class StreamingContext:
+    def __init__(self, batch_size: int = 128,
+                 stall_timeout: float = 300.0):
+        """stall_timeout bounds every intra-pipeline wait (backpressure,
+        EOS flush) inside the stage actors; run(timeout=...) bounds the
+        driver-side end-to-end drive."""
+        self._pipelines: list[list[_Op]] = []
+        self._batch_size = batch_size
+        self._stall_timeout = stall_timeout
+
+    # -- sources ---------------------------------------------------------
+
+    def from_collection(self, items) -> DataStream:
+        return DataStream(self, [_Op("source", list(items))])
+
+    def source(self, gen_fn) -> DataStream:
+        """gen_fn() -> iterable (evaluated inside the source actor)."""
+        return DataStream(self, [_Op("source", gen_fn)])
+
+    # -- execution -------------------------------------------------------
+
+    def run(self, timeout: float = 300.0) -> list:
+        """Build the actor DAG, run every pipeline to completion, and
+        return the concatenated sink outputs."""
+        results = []
+        for ops in self._pipelines:
+            results.extend(self._run_one(ops, timeout))
+        return results
+
+    def _run_one(self, ops: list[_Op], timeout: float) -> list:
+        stage_cls = ray_tpu.remote(_StageActor)
+        # instantiate every stage, then wire edges, then drive sources
+        stages: list[list] = []
+        for i, op in enumerate(ops):
+            num_up = 1 if i == 0 else ops[i - 1].parallelism
+            row = []
+            for j in range(op.parallelism):
+                fn = op.fn
+                if op.kind == "source" and op.parallelism > 1:
+                    if callable(fn):
+                        fn = _sliced_source(fn, j, op.parallelism)
+                    else:  # collection: slice driver-side, ship the slice
+                        fn = list(fn)[j::op.parallelism]
+                pickled = cloudpickle.dumps((op.kind, fn))
+                row.append(stage_cls.remote(pickled, j, num_up,
+                                            self._stall_timeout))
+            stages.append(row)
+        # wire edges; the edge INTO the op after key_by is hash-partitioned
+        wiring = []
+        for i in range(len(ops) - 1):
+            partitioned = ops[i].kind == "key_by"
+            for inst in stages[i]:
+                wiring.append(inst.connect.remote(stages[i + 1],
+                                                  partitioned))
+        try:
+            ray_tpu.get(wiring, timeout=min(60.0, timeout))
+            # drive sources to completion (EOS cascades through the chain)
+            ray_tpu.get([s.drain_source.remote(self._batch_size)
+                         for s in stages[0]], timeout=timeout)
+            # EOS has reached the sinks only after every intermediate
+            # actor acked; collect sink outputs
+            out = []
+            for sink in stages[-1]:
+                out.extend(ray_tpu.get(sink.collect.remote(),
+                                       timeout=min(60.0, timeout)))
+            return out
+        finally:
+            # Failed runs must not leak the actor DAG (worker processes
+            # plus buffered reduce/sink state).
+            for row in stages:
+                for inst in row:
+                    try:
+                        ray_tpu.kill(inst)
+                    except Exception:
+                        pass
