@@ -192,8 +192,34 @@ def _run_child(mode, env_extra, timeout, expect):
     return None
 
 
+def _probe_tpu(timeout: float = 420.0) -> bool:
+    """The axon tunnel can wedge for hours (bare jax.devices() hangs).
+    One bounded matmul probe decides whether the TPU attempt is worth
+    the child timeouts at all. The budget covers a COLD healthy tunnel
+    (runtime init can take minutes) — only a truly wedged one fails it —
+    and the probe shares the children's compilation cache."""
+    if not _tpu_visible():
+        return False
+    code = ("import jax, jax.numpy as jnp;"
+            "x = jnp.ones((256, 256), jnp.bfloat16);"
+            "print(float((x @ x).sum()))")
+    env = dict(os.environ)
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/ray_tpu/jax_cache")
+    try:
+        out = subprocess.run([sys.executable, "-c", code],
+                             env=env, timeout=timeout,
+                             capture_output=True, text=True)
+        return out.returncode == 0
+    except subprocess.TimeoutExpired:
+        sys.stderr.write("TPU probe timed out; falling back to CPU\n")
+        return False
+
+
 def _supervise():
-    for env_extra, timeout in (({}, 900), ({"JAX_PLATFORMS": "cpu"}, 600)):
+    attempts = [({}, 900), ({"JAX_PLATFORMS": "cpu"}, 600)]
+    if not _probe_tpu():
+        attempts = attempts[1:]
+    for env_extra, timeout in attempts:
         fw = _run_child("--inner-framework", env_extra, timeout,
                         "_framework_img_s")
         if fw is None:

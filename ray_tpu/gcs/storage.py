@@ -43,12 +43,20 @@ class GcsStorage:
         self._wal_path = os.path.join(dir_path, "wal.bin")
         self._lock = threading.Lock()
         self.tables: dict[str, dict] = {}
-        self._load()
+        valid_end = self._load()
+        if valid_end is not None:
+            # A crash mid-append left a torn frame: cut it off BEFORE
+            # appending, or every later (valid) record would sit behind
+            # the garbage and be discarded on the next recovery.
+            with open(self._wal_path, "ab") as f:
+                f.truncate(valid_end)
         self._wal = open(self._wal_path, "ab")
 
     # -- recovery ------------------------------------------------------
 
-    def _load(self):
+    def _load(self) -> int | None:
+        """Replay snapshot+WAL. Returns the WAL offset of a torn tail (to
+        truncate at), or None when the WAL is clean."""
         if os.path.exists(self._snap_path):
             with open(self._snap_path, "rb") as f:
                 raw = msgpack.unpackb(f.read(), raw=False, strict_map_key=False)
@@ -61,16 +69,30 @@ class GcsStorage:
                 (length,) = _HDR.unpack_from(data, off)
                 end = off + _HDR.size + length
                 if end > len(data):
-                    break  # torn tail from a crash mid-append: discard
-                op, table, key, value = msgpack.unpackb(
-                    data[off + _HDR.size:end], raw=False,
-                    strict_map_key=False)
+                    return off  # torn tail from a crash mid-append
+                try:
+                    op, table, key, value = msgpack.unpackb(
+                        data[off + _HDR.size:end], raw=False,
+                        strict_map_key=False)
+                except Exception:
+                    if end == len(data):
+                        return off  # last frame garbled: tail crash
+                    # Corruption MID-file with valid (possibly fsynced)
+                    # records after it: truncating would silently destroy
+                    # durable state — fail loudly instead.
+                    raise RuntimeError(
+                        f"GCS WAL corrupt at offset {off} with "
+                        f"{len(data) - end} bytes after it; refusing to "
+                        f"auto-truncate (inspect {self._wal_path})")
                 tbl = self.tables.setdefault(table, {})
                 if op == PUT:
                     tbl[key] = value
                 else:
                     tbl.pop(key, None)
                 off = end
+            if off != len(data):
+                return off  # trailing partial header
+        return None
 
     # -- mutation ------------------------------------------------------
 
