@@ -2,18 +2,24 @@
 reference: rllib/). JAX policies with jitted learner steps; CPU rollout
 actors feed the (TPU) learner."""
 
-from ray_tpu.rllib.agents import (DQNTrainer, ImpalaTrainer, PPOTrainer,
-                                  Trainer, build_trainer)
-from ray_tpu.rllib.env import make_env, register_env
+from ray_tpu.rllib.agents import (A3CTrainer, DQNTrainer, ImpalaTrainer,
+                                  PGTrainer, PPOTrainer, Trainer,
+                                  build_trainer)
+from ray_tpu.rllib.env import (MultiAgentEnv, make_env, register_env)
 from ray_tpu.rllib.execution import (LearnerThread, PrioritizedReplayBuffer,
                                      ReplayBuffer)
-from ray_tpu.rllib.policy import JAXPolicy, Policy, SampleBatch
+from ray_tpu.rllib.policy import (JAXPolicy, MultiAgentBatch, Policy,
+                                  SampleBatch)
 
 __all__ = [
+    "A3CTrainer",
     "DQNTrainer",
     "ImpalaTrainer",
     "JAXPolicy",
     "LearnerThread",
+    "MultiAgentBatch",
+    "MultiAgentEnv",
+    "PGTrainer",
     "PPOTrainer",
     "Policy",
     "PrioritizedReplayBuffer",

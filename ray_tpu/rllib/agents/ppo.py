@@ -125,30 +125,47 @@ def ppo_loss(params, batch, policy: PPOPolicy):
     }
 
 
+def _sgd_epochs(policy, batch: SampleBatch, config, rng) -> dict:
+    metrics: dict = {}
+    for _ in range(config["num_sgd_iter"]):
+        for mb in batch.minibatches(config["sgd_minibatch_size"], rng):
+            metrics = policy.learn_on_batch(mb)
+    return metrics
+
+
 def ppo_train_step(workers, config) -> dict:
     """Collect → minibatch SGD epochs → broadcast (reference:
     ppo.py:238 execution_plan = ParallelRollouts → TrainOneStep)."""
+    from ray_tpu.rllib.policy.sample_batch import MultiAgentBatch
+
     target = config["train_batch_size"]
     batches = []
     collected = 0
     while collected < target:
         b = workers.sample(config["rollout_fragment_length"])
         batches.append(b)
-        collected += len(b)
-    batch = SampleBatch.concat_samples(batches)
-
-    policy = workers.local_worker.policy
-    metrics: dict = {}
+        collected += (b.count if isinstance(b, MultiAgentBatch)
+                      else len(b))
     # One shuffle stream per worker set (not per call, and not stashed in
     # the user-visible config) so minibatch composition decorrelates
     # across iterations.
     rng = _shuffle_rng(workers, config.get("seed", 0))
-    for _ in range(config["num_sgd_iter"]):
-        for mb in batch.minibatches(config["sgd_minibatch_size"], rng):
-            metrics = policy.learn_on_batch(mb)
+    lw = workers.local_worker
+    if isinstance(batches[0], MultiAgentBatch):
+        batch = MultiAgentBatch.concat_samples(batches)
+        metrics = {
+            pid: _sgd_epochs(lw.policies[pid],
+                             batch.policy_batches[pid], config, rng)
+            for pid in lw.policies_to_train
+            if pid in batch.policy_batches}
+        metrics["num_env_steps_trained"] = batch.count
+    else:
+        batch = SampleBatch.concat_samples(batches)
+        metrics = _sgd_epochs(lw.policy, batch, config, rng)
+        metrics["num_env_steps_trained"] = len(batch)
     workers.sync_weights()
-    metrics["num_env_steps_trained"] = len(batch)
     return metrics
 
 
-PPOTrainer = build_trainer("PPO", PPO_CONFIG, PPOPolicy, ppo_train_step)
+PPOTrainer = build_trainer("PPO", PPO_CONFIG, PPOPolicy, ppo_train_step,
+                           supports_multiagent=True)

@@ -39,9 +39,18 @@ class Trainer(Trainable):
         merged = {**COMMON_CONFIG, **self._default_config, **config}
         super().__init__(merged)
 
+    # trainers whose train_step understands MultiAgentBatch set this
+    _supports_multiagent = False
+
     def setup(self, config: dict):
         if config.get("env") is None:
             raise ValueError("config['env'] must be set")
+        if (config.get("multiagent", {}).get("policies")
+                and not self._supports_multiagent):
+            raise ValueError(
+                f"{self._name} does not support config['multiagent'] "
+                "(its train step consumes single-policy SampleBatches); "
+                "use PPO or write a custom train_step")
         self.workers = WorkerSet(
             config["env"], type(self).policy_builder, config,
             num_workers=config.get("num_workers", 0))
@@ -69,8 +78,21 @@ class Trainer(Trainable):
         self.workers.local_worker.set_weights(state["weights"])
         self.workers.sync_weights()
 
-    def get_policy(self):
-        return self.workers.local_worker.policy
+    def get_policy(self, policy_id: str | None = None):
+        lw = self.workers.local_worker
+        policies = getattr(lw, "policies", None)
+        if policy_id is not None:
+            if policies is None:
+                raise ValueError(
+                    "policy_id given but this is a single-policy trainer")
+            return policies[policy_id]
+        if policies is None:
+            return lw.policy
+        if len(policies) == 1:
+            return next(iter(policies.values()))
+        raise ValueError(
+            f"multi-agent trainer has policies {sorted(policies)}; "
+            "pass get_policy(policy_id=...)")
 
     def compute_action(self, obs, explore: bool = False):
         import numpy as np
@@ -85,12 +107,14 @@ class Trainer(Trainable):
 
 def build_trainer(name: str, default_config: dict,
                   policy_builder: Callable,
-                  train_step: Callable) -> type:
+                  train_step: Callable,
+                  supports_multiagent: bool = False) -> type:
     """reference: rllib/agents/trainer_template.py:build_trainer."""
 
     cls = type(name, (Trainer,), {
         "_name": name,
         "_default_config": default_config,
+        "_supports_multiagent": supports_multiagent,
         "policy_builder": staticmethod(policy_builder),
         "train_step": lambda self: train_step(self.workers, self.config),
     })

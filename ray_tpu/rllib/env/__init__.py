@@ -8,9 +8,34 @@ from typing import Callable
 _ENV_REGISTRY: dict[str, Callable] = {}
 
 
+class MultiAgentEnv:
+    """Multi-agent env interface (reference: rllib/env/multi_agent_env.py).
+
+    reset() -> (obs_dict, info_dict); step(action_dict) ->
+    (obs_dict, reward_dict, terminated_dict, truncated_dict, info_dict).
+    Dicts are keyed by agent id; terminated/truncated carry the special
+    "__all__" key ending the episode for everyone. Agents may appear and
+    disappear between steps — only agents present in obs act next step."""
+
+    observation_space = None
+    action_space = None
+
+    def reset(self, seed=None):
+        raise NotImplementedError
+
+    def step(self, action_dict: dict):
+        raise NotImplementedError
+
+    def close(self):
+        pass
+
+
 def register_env(name: str, creator: Callable):
     """register_env("my_env", lambda config: MyEnv(config))"""
     _ENV_REGISTRY[name] = creator
+
+
+__all__ = ["MultiAgentEnv", "make_env", "register_env"]
 
 
 def make_env(env_spec, env_config: dict | None = None):

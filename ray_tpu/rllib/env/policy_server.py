@@ -1,0 +1,173 @@
+"""External-simulator serving (reference: rllib/env/policy_server_input.py
+PolicyServerInput + rllib/env/policy_client.py PolicyClient).
+
+An external process (a game, a robot, a production system) drives episodes
+against a policy hosted over HTTP; the server side accumulates the
+resulting trajectories as SampleBatches that a trainer can consume as an
+input reader. Transport is plain JSON over a threaded http.server (no
+asyncio requirement on the simulator side)."""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from ray_tpu.rllib.policy.sample_batch import SampleBatch
+
+__all__ = ["PolicyClient", "PolicyServerInput"]
+
+
+class PolicyServerInput:
+    """Host `policy` on http://host:port; acts as an input reader:
+    next() blocks until a completed episode batch is available."""
+
+    def __init__(self, policy, address: str = "127.0.0.1", port: int = 0):
+        self.policy = policy
+        self._episodes: "queue.Queue[SampleBatch]" = queue.Queue()
+        self._live: dict = {}  # episode_id -> column buffers
+        self._lock = threading.Lock()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                req = json.loads(self.rfile.read(length))
+                try:
+                    resp = outer._handle(req)
+                    body = json.dumps(resp).encode()
+                    self.send_response(200)
+                except Exception as e:  # surfaced to the client
+                    body = json.dumps({"error": str(e)}).encode()
+                    self.send_response(500)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._server = ThreadingHTTPServer((address, port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+
+    # -- protocol --------------------------------------------------------
+
+    def _handle(self, req: dict) -> dict:
+        cmd = req["command"]
+        if cmd == "start_episode":
+            eid = req["episode_id"]
+            with self._lock:
+                self._live[eid] = {k: [] for k in (
+                    SampleBatch.OBS, SampleBatch.ACTIONS,
+                    SampleBatch.REWARDS, SampleBatch.DONES,
+                    SampleBatch.ACTION_LOGP, SampleBatch.VF_PREDS)}
+            return {"ok": True}
+        if cmd == "get_action":
+            eid = req["episode_id"]
+            obs = np.asarray(req["observation"], np.float32).ravel()
+            actions, extra = self.policy.compute_actions(obs[None])
+            with self._lock:
+                buf = self._live[eid]
+                buf[SampleBatch.OBS].append(obs)
+                buf[SampleBatch.ACTIONS].append(actions[0])
+                buf[SampleBatch.ACTION_LOGP].append(
+                    extra[SampleBatch.ACTION_LOGP][0])
+                buf[SampleBatch.VF_PREDS].append(
+                    extra[SampleBatch.VF_PREDS][0])
+            act = actions[0]
+            return {"action": act.tolist() if hasattr(act, "tolist")
+                    else act}
+        if cmd == "log_returns":
+            with self._lock:
+                self._live[req["episode_id"]][SampleBatch.REWARDS].append(
+                    float(req["reward"]))
+            return {"ok": True}
+        if cmd == "end_episode":
+            eid = req["episode_id"]
+            with self._lock:
+                buf = self._live.pop(eid)
+            n = len(buf[SampleBatch.ACTIONS])
+            rewards = buf[SampleBatch.REWARDS][:n]
+            rewards += [0.0] * (n - len(rewards))
+            if n:
+                dones = [False] * (n - 1) + [True]
+                batch = SampleBatch({
+                    SampleBatch.OBS: np.stack(buf[SampleBatch.OBS]),
+                    SampleBatch.ACTIONS: np.asarray(
+                        buf[SampleBatch.ACTIONS]),
+                    SampleBatch.REWARDS: np.asarray(rewards, np.float32),
+                    SampleBatch.DONES: np.asarray(dones),
+                    SampleBatch.ACTION_LOGP: np.asarray(
+                        buf[SampleBatch.ACTION_LOGP], np.float32),
+                    SampleBatch.VF_PREDS: np.asarray(
+                        buf[SampleBatch.VF_PREDS], np.float32),
+                    SampleBatch.EPS_ID: np.full(n, hash(eid) % (2**31)),
+                })
+                self._episodes.put(batch)
+            return {"ok": True}
+        raise ValueError(f"unknown command {cmd!r}")
+
+    # -- input-reader surface -------------------------------------------
+
+    def next(self, timeout: float | None = 60) -> SampleBatch:
+        return self._episodes.get(timeout=timeout)
+
+    def stop(self):
+        self._server.shutdown()
+        self._thread.join(timeout=5)
+
+
+class PolicyClient:
+    """Client for an external simulator process (reference:
+    rllib/env/policy_client.py:31)."""
+
+    def __init__(self, address: str):
+        self.address = address.rstrip("/")
+        self._next_eid = 0
+
+    def _call(self, payload: dict) -> dict:
+        req = urllib.request.Request(
+            self.address, data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                out = json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            # surface the server-side exception message, not a bare 500
+            try:
+                detail = json.loads(e.read()).get("error", str(e))
+            except Exception:
+                detail = str(e)
+            raise RuntimeError(f"policy server error: {detail}") from None
+        if "error" in out:
+            raise RuntimeError(out["error"])
+        return out
+
+    def start_episode(self, episode_id: str | None = None) -> str:
+        if episode_id is None:
+            episode_id = f"client-{id(self)}-{self._next_eid}"
+            self._next_eid += 1
+        self._call({"command": "start_episode",
+                    "episode_id": episode_id})
+        return episode_id
+
+    def get_action(self, episode_id: str, observation):
+        obs = np.asarray(observation, np.float32)
+        out = self._call({"command": "get_action",
+                          "episode_id": episode_id,
+                          "observation": obs.tolist()})
+        return out["action"]
+
+    def log_returns(self, episode_id: str, reward: float):
+        self._call({"command": "log_returns", "episode_id": episode_id,
+                    "reward": float(reward)})
+
+    def end_episode(self, episode_id: str):
+        self._call({"command": "end_episode", "episode_id": episode_id})

@@ -39,6 +39,18 @@ class RolloutWorker:
         builder = cloudpickle.loads(policy_builder)
         self.policy = builder(self.envs[0].observation_space,
                               self.envs[0].action_space, self.config)
+        # offline IO (reference: rollout_worker.py input_creator/
+        # output_creator wiring of rllib/offline/)
+        self._output_writer = None
+        if self.config.get("output"):
+            from ray_tpu.rllib.offline import JsonWriter
+
+            self._output_writer = JsonWriter(self.config["output"])
+        self._input_reader = None
+        if self.config.get("input") and self.config["input"] != "sampler":
+            from ray_tpu.rllib.offline import JsonReader
+
+            self._input_reader = JsonReader(self.config["input"])
 
     def sample(self, num_steps: int | None = None) -> SampleBatch:
         """Collect `num_steps` total env steps (across the env vector).
@@ -47,6 +59,8 @@ class RolloutWorker:
         time) so split_by_episode/GAE see real trajectories. DONES means
         *terminated*: truncated episodes reset the env but keep
         dones=False so GAE bootstraps their tail with the value fn."""
+        if self._input_reader is not None:
+            return self._input_reader.next()
         horizon = num_steps or self.config.get("rollout_fragment_length",
                                                200)
         n = len(self.envs)
@@ -96,12 +110,28 @@ class RolloutWorker:
         batch = SampleBatch.concat_samples([
             SampleBatch({k: np.asarray(v) for k, v in cols.items()})
             for cols in per_env])
-        return self.policy.postprocess_trajectory(batch)
+        batch = self.policy.postprocess_trajectory(batch)
+        if self._output_writer is not None:
+            self._output_writer.write(batch)
+        return batch
 
     # -- learner/weights plumbing ---------------------------------------
 
     def learn_on_batch(self, batch: SampleBatch) -> dict:
         return self.policy.learn_on_batch(batch)
+
+    def sample_and_gradients(self, num_steps: int | None = None):
+        """Sample a fragment and compute (but don't apply) gradients on it
+        — the A3C async-gradients unit (reference:
+        execution/rollout_ops.py:92 AsyncGradients)."""
+        batch = self.sample(num_steps)
+        grads, info = self.policy.compute_gradients(batch)
+        info["batch_count"] = batch.count
+        return grads, info
+
+    def apply_gradients(self, grads):
+        self.policy.apply_gradients(grads)
+        return True
 
     def get_weights(self):
         return self.policy.get_weights()
@@ -122,6 +152,8 @@ class RolloutWorker:
         return out
 
     def stop(self):
+        if self._output_writer is not None:
+            self._output_writer.close()
         for env in self.envs:
             try:
                 env.close()

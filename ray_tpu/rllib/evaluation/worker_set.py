@@ -7,21 +7,37 @@ from __future__ import annotations
 import cloudpickle
 
 import ray_tpu
+from ray_tpu.rllib.evaluation.multi_agent import MultiAgentRolloutWorker
 from ray_tpu.rllib.evaluation.rollout_worker import RolloutWorker
-from ray_tpu.rllib.policy.sample_batch import SampleBatch
+from ray_tpu.rllib.policy.sample_batch import (MultiAgentBatch,
+                                               SampleBatch)
 
 
 class WorkerSet:
     def __init__(self, env_spec, policy_builder, config: dict,
                  num_workers: int = 0):
-        pickled_builder = cloudpickle.dumps(policy_builder)
-        self.local_worker = RolloutWorker(env_spec, pickled_builder, config,
-                                          worker_index=0)
+        ma = config.get("multiagent") or {}
+        if ma.get("policies"):
+            worker_cls = MultiAgentRolloutWorker
+            # spec carries the callables (builders, mapping fn); strip it
+            # from the plain config dict shipped to remote actors
+            config = {k: v for k, v in config.items() if k != "multiagent"}
+            pickled = cloudpickle.dumps({
+                "policies": {pid: (spec[0] or policy_builder, *spec[1:])
+                             for pid, spec in ma["policies"].items()},
+                "policy_mapping_fn": ma["policy_mapping_fn"],
+                "policies_to_train": ma.get("policies_to_train"),
+            })
+        else:
+            worker_cls = RolloutWorker
+            pickled = cloudpickle.dumps(policy_builder)
+        self.local_worker = worker_cls(env_spec, pickled, config,
+                                       worker_index=0)
         remote_cls = ray_tpu.remote(
             resources={"CPU": config.get("num_cpus_per_worker", 1)})(
-            RolloutWorker)
+            worker_cls)
         self.remote_workers = [
-            remote_cls.remote(env_spec, pickled_builder, config, i + 1)
+            remote_cls.remote(env_spec, pickled, config, i + 1)
             for i in range(num_workers)
         ]
 
@@ -41,6 +57,8 @@ class WorkerSet:
         batches = ray_tpu.get(
             [w.sample.remote(num_steps) for w in self.remote_workers],
             timeout=600)
+        if batches and isinstance(batches[0], MultiAgentBatch):
+            return MultiAgentBatch.concat_samples(batches)
         return SampleBatch.concat_samples(batches)
 
     def collect_metrics(self) -> dict:

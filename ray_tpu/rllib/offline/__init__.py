@@ -1,0 +1,175 @@
+"""Offline RL IO (reference: rllib/offline/ — json_writer.py JsonWriter,
+json_reader.py JsonReader, is_estimator.py ImportanceSampling,
+wis_estimator.py WeightedImportanceSampling, off_policy_estimator.py).
+
+Batches are stored as JSON lines; numpy columns round-trip via nested
+lists + dtype tags so files are greppable and language-neutral."""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+from typing import Iterator
+
+import numpy as np
+
+from ray_tpu.rllib.policy.sample_batch import SampleBatch
+
+__all__ = ["ImportanceSampling", "JsonReader", "JsonWriter",
+           "WeightedImportanceSampling"]
+
+
+def _encode(batch: SampleBatch) -> str:
+    return json.dumps({
+        k: {"dtype": str(v.dtype), "data": v.tolist()}
+        for k, v in batch.items() if v.dtype != object
+    })
+
+
+def _decode(line: str) -> SampleBatch:
+    raw = json.loads(line)
+    return SampleBatch({
+        k: np.asarray(v["data"], dtype=np.dtype(v["dtype"]))
+        for k, v in raw.items()
+    })
+
+
+class JsonWriter:
+    """Append SampleBatches to rolling .json files in a directory
+    (reference: rllib/offline/json_writer.py:26)."""
+
+    def __init__(self, path: str, max_file_size: int = 64 * 1024 * 1024):
+        import uuid
+
+        self.path = path
+        self.max_file_size = max_file_size
+        os.makedirs(path, exist_ok=True)
+        self._file = None
+        self._index = 0
+        self._uid = uuid.uuid4().hex[:8]
+
+    def _rollover(self):
+        if self._file:
+            self._file.close()
+        # unique per writer instance: pid alone collides across container
+        # restarts (pid 1) — uuid suffix makes runs append-safe
+        name = os.path.join(
+            self.path,
+            f"output-{os.getpid()}-{self._uid}-{self._index:05d}.json")
+        self._index += 1
+        self._file = open(name, "x")
+
+    def write(self, batch: SampleBatch):
+        if (self._file is None
+                or self._file.tell() >= self.max_file_size):
+            self._rollover()
+        self._file.write(_encode(batch) + "\n")
+        self._file.flush()
+
+    def close(self):
+        if self._file:
+            self._file.close()
+            self._file = None
+
+
+class JsonReader:
+    """Read batches back; next() cycles forever for training loops
+    (reference: rllib/offline/json_reader.py:30)."""
+
+    def __init__(self, path: str):
+        if os.path.isdir(path):
+            self.files = sorted(glob.glob(os.path.join(path, "*.json")))
+        else:
+            self.files = sorted(glob.glob(path))
+        if not self.files:
+            raise FileNotFoundError(f"no offline data under {path!r}")
+        self._cycle = None
+
+    def read_all(self) -> list[SampleBatch]:
+        out = []
+        for f in self.files:
+            with open(f) as fh:
+                out.extend(_decode(l) for l in fh if l.strip())
+        return out
+
+    def __iter__(self) -> Iterator[SampleBatch]:
+        return iter(self.read_all())
+
+    def next(self) -> SampleBatch:
+        if self._cycle is None:
+            self._cycle = self.read_all()
+            self._pos = 0
+        b = self._cycle[self._pos % len(self._cycle)]
+        self._pos += 1
+        return b
+
+
+class _OffPolicyEstimator:
+    """reference: rllib/offline/off_policy_estimator.py:23. Requires the
+    behaviour policy's action_logp in the batch (reference raises the
+    same requirement)."""
+
+    def __init__(self, policy, gamma: float = 0.99):
+        self.policy = policy
+        self.gamma = gamma
+
+    def _episode_ratios(self, episode: SampleBatch):
+        if SampleBatch.ACTION_LOGP not in episode:
+            raise ValueError(
+                "off-policy estimation needs batch['action_logp'] from "
+                "the behaviour policy")
+        new_logp = self.policy.compute_log_likelihoods(
+            episode[SampleBatch.OBS], episode[SampleBatch.ACTIONS])
+        ratios = np.exp(new_logp - episode[SampleBatch.ACTION_LOGP])
+        return np.cumprod(ratios)
+
+    def _discounted(self, rewards: np.ndarray) -> np.ndarray:
+        return rewards * (self.gamma ** np.arange(len(rewards)))
+
+
+class ImportanceSampling(_OffPolicyEstimator):
+    """V^pi estimate: mean over episodes of sum_t gamma^t * p_{0:t} * r_t
+    (reference: rllib/offline/is_estimator.py)."""
+
+    def estimate(self, batch: SampleBatch) -> dict:
+        vals = []
+        behaviour = []
+        for ep in batch.split_by_episode():
+            p = self._episode_ratios(ep)
+            r = self._discounted(
+                ep[SampleBatch.REWARDS].astype(np.float64))
+            vals.append(float(np.sum(p * r)))
+            behaviour.append(float(np.sum(r)))
+        return {"v_es": float(np.mean(vals)),
+                "v_behaviour": float(np.mean(behaviour)),
+                "episodes": len(vals)}
+
+
+class WeightedImportanceSampling(_OffPolicyEstimator):
+    """Self-normalized IS: per-step ratios normalized by their mean over
+    episodes — lower variance, slight bias (reference:
+    rllib/offline/wis_estimator.py)."""
+
+    def estimate(self, batch: SampleBatch) -> dict:
+        episodes = batch.split_by_episode()
+        ratios = [self._episode_ratios(ep) for ep in episodes]
+        max_t = max(len(p) for p in ratios)
+        # mean cumulative ratio at each t across episodes present at t
+        norm = np.zeros(max_t)
+        counts = np.zeros(max_t)
+        for p in ratios:
+            norm[:len(p)] += p
+            counts[:len(p)] += 1
+        norm = norm / np.maximum(counts, 1)
+        vals = []
+        behaviour = []
+        for ep, p in zip(episodes, ratios):
+            r = self._discounted(
+                ep[SampleBatch.REWARDS].astype(np.float64))
+            w = p / np.maximum(norm[:len(p)], 1e-12)
+            vals.append(float(np.sum(w * r)))
+            behaviour.append(float(np.sum(r)))
+        return {"v_es": float(np.mean(vals)),
+                "v_behaviour": float(np.mean(behaviour)),
+                "episodes": len(vals)}

@@ -148,7 +148,22 @@ class JAXPolicy(Policy):
                 params = jax.tree.map(lambda p, u: p + u, params, updates)
                 return params, opt_state, loss, metrics
 
+            @jax.jit
+            def grad_step(params, batch):
+                (loss, metrics), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(params, batch, policy)
+                return grads, loss, metrics
+
+            @jax.jit
+            def apply_step(params, opt_state, grads):
+                updates, opt_state = optimizer.update(grads, opt_state,
+                                                      params)
+                params = jax.tree.map(lambda p, u: p + u, params, updates)
+                return params, opt_state
+
             self._sgd_step = sgd_step
+            self._grad_step = grad_step
+            self._apply_step = apply_step
 
     # -- Policy interface ------------------------------------------------
 
@@ -164,6 +179,15 @@ class JAXPolicy(Policy):
         return (np.asarray(actions),
                 {SampleBatch.ACTION_LOGP: np.asarray(logp),
                  SampleBatch.VF_PREDS: np.asarray(vf)})
+
+    def compute_log_likelihoods(self, obs_batch, actions) -> np.ndarray:
+        """logp of given actions under the current policy (reference:
+        rllib/policy/policy.py compute_log_likelihoods; used by the
+        offline IS/WIS estimators)."""
+        obs = jnp.asarray(obs_batch, jnp.float32).reshape(
+            len(obs_batch), -1)
+        pi_out, _ = JAXPolicy.model_out(self.params, obs)
+        return np.asarray(self.logp_fn()(pi_out, jnp.asarray(actions)))
 
     def compute_values(self, obs_batch) -> np.ndarray:
         obs = jnp.asarray(obs_batch, jnp.float32).reshape(
@@ -186,6 +210,22 @@ class JAXPolicy(Policy):
         out = {"total_loss": float(loss)}
         out.update({k: float(v) for k, v in metrics.items()})
         return out
+
+    def compute_gradients(self, batch: SampleBatch):
+        """Gradients without applying them (reference:
+        rllib/policy/policy.py compute_gradients; used by AsyncGradients
+        execution/rollout_ops.py:92). Returns (numpy grad pytree, info)."""
+        jb = {k: jnp.asarray(v) for k, v in batch.items()
+              if k not in self._NON_LOSS_COLUMNS and v.dtype != object}
+        grads, loss, metrics = self._grad_step(self.params, jb)
+        info = {"total_loss": float(loss)}
+        info.update({k: float(v) for k, v in metrics.items()})
+        return jax.tree.map(np.asarray, grads), info
+
+    def apply_gradients(self, grads):
+        """reference: rllib/policy/policy.py apply_gradients."""
+        self.params, self.opt_state = self._apply_step(
+            self.params, self.opt_state, jax.tree.map(jnp.asarray, grads))
 
     def get_weights(self):
         return jax.tree.map(np.asarray, self.params)

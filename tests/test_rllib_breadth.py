@@ -1,0 +1,312 @@
+"""RLlib breadth tests: PG/A3C agents, multi-agent envs + per-policy
+training, offline IO + off-policy estimation, external-env policy
+server/client (reference idiom: rllib/tests/test_multi_agent_env.py,
+rllib/offline/, rllib/tests/test_external_env.py)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from ray_tpu.rllib.policy.sample_batch import MultiAgentBatch, SampleBatch
+
+
+def test_discounted_returns_bootstraps_tail():
+    from ray_tpu.rllib.agents.pg import discounted_returns
+
+    r = np.array([1.0, 1.0, 1.0])
+    d = np.array([0.0, 0.0, 0.0])
+    out = discounted_returns(r, d, gamma=0.5, last_value=8.0)
+    # t=2: 1 + .5*8 = 5; t=1: 1 + .5*5 = 3.5; t=0: 1 + .5*3.5 = 2.75
+    np.testing.assert_allclose(out, [2.75, 3.5, 5.0])
+    # terminal cuts the bootstrap
+    out2 = discounted_returns(r, np.array([0.0, 0.0, 1.0]), 0.5, 8.0)
+    np.testing.assert_allclose(out2, [1.75, 1.5, 1.0])
+
+
+def test_pg_learns_cartpole(ray_start_shared):
+    from ray_tpu.rllib.agents.pg import PGTrainer
+
+    trainer = PGTrainer(config={
+        "env": "CartPole-v1",
+        "rollout_fragment_length": 256,
+        "train_batch_size": 2048,
+        "lr": 5e-3,
+        "seed": 0,
+    })
+    rewards = [trainer.train()["episode_reward_mean"] for _ in range(10)]
+    trainer.cleanup()
+    assert rewards[-1] > 50, f"no learning: {rewards}"
+
+
+def test_compute_apply_gradients_match_sgd_step():
+    """compute_gradients + apply_gradients must equal learn_on_batch."""
+    import gymnasium
+
+    from ray_tpu.rllib.agents.ppo import PPOPolicy
+
+    env = gymnasium.make("CartPole-v1")
+    cfg = {"seed": 3, "lr": 1e-3}
+    p1 = PPOPolicy(env.observation_space, env.action_space, cfg)
+    p2 = PPOPolicy(env.observation_space, env.action_space, cfg)
+    batch = SampleBatch({
+        SampleBatch.OBS: np.random.RandomState(0).randn(16, 4)
+            .astype(np.float32),
+        SampleBatch.ACTIONS: np.random.RandomState(1).randint(0, 2, 16),
+        SampleBatch.ACTION_LOGP: np.full(16, -0.7, np.float32),
+        SampleBatch.VF_PREDS: np.zeros(16, np.float32),
+        SampleBatch.ADVANTAGES: np.random.RandomState(2).randn(16)
+            .astype(np.float32),
+        SampleBatch.VALUE_TARGETS: np.ones(16, np.float32),
+    })
+    p1.learn_on_batch(batch)
+    grads, info = p2.compute_gradients(batch)
+    assert np.isfinite(info["total_loss"])
+    p2.apply_gradients(grads)
+    np.testing.assert_allclose(p1.get_weights()["pi"][0]["w"],
+                               p2.get_weights()["pi"][0]["w"], rtol=1e-5)
+    env.close()
+
+
+def test_a3c_learns_cartpole(ray_start_shared):
+    from ray_tpu.rllib.agents.a3c import A3CTrainer
+
+    trainer = A3CTrainer(config={
+        "env": "CartPole-v1",
+        "num_workers": 2,
+        "rollout_fragment_length": 64,
+        "grads_per_step": 24,
+        "lr": 1e-3,
+        "entropy_coeff": 0.01,
+        "seed": 0,
+    })
+    rewards = [trainer.train()["episode_reward_mean"] for _ in range(6)]
+    trainer.cleanup()
+    assert rewards[-1] > 45, f"no learning: {rewards}"
+
+
+# -- multi-agent --------------------------------------------------------
+
+class SignGame:
+    """Two independent agents; obs in {-1,+1}; reward 1 iff action
+    matches the sign. 8-step episodes."""
+
+    import gymnasium
+
+    observation_space = gymnasium.spaces.Box(-1, 1, (1,), np.float32)
+    action_space = gymnasium.spaces.Discrete(2)
+
+    def __init__(self, config=None):
+        self._rng = np.random.RandomState(0)
+        self._t = 0
+
+    def _obs(self):
+        return {a: np.array([self._rng.choice([-1.0, 1.0])], np.float32)
+                for a in ("a0", "a1")}
+
+    def reset(self, seed=None):
+        if seed is not None:
+            self._rng = np.random.RandomState(seed)
+        self._t = 0
+        self._last = self._obs()
+        return self._last, {}
+
+    def step(self, action_dict):
+        rewards = {
+            a: float(int(act) == int(self._last[a][0] > 0))
+            for a, act in action_dict.items()
+        }
+        self._t += 1
+        done = self._t >= 8
+        self._last = self._obs()
+        return (self._last, rewards,
+                {"__all__": done}, {"__all__": False}, {})
+
+    def close(self):
+        pass
+
+
+def test_multi_agent_rollout_and_training(ray_start_shared):
+    from ray_tpu.rllib.agents.ppo import PPOPolicy, PPOTrainer
+
+    trainer = PPOTrainer(config={
+        "env": SignGame,
+        "multiagent": {
+            "policies": {
+                "p0": (None, None, None, {}),
+                "p1": (None, None, None, {}),
+            },
+            "policy_mapping_fn": lambda aid: "p0" if aid == "a0" else "p1",
+        },
+        "rollout_fragment_length": 64,
+        "train_batch_size": 256,
+        "sgd_minibatch_size": 64,
+        "num_sgd_iter": 4,
+        "lr": 5e-3,
+        "seed": 0,
+    })
+    # sampling produces a per-policy MultiAgentBatch
+    batch = trainer.workers.local_worker.sample(32)
+    assert isinstance(batch, MultiAgentBatch)
+    assert set(batch.policy_batches) == {"p0", "p1"}
+    assert batch.count == 32
+    # each agent stepped every env step
+    assert batch.policy_batches["p0"].count == 32
+
+    rewards = [trainer.train()["episode_reward_mean"] for _ in range(8)]
+    trainer.cleanup()
+    # random play: E[r] = 0.5/agent/step -> 8 total/episode; learned: -> 16
+    assert rewards[-1] > 11, f"no learning: {rewards}"
+
+
+def test_multi_agent_remote_workers(ray_start_shared):
+    from ray_tpu.rllib.agents.ppo import PPOTrainer
+
+    trainer = PPOTrainer(config={
+        "env": SignGame,
+        "num_workers": 2,
+        "multiagent": {
+            "policies": {"shared": (None, None, None, {})},
+            "policy_mapping_fn": lambda aid: "shared",
+        },
+        "rollout_fragment_length": 32,
+        "train_batch_size": 128,
+        "sgd_minibatch_size": 64,
+        "num_sgd_iter": 2,
+        "seed": 0,
+    })
+    result = trainer.train()
+    assert result["num_env_steps_trained"] >= 128
+    trainer.cleanup()
+
+
+def test_multiagent_unsupported_trainer_raises():
+    from ray_tpu.rllib.agents.pg import PGTrainer
+
+    with pytest.raises(ValueError, match="does not support"):
+        PGTrainer(config={
+            "env": SignGame,
+            "multiagent": {
+                "policies": {"p": (None, None, None, {})},
+                "policy_mapping_fn": lambda aid: "p",
+            },
+        })
+
+
+# -- offline IO ---------------------------------------------------------
+
+def test_json_writer_reader_roundtrip(tmp_path):
+    from ray_tpu.rllib.offline import JsonReader, JsonWriter
+
+    w = JsonWriter(str(tmp_path))
+    b = SampleBatch({
+        SampleBatch.OBS: np.random.randn(5, 3).astype(np.float32),
+        SampleBatch.ACTIONS: np.array([0, 1, 0, 1, 1]),
+        SampleBatch.REWARDS: np.arange(5.0, dtype=np.float32),
+        SampleBatch.DONES: np.array([False] * 4 + [True]),
+    })
+    w.write(b)
+    w.write(b)
+    w.close()
+    r = JsonReader(str(tmp_path))
+    batches = r.read_all()
+    assert len(batches) == 2
+    np.testing.assert_allclose(batches[0][SampleBatch.OBS],
+                               b[SampleBatch.OBS], rtol=1e-6)
+    assert batches[0][SampleBatch.ACTIONS].dtype == b[
+        SampleBatch.ACTIONS].dtype
+    # next() cycles
+    for _ in range(5):
+        assert len(r.next()) == 5
+
+
+def test_rollout_worker_output_and_input(tmp_path, ray_start_shared):
+    import cloudpickle
+
+    from ray_tpu.rllib.agents.ppo import PPOPolicy
+    from ray_tpu.rllib.evaluation.rollout_worker import RolloutWorker
+
+    out_dir = str(tmp_path / "data")
+    builder = cloudpickle.dumps(lambda o, a, c: PPOPolicy(o, a, c))
+    w = RolloutWorker("CartPole-v1", builder,
+                      {"rollout_fragment_length": 32, "seed": 0,
+                       "output": out_dir})
+    w.sample()
+    w.sample()
+    w.stop()
+    assert os.listdir(out_dir)
+
+    # an input-reading worker replays the logged data instead of the env
+    r = RolloutWorker("CartPole-v1", builder,
+                      {"input": out_dir, "seed": 0})
+    replayed = r.sample()
+    assert len(replayed) == 32
+    assert SampleBatch.ADVANTAGES in replayed
+    r.stop()
+
+
+def test_offline_estimators_sanity():
+    """On-policy data: IS and WIS estimates equal the behaviour value."""
+    import gymnasium
+
+    from ray_tpu.rllib.agents.ppo import PPOPolicy
+    from ray_tpu.rllib.offline import (ImportanceSampling,
+                                       WeightedImportanceSampling)
+
+    env = gymnasium.make("CartPole-v1")
+    policy = PPOPolicy(env.observation_space, env.action_space, {"seed": 0})
+    obs = np.random.RandomState(0).randn(12, 4).astype(np.float32)
+    actions, extra = policy.compute_actions(obs)
+    batch = SampleBatch({
+        SampleBatch.OBS: obs,
+        SampleBatch.ACTIONS: actions,
+        SampleBatch.ACTION_LOGP: extra[SampleBatch.ACTION_LOGP],
+        SampleBatch.REWARDS: np.ones(12, np.float32),
+        SampleBatch.EPS_ID: np.repeat([0, 1, 2], 4),
+        SampleBatch.DONES: np.tile([False, False, False, True], 3),
+    })
+    for cls in (ImportanceSampling, WeightedImportanceSampling):
+        est = cls(policy, gamma=1.0).estimate(batch)
+        assert est["episodes"] == 3
+        np.testing.assert_allclose(est["v_es"], est["v_behaviour"],
+                                   rtol=1e-4)
+    # estimator demands behaviour logp
+    del batch[SampleBatch.ACTION_LOGP]
+    with pytest.raises(ValueError):
+        ImportanceSampling(policy).estimate(batch)
+    env.close()
+
+
+# -- external env / policy server ---------------------------------------
+
+def test_policy_server_client_roundtrip():
+    import gymnasium
+
+    from ray_tpu.rllib.agents.ppo import PPOPolicy
+    from ray_tpu.rllib.env.policy_server import (PolicyClient,
+                                                 PolicyServerInput)
+
+    env = gymnasium.make("CartPole-v1")
+    policy = PPOPolicy(env.observation_space, env.action_space, {"seed": 0})
+    server = PolicyServerInput(policy)
+    client = PolicyClient(f"http://127.0.0.1:{server.port}")
+
+    # external simulator loop
+    for _ in range(2):
+        eid = client.start_episode()
+        obs, _ = env.reset(seed=0)
+        for _ in range(10):
+            action = client.get_action(eid, obs)
+            obs, reward, term, trunc, _ = env.step(int(action))
+            client.log_returns(eid, reward)
+            if term or trunc:
+                break
+        client.end_episode(eid)
+
+    batch = server.next(timeout=10)
+    assert isinstance(batch, SampleBatch)
+    assert batch[SampleBatch.OBS].shape[1] == 4
+    assert batch[SampleBatch.DONES][-1]
+    assert np.all(batch[SampleBatch.ACTION_LOGP] <= 0)
+    server.stop()
+    env.close()
