@@ -174,8 +174,17 @@ class CoreWorker:
             "remove_borrow": self.h_remove_borrow,
             "exit": self.h_exit,
             "cancel_task": self.h_cancel_task,
+            "get_stats": self.h_get_stats,
             "ping": lambda conn, d: "pong",
         }
+
+    async def h_get_stats(self, conn, d):
+        """Process-local metrics snapshot — the raylet aggregates these
+        into its own get_metrics reply so user-defined metrics
+        (util/metrics.py) surface in cluster_metrics()."""
+        from ray_tpu._private import stats
+
+        return stats.snapshot()
 
     def _connect(self, raylet_address: str, gcs_address: str):
         async def setup():
@@ -986,6 +995,16 @@ class CoreWorker:
     def get_profile_events(self) -> list[dict]:
         """All profile batches recorded cluster-wide (driver surface)."""
         return self._io.run(self.gcs.call("get_profile_events", {}))
+
+    def set_resource(self, resource_name: str, capacity: float,
+                     node_id: bytes | None = None):
+        """Dynamic resource resize, routed through the GCS to the target
+        raylet (reference: experimental/dynamic_resources.py)."""
+        return self._io.run(self.gcs.call("set_resource", {
+            "resource_name": resource_name,
+            "capacity": capacity,
+            "node_id": node_id,
+        }))
 
     def get_cluster_metrics(self) -> dict:
         """GCS + per-raylet metric snapshots, merged."""

@@ -1,0 +1,9 @@
+"""ray_tpu.experimental (reference: python/ray/experimental/ —
+internal_kv, async_api, dynamic_resources, shuffle)."""
+
+from ray_tpu.experimental.async_api import as_concurrent_future, as_future
+from ray_tpu.experimental.dynamic_resources import set_resource
+from ray_tpu.experimental.shuffle import simple_shuffle
+
+__all__ = ["as_concurrent_future", "as_future", "set_resource",
+           "simple_shuffle"]

@@ -1,0 +1,25 @@
+"""asyncio integration (reference: python/ray/experimental/async_api.py
+as_future — await ObjectRefs from asyncio event loops).
+
+ObjectRefs are natively awaitable here (object_ref.py __await__), so this
+module is the explicit-conversion surface for code that wants
+concurrent.futures / asyncio.Future objects instead of `await ref`."""
+
+from __future__ import annotations
+
+import asyncio
+
+
+def as_future(object_ref) -> asyncio.Future:
+    """Wrap an ObjectRef into an asyncio.Future on the running loop."""
+    return asyncio.ensure_future(_await_ref(object_ref))
+
+
+async def _await_ref(object_ref):
+    return await object_ref
+
+
+def as_concurrent_future(object_ref):
+    """concurrent.futures.Future resolving to the object (thread-safe;
+    no running asyncio loop required)."""
+    return object_ref.future()

@@ -1,0 +1,26 @@
+"""Dynamic custom resources (reference:
+python/ray/experimental/dynamic_resources.py set_resource — resize a
+node's custom resource capacity at runtime; deletion via capacity 0)."""
+
+from __future__ import annotations
+
+from ray_tpu._private import global_state
+
+
+def set_resource(resource_name: str, capacity: float,
+                 node_id: bytes | str | None = None):
+    """Set `resource_name`'s total capacity on a node (default: the
+    caller's node). capacity=0 removes the resource. Newly freed
+    capacity immediately unblocks queued tasks."""
+    if resource_name in ("CPU", "TPU", "GPU", "memory"):
+        raise ValueError(
+            f"cannot dynamically update built-in resource "
+            f"{resource_name!r} (reference imposes the same limit)")
+    if capacity < 0:
+        raise ValueError("capacity must be >= 0")
+    cw = global_state.require_core_worker()
+    if isinstance(node_id, str):
+        node_id = bytes.fromhex(node_id)
+    if node_id is None and cw.node_id is not None:
+        node_id = cw.node_id.binary()
+    return cw.set_resource(resource_name, float(capacity), node_id)

@@ -1,0 +1,69 @@
+"""Distributed shuffle (reference: python/ray/experimental/shuffle.py —
+the two-phase map/reduce shuffle used as a data-plane stress workload).
+
+Phase 1: map tasks partition their input block by key-hash and `put` one
+object per reducer. Phase 2: reduce tasks fetch their partition from
+every mapper and merge. All transport rides the object store (zero-copy
+numpy on shared memory locally, chunked pulls across nodes)."""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import ray_tpu
+
+
+def _stable_key(record) -> int:
+    """Cross-process-stable default key: builtin hash() is per-process
+    randomized for strings, and mappers run in separate worker
+    processes (same rationale as streaming.py _stable_hash)."""
+    import pickle
+    import zlib
+
+    if isinstance(record, int):
+        return record & 0x7FFFFFFF
+    return zlib.crc32(pickle.dumps(record, protocol=4))
+
+
+def simple_shuffle(input_blocks: Sequence,
+                   num_reducers: int,
+                   key_fn: Callable | None = None,
+                   reduce_fn: Callable | None = None,
+                   partition_resources: dict | None = None) -> list:
+    """Shuffle rows from `input_blocks` (each a list of records) into
+    `num_reducers` output blocks grouped by key_fn(record) % num_reducers.
+    reduce_fn(list_of_partitions) -> merged block (default: concat).
+    Returns the reduced blocks (materialized on the driver)."""
+
+    if key_fn is None:
+        key_fn = _stable_key
+    resources = partition_resources or {"CPU": 1}
+
+    @ray_tpu.remote(resources=resources, num_returns=num_reducers)
+    def mapper(block):
+        parts = [[] for _ in range(num_reducers)]
+        for rec in block:
+            parts[key_fn(rec) % num_reducers].append(rec)
+        if num_reducers == 1:
+            return parts[0]
+        return tuple(parts)
+
+    @ray_tpu.remote(resources=resources)
+    def reducer(*partitions):
+        if reduce_fn is not None:
+            return reduce_fn(list(partitions))
+        out = []
+        for p in partitions:
+            out.extend(p)
+        return out
+
+    map_out = [mapper.remote(block) for block in input_blocks]
+    if num_reducers == 1:
+        map_refs = [[ref] for ref in map_out]
+    else:
+        map_refs = map_out  # list of tuples of refs
+    reduce_refs = [
+        reducer.remote(*[refs[r] for refs in map_refs])
+        for r in range(num_reducers)
+    ]
+    return ray_tpu.get(reduce_refs, timeout=600)

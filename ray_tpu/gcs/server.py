@@ -64,6 +64,7 @@ class GcsServer:
         self.server = rpc.Server(self._handlers(), on_disconnect=self._on_disconnect,
                                  name="gcs")
         self._pending_actor_queue: list[bytes] = []
+        self._pending_logged: set[bytes] = set()
         # Profile-event table (reference: the GCS profile table fed by
         # core_worker profiling.h batches), bounded ring.
         import collections as _collections
@@ -137,6 +138,7 @@ class GcsServer:
             "publish": self.h_publish,
             "register_node": self.h_register_node,
             "heartbeat": self.h_heartbeat,
+            "set_resource": self.h_set_resource,
             "get_all_nodes": self.h_get_all_nodes,
             "get_available_resources": self.h_get_available_resources,
             "drain_node": self.h_drain_node,
@@ -240,6 +242,32 @@ class GcsServer:
         await self._retry_pending_pgs()
         return True
 
+    async def h_set_resource(self, conn, d):
+        """ray.experimental.set_resource: forward to the target raylet,
+        then refresh this table's view (reference: gcs_resource_manager
+        UpdateResources)."""
+        node_id = d.get("node_id") or next(
+            (nid for nid, info in self.nodes.items()
+             if info["state"] == "ALIVE"), None)
+        node_conn = self.node_conns.get(node_id)
+        if node_conn is None or node_conn.closed:
+            raise ValueError(f"no live raylet for node "
+                             f"{node_id.hex()[:8] if node_id else None}")
+        reply = await node_conn.call("set_resource", {
+            "resource_name": d["resource_name"],
+            "capacity": d["capacity"],
+        })
+        info = self.nodes.get(node_id)
+        if info is not None:
+            info["resources"] = reply["total"]
+            self._persist("nodes", node_id, info)
+            # let every raylet refresh its cluster view (spillback
+            # scoring and api.nodes() read it)
+            await self.publish("nodes", {"event": "updated",
+                                         "node": _node_public(info)})
+        self.available[node_id] = ResourceSet.from_raw(reply["available"])
+        return True
+
     async def h_heartbeat(self, conn, d):
         node_id = d["node_id"]
         self.last_heartbeat[node_id] = time.monotonic()
@@ -248,6 +276,12 @@ class GcsServer:
             if any(r["state"] == "PENDING"
                    for r in self.placement_groups.values()):
                 await self._retry_pending_pgs()
+            # resources freed elsewhere may unblock queued actors —
+            # without this, a pending actor waits for a node REGISTRATION
+            # that may never come (the deadlock: all slots busy at
+            # creation time, freed later)
+            if self._pending_actor_queue:
+                await self._try_schedule_pending_actors()
         return True
 
     async def h_get_all_nodes(self, conn, d):
@@ -388,14 +422,43 @@ class GcsServer:
         if not candidates:
             if actor_id not in self._pending_actor_queue:
                 self._pending_actor_queue.append(actor_id)
-            logger.info("actor %s pending: no feasible node", actor_id.hex()[:8])
+            # one-shot logging: the heartbeat-driven retry re-enters here
+            # every interval for a stuck actor
+            if actor_id not in self._pending_logged:
+                self._pending_logged.add(actor_id)
+                logger.info("actor %s pending: no feasible node",
+                            actor_id.hex()[:8])
+                # infeasible-anywhere warning (reference:
+                # cluster_task_manager.cc logs infeasible tasks)
+                totals = [ResourceSet.from_raw(n["resources"])
+                          for n in self.nodes.values()]
+                if not any(need.is_subset_of(t) for t in totals):
+                    logger.warning(
+                        "actor %s requires %s, which exceeds every "
+                        "node's TOTAL capacity — it will never schedule "
+                        "on the current cluster", actor_id.hex()[:8],
+                        need.to_dict())
             return
+        self._pending_logged.discard(actor_id)
         node_id = random.choice(candidates)
         conn = self.node_conns[node_id]
         rec["node_id"] = node_id
         try:
             reply = await conn.call("create_actor", {"spec": spec})
         except Exception as e:
+            if "insufficient resources" in str(e):
+                # The GCS's availability view was stale (lease grants race
+                # the heartbeat): that is a scheduling miss, not an actor
+                # failure — requeue, and correct the view so the next
+                # pass picks another node (the true value arrives with
+                # the node's next heartbeat).
+                self.available[node_id] = ResourceSet()
+                if actor_id not in self._pending_actor_queue:
+                    self._pending_actor_queue.append(actor_id)
+                logger.info("actor %s bounced off %s (stale availability);"
+                            " requeued", actor_id.hex()[:8],
+                            node_id.hex()[:8])
+                return
             logger.warning("actor creation on %s failed: %s", node_id.hex()[:8], e)
             await self._on_actor_interrupted(actor_id, f"creation failed: {e}")
             return

@@ -31,6 +31,11 @@ class ResNetConfig:
     width: int = 64
     dtype: Any = jnp.bfloat16
     small_images: bool = False   # CIFAR stem: 3x3/1 conv, no maxpool
+    # "s2d": run the stem conv in 2x2 space-to-depth layout (MLPerf TPU
+    # trick) — mathematically identical outputs/params, but the MXU sees a
+    # 4x4 stride-1 conv over 12 channels instead of a 7x7 stride-2 conv
+    # over 3 (a 3-deep reduction wastes the 128-deep MXU contraction).
+    stem_mode: str = "standard"
 
 
 def resnet18(num_classes=1000, **kw) -> ResNetConfig:
@@ -82,6 +87,33 @@ def _bn(x, p, s, train: bool, momentum=0.9, eps=1e-5):
     offset = p["bias"] - mean * inv
     y = x * inv.astype(x.dtype) + offset.astype(x.dtype)
     return y, new_s
+
+
+def _stem_s2d(x, w, dtype):
+    """7x7/s2 stem conv, computed in 2x2 space-to-depth layout.
+
+    Exactly equivalent to _conv(x, w, 2) with SAME padding for even input
+    sizes: SAME for k=7,s=2 pads (2,3), so output[i] reads input pixels
+    2i-2..2i+4; padding the kernel to 8 taps (zeros at the tail) widens
+    that to 2i-2..2i+5 — exactly blocks i-1..i+2 of the 2x2 layout, i.e.
+    a 4-tap stride-1 conv over blocks with padding (1,2)."""
+    n, h, w_, c = x.shape
+    if h % 2 or w_ % 2:
+        raise ValueError(
+            f"stem_mode='s2d' needs even input H/W (got {h}x{w_}): the "
+            "2x2 space-to-depth equivalence only holds for even sizes — "
+            "use stem_mode='standard' for odd inputs")
+    # space-to-depth: [N,H,W,3] -> [N,H/2,W/2,12], channel = (dy,dx,c)
+    x2 = x.reshape(n, h // 2, 2, w_ // 2, 2, c)
+    x2 = x2.transpose(0, 1, 3, 2, 4, 5).reshape(n, h // 2, w_ // 2, 4 * c)
+    # kernel: [7,7,3,O] -> zero-pad to [8,8,3,O] -> block form [4,4,12,O]
+    kw = jnp.pad(w, ((0, 1), (0, 1), (0, 0), (0, 0)))
+    cout = kw.shape[-1]
+    kw = kw.reshape(4, 2, 4, 2, c, cout)
+    kw = kw.transpose(0, 2, 1, 3, 4, 5).reshape(4, 4, 4 * c, cout)
+    return lax.conv_general_dilated(
+        x2, kw.astype(dtype), (1, 1), [(1, 2), (1, 2)],
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
 
 
 def _block_channels(cfg: ResNetConfig, stage: int) -> tuple[int, int]:
@@ -155,7 +187,10 @@ def apply(params, state, x, cfg: ResNetConfig, train: bool = True):
     """x: [N, H, W, 3] float → (logits [N, classes] fp32, new_state)."""
     x = x.astype(cfg.dtype)
     new_state: dict = {}
-    y = _conv(x, params["stem_conv"], 1 if cfg.small_images else 2)
+    if cfg.stem_mode == "s2d" and not cfg.small_images:
+        y = _stem_s2d(x, params["stem_conv"], cfg.dtype)
+    else:
+        y = _conv(x, params["stem_conv"], 1 if cfg.small_images else 2)
     y, new_state["stem_bn"] = _bn(y, params["stem_bn"], state["stem_bn"],
                                   train)
     y = jax.nn.relu(y)

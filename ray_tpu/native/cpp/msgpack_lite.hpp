@@ -1,0 +1,296 @@
+// Minimal msgpack codec for the ray_tpu C++ client (role parity:
+// the reference's C++/Java workers serialize cross-language payloads as
+// msgpack — src/ray/common/... msgpack dependency; here a dependency-free
+// subset: nil/bool/int/float64/str/bin/array/map).
+//
+// Not a general-purpose library: covers exactly the wire shapes the
+// ray_tpu client-server protocol uses (rpc.py: length-prefixed
+// msgpack([msgtype, msgid, method, data])).
+#pragma once
+
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace msgpack_lite {
+
+class Value;
+using Array = std::vector<Value>;
+using Map = std::map<std::string, Value>;
+
+class Value {
+ public:
+  enum class Type { Nil, Bool, Int, Float, Str, Bin, Arr, MapT };
+
+  Value() : type_(Type::Nil) {}
+  Value(std::nullptr_t) : type_(Type::Nil) {}
+  Value(bool b) : type_(Type::Bool), b_(b) {}
+  Value(int i) : type_(Type::Int), i_(i) {}
+  Value(int64_t i) : type_(Type::Int), i_(i) {}
+  Value(uint64_t i) : type_(Type::Int), i_(static_cast<int64_t>(i)) {}
+  Value(double d) : type_(Type::Float), d_(d) {}
+  Value(const char* s) : type_(Type::Str), s_(s) {}
+  Value(std::string s) : type_(Type::Str), s_(std::move(s)) {}
+  static Value Bin(std::string data) {
+    Value v;
+    v.type_ = Type::Bin;
+    v.s_ = std::move(data);
+    return v;
+  }
+  Value(Array a) : type_(Type::Arr), arr_(std::move(a)) {}
+  Value(Map m) : type_(Type::MapT), map_(std::move(m)) {}
+
+  Type type() const { return type_; }
+  bool is_nil() const { return type_ == Type::Nil; }
+  bool as_bool() const { check(Type::Bool); return b_; }
+  int64_t as_int() const { check(Type::Int); return i_; }
+  double as_float() const {
+    if (type_ == Type::Int) return static_cast<double>(i_);
+    check(Type::Float);
+    return d_;
+  }
+  const std::string& as_str() const {
+    if (type_ != Type::Str && type_ != Type::Bin)
+      throw std::runtime_error("msgpack: not a str/bin");
+    return s_;
+  }
+  const Array& as_array() const { check(Type::Arr); return arr_; }
+  const Map& as_map() const { check(Type::MapT); return map_; }
+
+  // map convenience: v["key"]
+  const Value& operator[](const std::string& k) const {
+    static Value nil;
+    check(Type::MapT);
+    auto it = map_.find(k);
+    return it == map_.end() ? nil : it->second;
+  }
+
+ private:
+  void check(Type t) const {
+    if (type_ != t) throw std::runtime_error("msgpack: type mismatch");
+  }
+  Type type_;
+  bool b_ = false;
+  int64_t i_ = 0;
+  double d_ = 0;
+  std::string s_;
+  Array arr_;
+  Map map_;
+};
+
+// ---------------------------------------------------------------- pack
+
+inline void pack_into(const Value& v, std::string& out);
+
+inline void put_be(std::string& out, uint64_t x, int bytes) {
+  for (int i = bytes - 1; i >= 0; --i)
+    out.push_back(static_cast<char>((x >> (8 * i)) & 0xff));
+}
+
+inline void pack_into(const Value& v, std::string& out) {
+  using T = Value::Type;
+  switch (v.type()) {
+    case T::Nil:
+      out.push_back(static_cast<char>(0xc0));
+      break;
+    case T::Bool:
+      out.push_back(static_cast<char>(v.as_bool() ? 0xc3 : 0xc2));
+      break;
+    case T::Int: {
+      int64_t i = v.as_int();
+      if (i >= 0 && i < 128) {
+        out.push_back(static_cast<char>(i));
+      } else if (i < 0 && i >= -32) {
+        out.push_back(static_cast<char>(0xe0 | (i + 32)));
+      } else {
+        out.push_back(static_cast<char>(0xd3));  // int64
+        put_be(out, static_cast<uint64_t>(i), 8);
+      }
+      break;
+    }
+    case T::Float: {
+      out.push_back(static_cast<char>(0xcb));
+      double d = v.as_float();
+      uint64_t bits;
+      std::memcpy(&bits, &d, 8);
+      put_be(out, bits, 8);
+      break;
+    }
+    case T::Str: {
+      const std::string& s = v.as_str();
+      if (s.size() < 32) {
+        out.push_back(static_cast<char>(0xa0 | s.size()));
+      } else if (s.size() < 256) {
+        out.push_back(static_cast<char>(0xd9));
+        put_be(out, s.size(), 1);
+      } else {
+        out.push_back(static_cast<char>(0xda));
+        put_be(out, s.size(), 2);
+      }
+      out += s;
+      break;
+    }
+    case T::Bin: {
+      const std::string& s = v.as_str();
+      if (s.size() < 256) {
+        out.push_back(static_cast<char>(0xc4));
+        put_be(out, s.size(), 1);
+      } else if (s.size() < (1u << 16)) {
+        out.push_back(static_cast<char>(0xc5));
+        put_be(out, s.size(), 2);
+      } else {
+        out.push_back(static_cast<char>(0xc6));
+        put_be(out, s.size(), 4);
+      }
+      out += s;
+      break;
+    }
+    case T::Arr: {
+      const Array& a = v.as_array();
+      if (a.size() < 16) {
+        out.push_back(static_cast<char>(0x90 | a.size()));
+      } else {
+        out.push_back(static_cast<char>(0xdc));
+        put_be(out, a.size(), 2);
+      }
+      for (const auto& e : a) pack_into(e, out);
+      break;
+    }
+    case T::MapT: {
+      const Map& m = v.as_map();
+      if (m.size() < 16) {
+        out.push_back(static_cast<char>(0x80 | m.size()));
+      } else {
+        out.push_back(static_cast<char>(0xde));
+        put_be(out, m.size(), 2);
+      }
+      for (const auto& kv : m) {
+        pack_into(Value(kv.first), out);
+        pack_into(kv.second, out);
+      }
+      break;
+    }
+  }
+}
+
+inline std::string pack(const Value& v) {
+  std::string out;
+  pack_into(v, out);
+  return out;
+}
+
+// -------------------------------------------------------------- unpack
+
+struct Cursor {
+  const uint8_t* p;
+  const uint8_t* end;
+  uint8_t u8() {
+    if (p >= end) throw std::runtime_error("msgpack: truncated");
+    return *p++;
+  }
+  uint64_t be(int bytes) {
+    uint64_t x = 0;
+    for (int i = 0; i < bytes; ++i) x = (x << 8) | u8();
+    return x;
+  }
+  std::string bytes(size_t n) {
+    if (p + n > end) throw std::runtime_error("msgpack: truncated");
+    std::string s(reinterpret_cast<const char*>(p), n);
+    p += n;
+    return s;
+  }
+};
+
+inline Value unpack_one(Cursor& c) {
+  uint8_t t = c.u8();
+  if (t < 0x80) return Value(static_cast<int64_t>(t));          // posfixint
+  if (t >= 0xe0) return Value(static_cast<int64_t>(static_cast<int8_t>(t)));
+  if (t >= 0xa0 && t <= 0xbf) return Value(c.bytes(t & 0x1f));  // fixstr
+  if (t >= 0x90 && t <= 0x9f) {                                 // fixarray
+    Array a;
+    for (int i = 0; i < (t & 0x0f); ++i) a.push_back(unpack_one(c));
+    return Value(std::move(a));
+  }
+  if (t >= 0x80 && t <= 0x8f) {                                 // fixmap
+    Map m;
+    for (int i = 0; i < (t & 0x0f); ++i) {
+      std::string k = unpack_one(c).as_str();
+      m.emplace(std::move(k), unpack_one(c));
+    }
+    return Value(std::move(m));
+  }
+  switch (t) {
+    case 0xc0: return Value();
+    case 0xc2: return Value(false);
+    case 0xc3: return Value(true);
+    case 0xc4: return Value::Bin(c.bytes(c.be(1)));
+    case 0xc5: return Value::Bin(c.bytes(c.be(2)));
+    case 0xc6: return Value::Bin(c.bytes(c.be(4)));
+    case 0xca: {  // float32
+      uint32_t bits = static_cast<uint32_t>(c.be(4));
+      float f;
+      std::memcpy(&f, &bits, 4);
+      return Value(static_cast<double>(f));
+    }
+    case 0xcb: {  // float64
+      uint64_t bits = c.be(8);
+      double d;
+      std::memcpy(&d, &bits, 8);
+      return Value(d);
+    }
+    case 0xcc: return Value(static_cast<int64_t>(c.be(1)));
+    case 0xcd: return Value(static_cast<int64_t>(c.be(2)));
+    case 0xce: return Value(static_cast<int64_t>(c.be(4)));
+    case 0xcf: return Value(static_cast<int64_t>(c.be(8)));
+    case 0xd0: return Value(static_cast<int64_t>(static_cast<int8_t>(c.be(1))));
+    case 0xd1: return Value(static_cast<int64_t>(static_cast<int16_t>(c.be(2))));
+    case 0xd2: return Value(static_cast<int64_t>(static_cast<int32_t>(c.be(4))));
+    case 0xd3: return Value(static_cast<int64_t>(c.be(8)));
+    case 0xd9: return Value(c.bytes(c.be(1)));
+    case 0xda: return Value(c.bytes(c.be(2)));
+    case 0xdb: return Value(c.bytes(c.be(4)));
+    case 0xdc: {
+      size_t n = c.be(2);
+      Array a;
+      for (size_t i = 0; i < n; ++i) a.push_back(unpack_one(c));
+      return Value(std::move(a));
+    }
+    case 0xdd: {
+      size_t n = c.be(4);
+      Array a;
+      for (size_t i = 0; i < n; ++i) a.push_back(unpack_one(c));
+      return Value(std::move(a));
+    }
+    case 0xde: {
+      size_t n = c.be(2);
+      Map m;
+      for (size_t i = 0; i < n; ++i) {
+        std::string k = unpack_one(c).as_str();
+        m.emplace(std::move(k), unpack_one(c));
+      }
+      return Value(std::move(m));
+    }
+    case 0xdf: {
+      size_t n = c.be(4);
+      Map m;
+      for (size_t i = 0; i < n; ++i) {
+        std::string k = unpack_one(c).as_str();
+        m.emplace(std::move(k), unpack_one(c));
+      }
+      return Value(std::move(m));
+    }
+  }
+  throw std::runtime_error("msgpack: unsupported tag");
+}
+
+inline Value unpack(const std::string& data) {
+  Cursor c{reinterpret_cast<const uint8_t*>(data.data()),
+           reinterpret_cast<const uint8_t*>(data.data() + data.size())};
+  return unpack_one(c);
+}
+
+}  // namespace msgpack_lite

@@ -1,0 +1,232 @@
+// ray_tpu C++ worker API (role parity with the reference's C++ API:
+// cpp/src/ray/api.cc ray::Init / ray::Put / ray::Get /
+// ray::Task(...).Remote()).
+//
+// Architecture: unlike the reference (whose C++ worker links the whole
+// core-worker runtime), this client speaks the ray_tpu client-server
+// protocol (ray_tpu/util/client/server.py) over one TCP connection —
+// the idiomatic integration for this runtime, where remote drivers hold
+// no local runtime and values cross languages as msgpack (the same
+// cross-language data plane the reference uses for Java/C++ calls).
+// Tasks are addressed by "module:function" descriptors executed by the
+// cluster's Python workers (reference cross_language.py py_function).
+#pragma once
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <stdexcept>
+#include <string>
+#include <type_traits>
+#include <vector>
+
+#include "msgpack_lite.hpp"
+
+namespace ray {
+
+namespace mp = msgpack_lite;
+
+// wire constants (ray_tpu/_private/rpc.py)
+constexpr int kRequest = 0;
+constexpr int kReplyOk = 1;
+constexpr int kReplyErr = 2;
+constexpr int kPush = 4;
+
+class ObjectRef {
+ public:
+  ObjectRef() = default;
+  explicit ObjectRef(std::string id) : id_(std::move(id)) {}
+  const std::string& id() const { return id_; }
+  bool valid() const { return !id_.empty(); }
+
+ private:
+  std::string id_;
+};
+
+class RayClient {
+ public:
+  void Connect(const std::string& host, int port) {
+    fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd_ < 0) throw std::runtime_error("socket() failed");
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    hostent* he = ::gethostbyname(host.c_str());
+    if (!he) throw std::runtime_error("unknown host " + host);
+    std::memcpy(&addr.sin_addr, he->h_addr, he->h_length);
+    if (::connect(fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)))
+      throw std::runtime_error("connect to " + host + " failed");
+  }
+
+  void Close() {
+    if (fd_ >= 0) ::close(fd_);
+    fd_ = -1;
+  }
+
+  bool connected() const { return fd_ >= 0; }
+
+  // one correlated request/reply (pushes are skipped)
+  mp::Value Call(const std::string& method, mp::Map data) {
+    int64_t msgid = next_id_++;
+    mp::Array frame;
+    frame.emplace_back(static_cast<int64_t>(kRequest));
+    frame.emplace_back(msgid);
+    frame.emplace_back(method);
+    frame.emplace_back(mp::Map(std::move(data)));
+    SendFrame(mp::pack(mp::Value(std::move(frame))));
+    for (;;) {
+      mp::Value reply = mp::unpack(RecvFrame());
+      const mp::Array& arr = reply.as_array();
+      int64_t kind = arr[0].as_int();
+      if (kind == kPush) continue;  // pubsub pushes are not our reply
+      if (arr[1].as_int() != msgid) continue;  // stale (shouldn't happen)
+      if (kind == kReplyErr) {
+        // data = [pickled_exc (bin), traceback (str)]
+        std::string detail = "remote error";
+        if (arr[3].type() == mp::Value::Type::Arr &&
+            arr[3].as_array().size() > 1)
+          detail = arr[3].as_array()[1].as_str();
+        throw std::runtime_error("ray_tpu server error:\n" + detail);
+      }
+      return arr[3];
+    }
+  }
+
+ private:
+  void SendAll(const char* p, size_t n) {
+    while (n) {
+      ssize_t w = ::send(fd_, p, n, 0);
+      if (w <= 0) throw std::runtime_error("send failed");
+      p += w;
+      n -= static_cast<size_t>(w);
+    }
+  }
+  void RecvAll(char* p, size_t n) {
+    while (n) {
+      ssize_t r = ::recv(fd_, p, n, 0);
+      if (r <= 0) throw std::runtime_error("connection closed");
+      p += r;
+      n -= static_cast<size_t>(r);
+    }
+  }
+  void SendFrame(const std::string& body) {
+    uint32_t len = htonl(static_cast<uint32_t>(body.size()));
+    SendAll(reinterpret_cast<const char*>(&len), 4);
+    SendAll(body.data(), body.size());
+  }
+  std::string RecvFrame() {
+    uint32_t len_be;
+    RecvAll(reinterpret_cast<char*>(&len_be), 4);
+    uint32_t len = ntohl(len_be);
+    std::string body(len, '\0');
+    RecvAll(body.data(), len);
+    return body;
+  }
+
+  int fd_ = -1;
+  std::atomic<int64_t> next_id_{1};
+};
+
+// ------------------------------------------------------------ ray:: API
+
+inline RayClient& Client() {
+  static RayClient client;
+  return client;
+}
+
+// ray::Init("host:port") — address of a ray-tpu client server
+// (`python -m ray_tpu.util.client.server --address <gcs>`)
+inline void Init(const std::string& address) {
+  auto colon = address.rfind(':');
+  if (colon == std::string::npos)
+    throw std::invalid_argument("address must be host:port");
+  Client().Connect(address.substr(0, colon),
+                   std::stoi(address.substr(colon + 1)));
+  Client().Call("ping", {});
+}
+
+inline void Shutdown() { Client().Close(); }
+
+inline ObjectRef Put(const mp::Value& value) {
+  mp::Map req;
+  req.emplace("data", value);
+  req.emplace("codec", mp::Value("msgpack"));
+  mp::Value reply = Client().Call("put", std::move(req));
+  return ObjectRef(reply["ref"].as_str());
+}
+
+inline std::vector<mp::Value> Get(const std::vector<ObjectRef>& refs,
+                                  double timeout = 120.0) {
+  mp::Array ids;
+  for (const auto& r : refs) ids.push_back(mp::Value::Bin(r.id()));
+  mp::Map req;
+  req.emplace("refs", mp::Value(std::move(ids)));
+  req.emplace("codec", mp::Value("msgpack"));
+  req.emplace("timeout", mp::Value(timeout));
+  mp::Value reply = Client().Call("get", std::move(req));
+  if (!reply["error_msg"].is_nil())
+    throw std::runtime_error(reply["error_msg"].as_str());
+  return reply["raw_values"].as_array();
+}
+
+inline mp::Value Get(const ObjectRef& ref, double timeout = 120.0) {
+  return Get(std::vector<ObjectRef>{ref}, timeout)[0];
+}
+
+// ray::Task("module:function").Remote(args...) — submit to the cluster
+class TaskCaller {
+ public:
+  explicit TaskCaller(std::string descriptor)
+      : descriptor_(std::move(descriptor)) {}
+
+  TaskCaller& SetResource(const std::string& name, double amount) {
+    resources_.emplace(name, mp::Value(amount));
+    return *this;
+  }
+
+  template <typename... Args>
+  ObjectRef Remote(Args&&... args) {
+    mp::Array packed;
+    (AppendArg(packed, std::forward<Args>(args)), ...);
+    mp::Map req;
+    req.emplace("name", mp::Value(descriptor_));
+    req.emplace("args", mp::Value(std::move(packed)));
+    if (!resources_.empty()) {
+      mp::Map opts;
+      opts.emplace("resources", mp::Value(resources_));
+      req.emplace("options", mp::Value(std::move(opts)));
+    }
+    mp::Value reply = Client().Call("task_by_name", std::move(req));
+    return ObjectRef(reply["refs"].as_array()[0].as_str());
+  }
+
+ private:
+  template <typename T>
+  static void AppendArg(mp::Array& out, T&& v) {
+    if constexpr (std::is_same_v<std::decay_t<T>, ObjectRef>) {
+      // refs travel as {"__ref__": id} placeholders the server
+      // rehydrates to its pinned ObjectRef
+      mp::Map placeholder;
+      placeholder.emplace("__ref__", mp::Value::Bin(v.id()));
+      out.emplace_back(std::move(placeholder));
+    } else {
+      out.emplace_back(mp::Value(std::forward<T>(v)));
+    }
+  }
+
+  std::string descriptor_;
+  mp::Map resources_;
+};
+
+inline TaskCaller Task(const std::string& descriptor) {
+  return TaskCaller(descriptor);
+}
+
+inline mp::Value ClusterResources() {
+  return Client().Call("cluster_resources", {});
+}
+
+}  // namespace ray

@@ -134,6 +134,7 @@ class Raylet:
             "pin_object": self.h_pin_object,
             "cluster_info": self.h_cluster_info,
             "get_metrics": self.h_get_metrics,
+            "set_resource": self.h_set_resource,
             "actor_exiting": self.h_actor_exiting,
             # gcs-facing
             "create_actor": self.h_create_actor,
@@ -872,6 +873,36 @@ class Raylet:
     # cluster info
     # ------------------------------------------------------------------
 
+    async def h_set_resource(self, conn, d):
+        """Dynamically resize one resource's capacity on this node
+        (reference: ray.experimental.set_resource →
+        node_manager.cc resource update path). Capacity 0 deletes it."""
+        from ray_tpu._private.common import quantize
+
+        name = d["resource_name"]
+        new_total = quantize(float(d["capacity"]))
+        old_total = self.total.raw().get(name, 0)
+        delta = new_total - old_total
+        t = self.total.raw()
+        a = self.available.raw()
+        if new_total <= 0:
+            # delete from totals, but keep availability DELTA accounting:
+            # leases still out will release back into `a`, and dropping
+            # the entry here would let that release resurrect capacity
+            # for a resource that no longer exists
+            t.pop(name, None)
+            a[name] = a.get(name, 0) - old_total
+            if a[name] == 0:
+                a.pop(name)
+        else:
+            t[name] = new_total
+            a[name] = a.get(name, 0) + delta  # may go negative while busy
+        self.total = ResourceSet.from_raw(t)
+        self.available = ResourceSet.from_raw(a)
+        # fresh capacity may unblock queued leases
+        await self._dispatch_pending()
+        return {"total": self.total.raw(), "available": self.available.raw()}
+
     async def h_get_metrics(self, conn, d):
         from ray_tpu._private import stats
 
@@ -884,6 +915,36 @@ class Raylet:
                                         "value": len(self.local_objects)}
         snap["raylet.pending_leases"] = {"type": "gauge",
                                          "value": len(self.pending_leases)}
+        # fold in per-worker process metrics (user-defined metrics from
+        # util/metrics.py live in worker processes)
+        import asyncio
+
+        async def _pull(w):
+            try:
+                return await asyncio.wait_for(
+                    w.conn.call("get_stats", {}), timeout=2.0)
+            except Exception:
+                return {}
+
+        worker_snaps = await asyncio.gather(
+            *[_pull(w) for w in list(self.workers.values())
+              if not w.conn.closed])
+        for ws in worker_snaps:
+            for name, m in ws.items():
+                cur = snap.get(name)
+                if cur is None:
+                    snap[name] = dict(m)
+                elif m.get("type") == "count" and cur.get("type") == "count":
+                    cur["value"] = cur.get("value", 0) + m.get("value", 0)
+                elif (m.get("type") == "histogram"
+                      and cur.get("type") == "histogram"
+                      and m.get("boundaries") == cur.get("boundaries")):
+                    cur["counts"] = [a + b for a, b in
+                                     zip(cur["counts"], m["counts"])]
+                    cur["sum"] = cur.get("sum", 0) + m.get("sum", 0)
+                    cur["count"] = cur.get("count", 0) + m.get("count", 0)
+                else:
+                    snap[name] = dict(m)  # gauges: last writer wins
         return snap
 
     async def h_cluster_info(self, conn, d):
@@ -907,7 +968,7 @@ class Raylet:
     async def _handle_gcs_push(self, channel, data):
         if channel == "nodes":
             node = data["node"]
-            if data["event"] == "added":
+            if data["event"] in ("added", "updated"):
                 self.cluster_nodes[node["node_id"]] = node
             else:
                 self.cluster_nodes.pop(node["node_id"], None)

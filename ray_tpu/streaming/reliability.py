@@ -1,0 +1,69 @@
+"""Streaming fault tolerance: checkpoint barriers + replay (reference:
+streaming/src/reliability/barrier_helper.cc + barrier coordination in
+streaming/src/data_writer.cc — at-least-once/exactly-once via barriers).
+
+Mechanism (Chandy–Lamport style, as in the reference's aligned barriers):
+sources inject a barrier marker every `checkpoint_interval` batches,
+tagged with a checkpoint id and the source's replay offset. A stage that
+has seen the barrier from SOME upstream instance buffers further batches
+from that upstream until the barrier has arrived from ALL of them
+(alignment), then snapshots its operator state (reduce aggregates, sink
+buffer, round-robin cursor) to the cluster KV and forwards the barrier.
+Because alignment prevents post-barrier records from leaking into the
+snapshot, restored state is consistent: re-driving sources from their
+recorded offsets reprocesses exactly the post-checkpoint suffix.
+
+Snapshot keys: stream:{job}:{ckpt}:{stage}:{instance} → pickled state,
+plus stream:{job}:{ckpt}:manifest once the driver confirms completeness.
+Sink *state* is exactly-once (it's in the snapshot); user sink side
+effects replay at-least-once, same caveat as the reference."""
+
+from __future__ import annotations
+
+import cloudpickle
+
+BARRIER = "__ray_tpu_stream_barrier__"
+
+
+def kv_key(job_id: str, ckpt_id: int, stage: int, inst: int) -> str:
+    return f"stream:{job_id}:{ckpt_id}:{stage}:{inst}"
+
+
+def save_snapshot(job_id: str, ckpt_id: int, stage: int, inst: int,
+                  state: dict):
+    from ray_tpu.experimental.internal_kv import _kv_put
+
+    _kv_put(kv_key(job_id, ckpt_id, stage, inst),
+            cloudpickle.dumps(state))
+
+
+def load_snapshot(job_id: str, ckpt_id: int, stage: int,
+                  inst: int) -> dict | None:
+    from ray_tpu.experimental.internal_kv import _kv_get
+
+    raw = _kv_get(kv_key(job_id, ckpt_id, stage, inst))
+    return None if raw is None else cloudpickle.loads(raw)
+
+
+def bump_max_checkpoint(job_id: str, ckpt_id: int):
+    from ray_tpu.experimental.internal_kv import _kv_get, _kv_put
+
+    key = f"stream:{job_id}:max_ckpt"
+    cur = _kv_get(key)
+    if cur is None or int(cur) < ckpt_id:
+        _kv_put(key, str(ckpt_id).encode())
+
+
+def find_complete_checkpoint(job_id: str, plan: list[int]) -> int | None:
+    """Latest ckpt id for which every stage instance snapshotted.
+    `plan` = instances per stage."""
+    from ray_tpu.experimental.internal_kv import _kv_get
+
+    raw = _kv_get(f"stream:{job_id}:max_ckpt")
+    if raw is None:
+        return None
+    for ckpt in range(int(raw), 0, -1):
+        if all(_kv_get(kv_key(job_id, ckpt, s, i)) is not None
+               for s, n in enumerate(plan) for i in range(n)):
+            return ckpt
+    return None

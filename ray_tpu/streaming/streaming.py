@@ -2,7 +2,8 @@
 
 Role parity with the reference's streaming engine (reference:
 streaming/python — StreamingContext, DataStream, KeyDataStream,
-word-count e2e in its tests), redesigned for this runtime instead of the
+word-count e2e in its tests; reliability: streaming/src/reliability/
+barrier_helper.cc), redesigned for this runtime instead of the
 reference's C++ DataWriter/DataReader channels:
 
 - logical graph: chained operators, each with its own parallelism;
@@ -16,14 +17,23 @@ reference's C++ DataWriter/DataReader channels:
   once ALL of its upstream instances finished; reducers flush their
   per-key state on EOS (so finite pipelines behave like batch jobs);
 - results: sink() collects into sink actors the driver drains at the
-  end of run().
+  end of run();
+- fault tolerance (checkpoint_interval=N): aligned checkpoint barriers
+  snapshot operator state to the cluster KV; on a stage-actor death the
+  driver rebuilds the DAG from the last complete checkpoint and re-drives
+  sources from their recorded offsets (streaming/reliability.py).
 """
 
 from __future__ import annotations
 
+import uuid
+
 import cloudpickle
 
 import ray_tpu
+from ray_tpu.streaming.reliability import (BARRIER, bump_max_checkpoint,
+                                           find_complete_checkpoint,
+                                           load_snapshot, save_snapshot)
 
 _EOS = "__ray_tpu_stream_eos__"
 
@@ -57,11 +67,15 @@ class _StageActor:
     """One parallel instance of one operator."""
 
     def __init__(self, op_pickled: bytes, index: int, num_upstream: int,
-                 stall_timeout: float = 300.0):
+                 stall_timeout: float = 300.0, job_id: str = "",
+                 stage_index: int = 0, restore_ckpt: int = 0):
         kind, fn = cloudpickle.loads(op_pickled)
         self._kind = kind
         self._fn = fn
         self._index = index
+        self._stage = stage_index
+        self._job = job_id
+        self._num_upstream = num_upstream
         self._eos_left = num_upstream
         self._downstream = None          # list[handle] | None
         self._partitioned = False
@@ -71,6 +85,18 @@ class _StageActor:
         self._state = {}                 # reduce: key -> aggregate
         self._out = []                   # sink: collected records
         self._rr = -1
+        # barrier alignment (reliability.py)
+        self._barrier_from: set[int] = set()
+        self._eos_from: set[int] = set()
+        self._aligned_buffer: list[tuple[int, list]] = []
+        self._barrier_offsets: dict = {}
+        self._pending_ckpt: int = 0
+        if restore_ckpt and job_id:
+            snap = load_snapshot(job_id, restore_ckpt, stage_index, index)
+            if snap is not None:
+                self._state = snap["state"]
+                self._out = snap["out"]
+                self._rr = snap["rr"]
 
     def connect(self, downstream, partitioned: bool):
         self._downstream = list(downstream)
@@ -92,7 +118,7 @@ class _StageActor:
             # instead of silently dropping data.
             ray_tpu.get(ready)
             self._inflight[key] = refs = rest
-        refs.append(target.process.remote(batch))
+        refs.append(target.process.remote(batch, self._index))
 
     def _emit(self, records):
         if not records or self._downstream is None:
@@ -109,6 +135,14 @@ class _StageActor:
             self._rr = (self._rr + 1) % len(self._downstream)
             self._push(self._downstream[self._rr], records)
 
+    def _broadcast(self, marker):
+        if self._downstream is None:
+            return
+        for target in self._downstream:
+            # markers must arrive AFTER the data already in flight: the
+            # per-target call order guarantees it.
+            self._push(target, marker)
+
     def _flush_and_forward_eos(self):
         if self._kind == "reduce" and self._downstream is not None:
             items = list(self._state.items())
@@ -116,21 +150,75 @@ class _StageActor:
                 self._emit(items[i:i + 256])
             self._state = {}
         if self._downstream is not None:
-            for target in self._downstream:
-                # EOS must arrive AFTER the data already in flight: the
-                # per-target call order guarantees it.
-                self._push(target, _EOS)
+            self._broadcast(_EOS)
             for refs in self._inflight.values():
                 ray_tpu.get(refs, timeout=self._stall_timeout)
             self._inflight = {}
 
+    # -- checkpoint barriers (reliability.py) ----------------------------
+
+    def _snapshot(self, ckpt_id: int):
+        save_snapshot(self._job, ckpt_id, self._stage, self._index, {
+            "state": self._state,
+            "out": self._out,
+            "rr": self._rr,
+        })
+
+    def _on_barrier(self, marker: dict, from_idx: int):
+        if from_idx in self._barrier_from:
+            # this upstream raced ahead into its NEXT checkpoint while we
+            # still await others for the current one — hold its barrier in
+            # the alignment buffer with its data (replayed in order)
+            self._aligned_buffer.append((from_idx, marker))
+            return True
+        self._barrier_from.add(from_idx)
+        self._barrier_offsets.update(marker.get("offsets", {}))
+        self._pending_ckpt = marker["ckpt"]
+        self._maybe_complete_barrier()
+        return True
+
+    def _maybe_complete_barrier(self):
+        """Aligned once every upstream has either sent the barrier or
+        finished (EOS — it will never send one; an upstream with a
+        shorter input must not deadlock the alignment)."""
+        if not self._barrier_from:
+            return
+        if len(self._barrier_from | self._eos_from) < self._num_upstream:
+            return
+        ckpt_id = self._pending_ckpt
+        self._snapshot(ckpt_id)
+        self._broadcast({BARRIER: True, "ckpt": ckpt_id,
+                         "offsets": self._barrier_offsets})
+        self._barrier_from = set()
+        self._barrier_offsets = {}
+        buffered, self._aligned_buffer = self._aligned_buffer, []
+        for from_i, batch in buffered:
+            self.process(batch, from_i)
+
     # -- operator semantics ----------------------------------------------
 
-    def process(self, batch):
+    def process(self, batch, from_idx: int = 0):
         if isinstance(batch, str) and batch == _EOS:
             self._eos_left -= 1
+            self._eos_from.add(from_idx)
+            # a finished upstream can no longer send barriers: re-check
+            # alignment so live upstreams' checkpoints still complete
+            self._maybe_complete_barrier()
             if self._eos_left == 0:
+                # release anything still held for an alignment that can
+                # no longer complete, then flush
+                buffered, self._aligned_buffer = self._aligned_buffer, []
+                self._barrier_from = set()
+                for from_i, b in buffered:
+                    self.process(b, from_i)
                 self._flush_and_forward_eos()
+            return True
+        if isinstance(batch, dict) and batch.get(BARRIER):
+            return self._on_barrier(batch, from_idx)
+        if from_idx in self._barrier_from:
+            # alignment: this upstream already passed the barrier; hold
+            # its post-barrier data out of the pre-barrier snapshot
+            self._aligned_buffer.append((from_idx, batch))
             return True
         kind, fn = self._kind, self._fn
         if kind == "map":
@@ -157,19 +245,50 @@ class _StageActor:
         self._emit(out)
         return True
 
-    def drain_source(self, batch_size: int = 128):
-        """Source instances: pull from the user iterable and push."""
+    def drain_source(self, batch_size: int = 128,
+                     checkpoint_interval: int = 0,
+                     resume_offset: int = 0, resume_ckpt: int = 0):
+        """Source instances: pull from the user iterable and push.
+        With checkpointing on, a barrier follows every
+        `checkpoint_interval` batches, carrying this instance's record
+        offset; `resume_offset` skips records already covered by the
+        checkpoint being restored and `resume_ckpt` continues its
+        numbering (deterministic sources make snapshots from different
+        run attempts interchangeable at the same ckpt id)."""
+        import itertools
+
         it = self._fn() if callable(self._fn) else iter(self._fn)
+        if resume_offset:
+            it = itertools.islice(it, resume_offset, None)
+        offset = resume_offset
+        batches_since = 0
+        ckpt_id = resume_ckpt
         buf = []
         for item in it:
             buf.append(item)
             if len(buf) >= batch_size:
                 self._emit(buf)
+                offset += len(buf)
                 buf = []
+                batches_since += 1
+                if (checkpoint_interval
+                        and batches_since >= checkpoint_interval):
+                    batches_since = 0
+                    ckpt_id += 1
+                    self._snapshot_source(ckpt_id, offset)
         if buf:
             self._emit(buf)
+            offset += len(buf)
         self._flush_and_forward_eos()
         return True
+
+    def _snapshot_source(self, ckpt_id: int, offset: int):
+        save_snapshot(self._job, ckpt_id, self._stage, self._index,
+                      {"state": {}, "out": [], "rr": self._rr,
+                       "offset": offset})
+        bump_max_checkpoint(self._job, ckpt_id)
+        self._broadcast({BARRIER: True, "ckpt": ckpt_id,
+                         "offsets": {self._index: offset}})
 
     def collect(self):
         out, self._out = self._out, []
@@ -220,13 +339,20 @@ class DataStream:
 
 class StreamingContext:
     def __init__(self, batch_size: int = 128,
-                 stall_timeout: float = 300.0):
+                 stall_timeout: float = 300.0,
+                 checkpoint_interval: int = 0,
+                 max_restarts: int = 0):
         """stall_timeout bounds every intra-pipeline wait (backpressure,
         EOS flush) inside the stage actors; run(timeout=...) bounds the
-        driver-side end-to-end drive."""
+        driver-side end-to-end drive. checkpoint_interval > 0 turns on
+        barrier checkpointing every N source batches; max_restarts is how
+        many times run() rebuilds a failed DAG from the last complete
+        checkpoint before giving up."""
         self._pipelines: list[list[_Op]] = []
         self._batch_size = batch_size
         self._stall_timeout = stall_timeout
+        self._checkpoint_interval = checkpoint_interval
+        self._max_restarts = max_restarts
 
     # -- sources ---------------------------------------------------------
 
@@ -244,12 +370,28 @@ class StreamingContext:
         return the concatenated sink outputs."""
         results = []
         for ops in self._pipelines:
-            results.extend(self._run_one(ops, timeout))
+            results.extend(self._run_with_recovery(ops, timeout))
         return results
 
-    def _run_one(self, ops: list[_Op], timeout: float) -> list:
+    def _run_with_recovery(self, ops: list[_Op], timeout: float) -> list:
+        job_id = uuid.uuid4().hex[:12]
+        attempts = self._max_restarts + 1
+        last_err = None
+        for attempt in range(attempts):
+            restore = 0
+            if attempt and self._checkpoint_interval:
+                plan = [op.parallelism for op in ops]
+                restore = find_complete_checkpoint(job_id, plan) or 0
+            try:
+                return self._run_one(ops, timeout, job_id, restore)
+            except Exception as e:
+                last_err = e
+                if attempt + 1 >= attempts:
+                    raise
+        raise last_err  # unreachable
+
+    def _build_stages(self, ops: list[_Op], job_id: str, restore: int):
         stage_cls = ray_tpu.remote(_StageActor)
-        # instantiate every stage, then wire edges, then drive sources
         stages: list[list] = []
         for i, op in enumerate(ops):
             num_up = 1 if i == 0 else ops[i - 1].parallelism
@@ -262,9 +404,15 @@ class StreamingContext:
                     else:  # collection: slice driver-side, ship the slice
                         fn = list(fn)[j::op.parallelism]
                 pickled = cloudpickle.dumps((op.kind, fn))
-                row.append(stage_cls.remote(pickled, j, num_up,
-                                            self._stall_timeout))
+                row.append(stage_cls.remote(
+                    pickled, j, num_up, self._stall_timeout, job_id, i,
+                    restore))
             stages.append(row)
+        return stages
+
+    def _run_one(self, ops: list[_Op], timeout: float, job_id: str = "",
+                 restore: int = 0) -> list:
+        stages = self._build_stages(ops, job_id, restore)
         # wire edges; the edge INTO the op after key_by is hash-partitioned
         wiring = []
         for i in range(len(ops) - 1):
@@ -274,9 +422,18 @@ class StreamingContext:
                                                   partitioned))
         try:
             ray_tpu.get(wiring, timeout=min(60.0, timeout))
-            # drive sources to completion (EOS cascades through the chain)
-            ray_tpu.get([s.drain_source.remote(self._batch_size)
-                         for s in stages[0]], timeout=timeout)
+            # drive sources to completion (EOS cascades through the
+            # chain); restored runs resume from the checkpoint offsets
+            drains = []
+            for j, s in enumerate(stages[0]):
+                offset = 0
+                if restore:
+                    snap = load_snapshot(job_id, restore, 0, j)
+                    offset = (snap or {}).get("offset", 0)
+                drains.append(s.drain_source.remote(
+                    self._batch_size, self._checkpoint_interval, offset,
+                    restore))
+            ray_tpu.get(drains, timeout=timeout)
             # EOS has reached the sinks only after every intermediate
             # actor acked; collect sink outputs
             out = []

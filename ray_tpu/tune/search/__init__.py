@@ -2,7 +2,9 @@
 BasicVariantGenerator (grid × random sampling, suggest/basic_variant.py)."""
 
 from ray_tpu.tune.search.basic_variant import BasicVariantGenerator
-from ray_tpu.tune.search.searcher import ConcurrencyLimiter, Repeater, Searcher
+from ray_tpu.tune.search.searcher import (ConcurrencyLimiter, Repeater,
+                                          SampleBudget, Searcher)
+from ray_tpu.tune.search.tpe import TPESearcher, TuneBOHB
 
 __all__ = ["BasicVariantGenerator", "ConcurrencyLimiter", "Repeater",
-           "Searcher"]
+           "SampleBudget", "Searcher", "TPESearcher", "TuneBOHB"]

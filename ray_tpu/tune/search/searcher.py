@@ -36,6 +36,43 @@ class Searcher:
         return False
 
 
+class SampleBudget(Searcher):
+    """Caps total suggestions at num_samples — gives model-based
+    searchers (which never self-exhaust) the reference's
+    tune.run(num_samples=N) stopping semantics (reference:
+    suggest/search_generator.py SearchGenerator counts its trials)."""
+
+    def __init__(self, searcher: Searcher, num_samples: int):
+        super().__init__(searcher.metric, searcher.mode)
+        self.searcher = searcher
+        self.num_samples = num_samples
+        self._suggested = 0
+
+    def set_search_properties(self, metric, mode, config):
+        ok = self.searcher.set_search_properties(metric, mode, config)
+        self.metric = self.searcher.metric
+        self.mode = self.searcher.mode
+        return ok
+
+    def suggest(self, trial_id):
+        if self._suggested >= self.num_samples:
+            return None
+        config = self.searcher.suggest(trial_id)
+        if config is not None:
+            self._suggested += 1
+        return config
+
+    def on_trial_result(self, trial_id, result):
+        self.searcher.on_trial_result(trial_id, result)
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        self.searcher.on_trial_complete(trial_id, result, error)
+
+    def is_finished(self):
+        return (self._suggested >= self.num_samples
+                or self.searcher.is_finished())
+
+
 class ConcurrencyLimiter(Searcher):
     """Caps concurrent unfinished suggestions (reference:
     suggest/suggestion.py ConcurrencyLimiter)."""

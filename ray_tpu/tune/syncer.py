@@ -1,0 +1,69 @@
+"""Checkpoint/result syncing (reference: python/ray/tune/syncer.py
+SyncConfig/Syncer + sync_client.py CommandBasedClient).
+
+Mirrors each trial's logdir to an upload location so experiment state
+survives the head node. Two modes:
+- upload_dir on a mounted filesystem → built-in mirror copy (no deps)
+- sync_template e.g. "rsync -a {source} {target}" → run the command
+  (the reference's command-based sync client)
+"""
+
+from __future__ import annotations
+
+import os
+import shlex
+import shutil
+import subprocess
+import time
+
+
+class SyncConfig:
+    def __init__(self, upload_dir: str | None = None,
+                 sync_template: str | None = None,
+                 sync_period: float = 300.0):
+        self.upload_dir = upload_dir
+        self.sync_template = sync_template
+        self.sync_period = sync_period
+
+
+class Syncer:
+    def __init__(self, config: SyncConfig):
+        self.config = config
+        self._last_sync: dict[str, float] = {}
+
+    def _target_for(self, logdir: str) -> str:
+        return os.path.join(self.config.upload_dir,
+                            os.path.basename(logdir.rstrip("/")))
+
+    def sync_up(self, logdir: str, force: bool = False) -> bool:
+        """Mirror `logdir` to the upload location. Rate-limited by
+        sync_period unless force."""
+        if not self.config.upload_dir or not os.path.isdir(logdir):
+            return False
+        now = time.monotonic()
+        last = self._last_sync.get(logdir)
+        if (not force and last is not None
+                and now - last < self.config.sync_period):
+            return False
+        self._last_sync[logdir] = now
+        target = self._target_for(logdir)
+        if self.config.sync_template:
+            cmd = self.config.sync_template.format(
+                source=shlex.quote(logdir), target=shlex.quote(target))
+            proc = subprocess.run(cmd, shell=True, capture_output=True)
+            return proc.returncode == 0
+        os.makedirs(target, exist_ok=True)
+        shutil.copytree(logdir, target, dirs_exist_ok=True)
+        return True
+
+    def sync_down(self, logdir: str) -> bool:
+        """Restore a trial logdir from the upload location (head-node
+        recovery path)."""
+        if not self.config.upload_dir:
+            return False
+        source = self._target_for(logdir)
+        if not os.path.isdir(source):
+            return False
+        os.makedirs(logdir, exist_ok=True)
+        shutil.copytree(source, logdir, dirs_exist_ok=True)
+        return True

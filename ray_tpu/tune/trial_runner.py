@@ -60,7 +60,8 @@ class TrialRunner:
                  max_failures: int = 0,
                  local_dir: str | None = None,
                  loggers=None,
-                 progress_reporter=None):
+                 progress_reporter=None,
+                 sync_config=None):
         from ray_tpu.tune.placement_groups import PlacementGroupFactory
 
         self._trainable_cls = trainable_cls
@@ -87,6 +88,11 @@ class TrialRunner:
         self._logger_classes = loggers
         self._loggers: dict[str, object] = {}
         self._reporter = progress_reporter
+        self._syncer = None
+        if sync_config is not None and sync_config.upload_dir:
+            from ray_tpu.tune.syncer import Syncer
+
+            self._syncer = Syncer(sync_config)
         self.trials: list[Trial] = []
         self._search.set_search_properties(metric, mode, None)
         self._scheduler.set_search_properties(metric, mode)
@@ -249,6 +255,11 @@ class TrialRunner:
         lg = self._logger_for(trial)
         if lg is not None:
             lg.on_result(result)
+        if self._syncer is not None and self._local_dir:
+            import os
+
+            self._syncer.sync_up(
+                os.path.join(self._local_dir, trial.trial_id))
         self._search.on_trial_result(trial.trial_id, result)
         if (self._checkpoint_freq
                 and trial.iteration % self._checkpoint_freq == 0):
@@ -294,6 +305,11 @@ class TrialRunner:
         self._scheduler.on_trial_complete(self, trial, result)
         self._search.on_trial_complete(trial.trial_id, result)
         self._stop_trial(trial, TERMINATED)
+        if self._syncer is not None and self._local_dir:
+            import os
+
+            self._syncer.sync_up(
+                os.path.join(self._local_dir, trial.trial_id), force=True)
 
     def run(self):
         while not self.is_finished():

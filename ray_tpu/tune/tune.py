@@ -72,7 +72,7 @@ def run(run_or_experiment, *, config: dict | None = None,
         max_concurrent_trials: int = 0, checkpoint_freq: int = 0,
         max_failures: int = 0, verbose: int = 1,
         local_dir: str | None = None, loggers=None,
-        progress_reporter=None,
+        progress_reporter=None, sync_config=None,
         raise_on_failed_trial: bool = True) -> ExperimentAnalysis:
     """Run a hyperparameter sweep (reference: tune/tune.py:71).
 
@@ -88,8 +88,18 @@ def run(run_or_experiment, *, config: dict | None = None,
     else:
         raise TypeError(f"not a trainable: {run_or_experiment!r}")
 
-    search = search_alg or BasicVariantGenerator(
-        config or {}, num_samples=num_samples)
+    if search_alg is None:
+        search = BasicVariantGenerator(config or {},
+                                       num_samples=num_samples)
+    else:
+        from ray_tpu.tune.search.searcher import SampleBudget
+
+        search = search_alg
+        # feed the config's Domain leaves to model-based searchers and
+        # cap them at num_samples (they never self-exhaust)
+        search.set_search_properties(metric, mode, config or {})
+        if num_samples:
+            search = SampleBudget(search, num_samples)
     runner = TrialRunner(
         trainable_cls,
         search_alg=search,
@@ -104,6 +114,7 @@ def run(run_or_experiment, *, config: dict | None = None,
         local_dir=local_dir,
         loggers=loggers,
         progress_reporter=progress_reporter,
+        sync_config=sync_config,
     )
     runner.run()
     errored = [t for t in runner.trials if t.status == "ERROR"]

@@ -1,0 +1,80 @@
+"""Serializability inspection (reference:
+python/ray/util/check_serialize.py inspect_serializability — walks an
+object graph to point at the exact member that can't pickle)."""
+
+from __future__ import annotations
+
+import inspect
+from typing import Any, Set, Tuple
+
+
+class FailureTuple:
+    """One unserializable leaf: the object, its attribute name, and the
+    parent that holds it."""
+
+    def __init__(self, obj: Any, name: str, parent: Any):
+        self.obj = obj
+        self.name = name
+        self.parent = parent
+
+    def __repr__(self):
+        return f"FailureTuple(obj={self.obj!r}, name={self.name}, parent={self.parent!r})"
+
+
+def _can_pickle(obj) -> bool:
+    import cloudpickle
+
+    try:
+        cloudpickle.dumps(obj)
+        return True
+    except Exception:
+        return False
+
+
+def _scan(obj, name, parent, failures: list, seen: Set[int], depth: int):
+    if id(obj) in seen or depth > 4:
+        return
+    seen.add(id(obj))
+    if _can_pickle(obj):
+        return
+    found_inner = False
+    # descend: closures, attributes, containers — blame the leaf
+    if inspect.isfunction(obj) and obj.__closure__:
+        for var, cell in zip(obj.__code__.co_freevars, obj.__closure__):
+            try:
+                inner = cell.cell_contents
+            except ValueError:
+                continue
+            if not _can_pickle(inner):
+                found_inner = True
+                _scan(inner, var, obj, failures, seen, depth + 1)
+    elif isinstance(obj, dict):
+        for k, v in obj.items():
+            if not _can_pickle(v):
+                found_inner = True
+                _scan(v, str(k), obj, failures, seen, depth + 1)
+    elif isinstance(obj, (list, tuple, set)):
+        for i, v in enumerate(obj):
+            if not _can_pickle(v):
+                found_inner = True
+                _scan(v, f"[{i}]", obj, failures, seen, depth + 1)
+    elif hasattr(obj, "__dict__"):
+        for k, v in vars(obj).items():
+            if not _can_pickle(v):
+                found_inner = True
+                _scan(v, k, obj, failures, seen, depth + 1)
+    if not found_inner:
+        failures.append(FailureTuple(obj, name, parent))
+
+
+def inspect_serializability(
+        obj: Any, name: str | None = None
+) -> Tuple[bool, Set[FailureTuple]]:
+    """-> (serializable, failures). failures point at the innermost
+    unserializable members (reference: check_serialize.py:117)."""
+    name = name or getattr(obj, "__name__", repr(obj))
+    if _can_pickle(obj):
+        return True, set()
+    failures: list = []
+    _scan(obj, name, None, failures, set(), 0)
+    return False, set(failures)

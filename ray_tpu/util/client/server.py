@@ -47,6 +47,7 @@ class ClientServer:
             "wait": self.h_wait,
             "register_function": self.h_register_function,
             "task": self.h_task,
+            "task_by_name": self.h_task_by_name,
             "create_actor": self.h_create_actor,
             "actor_call": self.h_actor_call,
             "kill_actor": self.h_kill_actor,
@@ -102,10 +103,19 @@ class ClientServer:
         return _Unpickler(io.BytesIO(blob)).load()
 
     # -- API surface -----------------------------------------------------
+    # codec="msgpack" switches the value plane from pickle to msgpack so
+    # non-Python clients (the C++ API, native/cpp/) can move plain data —
+    # the same role the reference's cross-language msgpack serialization
+    # plays for its Java/C++ workers (reference:
+    # java/runtime/.../serializer/, src/ray/core_worker —
+    # cross-language calls serialize args as msgpack).
 
     async def h_put(self, conn, d):
         st = self._state(conn)
-        value = cloudpickle.loads(d["data"])
+        if d.get("codec") == "msgpack":
+            value = d["data"]  # already decoded by the rpc layer
+        else:
+            value = cloudpickle.loads(d["data"])
         loop = asyncio.get_running_loop()
         ref = await loop.run_in_executor(None, self._ray.put, value)
         return {"ref": self._track_refs(st, [ref])[0]}
@@ -119,7 +129,19 @@ class ClientServer:
                 None, lambda: self._ray.get(refs,
                                             timeout=d.get("timeout")))
         except Exception as e:
+            if d.get("codec") == "msgpack":
+                return {"error_msg": f"{type(e).__name__}: {e}"}
             return {"error": cloudpickle.dumps(e)}
+        if d.get("codec") == "msgpack":
+            import msgpack
+
+            try:  # pre-validate so the client gets a clear error
+                msgpack.packb(values, use_bin_type=True)
+            except Exception as e:
+                return {"error_msg":
+                        f"result not msgpack-encodable for a "
+                        f"cross-language client: {e}"}
+            return {"raw_values": values}
         return {"values": cloudpickle.dumps(values)}
 
     async def h_wait(self, conn, d):
@@ -149,6 +171,30 @@ class ClientServer:
         loop = asyncio.get_running_loop()
         out = await loop.run_in_executor(
             None, lambda: rf.remote(*args, **kwargs))
+        refs = out if isinstance(out, list) else [out]
+        return {"refs": self._track_refs(st, refs)}
+
+    async def h_task_by_name(self, conn, d):
+        """Cross-language task submission: the callee is a Python
+        function addressed "module:qualname", args are msgpack data
+        (reference: Java→Python calls address functions by descriptor,
+        e.g. cross_language.java_function / py_function)."""
+        import importlib
+
+        st = self._state(conn)
+        mod_name, _, fn_name = d["name"].partition(":")
+        fn = importlib.import_module(mod_name)
+        for part in fn_name.split("."):
+            fn = getattr(fn, part)
+        opts = d.get("options") or {}
+        rf = self._ray.remote(**opts)(fn) if opts else self._ray.remote(fn)
+        args = d.get("args") or []
+        # ref placeholders: {"__ref__": ref_id} rehydrates to the pinned ref
+        args = [st.refs[a["__ref__"]]
+                if isinstance(a, dict) and "__ref__" in a else a
+                for a in args]
+        loop = asyncio.get_running_loop()
+        out = await loop.run_in_executor(None, lambda: rf.remote(*args))
         refs = out if isinstance(out, list) else [out]
         return {"refs": self._track_refs(st, refs)}
 

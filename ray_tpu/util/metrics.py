@@ -1,0 +1,92 @@
+"""User-defined application metrics (reference: python/ray/util/metrics.py
+Count/Gauge/Histogram over the C++ stats layer).
+
+Metrics register in the defining process's stats registry
+(_private/stats.py); worker registries are pulled and merged by the local
+raylet on every metrics scrape, so values defined inside tasks/actors
+show up in `ray_tpu.cluster_metrics()` / `ray-tpu metrics` tagged by
+their metric name. Tag dicts are folded into the metric name
+(`name{k=v,...}`) — one time series per tag combination, like the
+reference's per-tag OpenCensus streams."""
+
+from __future__ import annotations
+
+from ray_tpu._private import stats
+
+
+def _tagged(name: str, tags: dict | None) -> str:
+    if not tags:
+        return name
+    inner = ",".join(f"{k}={tags[k]}" for k in sorted(tags))
+    return f"{name}{{{inner}}}"
+
+
+class _UserMetric:
+    _impl_cls: type = None
+    _default_tags: dict
+
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: tuple = ()):
+        self._name = name
+        self._description = description
+        self._tag_keys = tuple(tag_keys)
+        self._default_tags = {}
+        self._series: dict[str, stats.Metric] = {}
+
+    def set_default_tags(self, tags: dict):
+        self._default_tags = dict(tags)
+        return self
+
+    def _series_for(self, tags: dict | None):
+        merged = {**self._default_tags, **(tags or {})}
+        extra = set(merged) - set(self._tag_keys)
+        if extra:
+            raise ValueError(
+                f"tags {sorted(extra)} not in declared tag_keys "
+                f"{self._tag_keys}")
+        key = _tagged(self._name, merged)
+        m = self._series.get(key)
+        if m is None:
+            m = self._make(key)
+            self._series[key] = m
+        return m
+
+
+class Counter(_UserMetric):
+    """Monotonic counter (reference: util/metrics.py Count)."""
+
+    def _make(self, key):
+        return stats.Count(key, self._description)
+
+    def inc(self, value: float = 1.0, tags: dict | None = None):
+        if value <= 0:
+            raise ValueError("Counter.inc requires value > 0")
+        self._series_for(tags).inc(value)
+
+
+class Gauge(_UserMetric):
+    def _make(self, key):
+        return stats.Gauge(key, self._description)
+
+    def set(self, value: float, tags: dict | None = None):
+        self._series_for(tags).set(value)
+
+
+class Histogram(_UserMetric):
+    def __init__(self, name: str, description: str = "",
+                 boundaries: list[float] | None = None,
+                 tag_keys: tuple = ()):
+        super().__init__(name, description, tag_keys)
+        if not boundaries:
+            raise ValueError("Histogram requires bucket boundaries")
+        self._boundaries = list(boundaries)
+
+    def _make(self, key):
+        return stats.Histogram(key, self._boundaries, self._description)
+
+    def observe(self, value: float, tags: dict | None = None):
+        self._series_for(tags).observe(value)
+
+
+# reference aliases (util/metrics.py exports Count for the counter)
+Count = Counter

@@ -1,0 +1,15 @@
+"""Functions the C++ API demo invokes by descriptor
+("tests.cpp_demo_funcs:add") — the cross-language callee side
+(reference: cross-language py_function descriptors)."""
+
+
+def add(a, b):
+    return a + b
+
+
+def double_it(x):
+    return 2 * x
+
+
+def boom():
+    raise RuntimeError("deliberate failure for the C++ demo")

@@ -1,0 +1,99 @@
+"""C++ worker API: compile the native demo driver and run it against a
+live cluster through the client server (reference: cpp/src/ray — the
+C++ `ray::Init/Put/Get/Task(...).Remote()` surface; here speaking the
+client-server protocol with msgpack cross-language values)."""
+
+import os
+import shutil
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CPP_DIR = os.path.join(REPO, "ray_tpu", "native", "cpp")
+
+
+@pytest.fixture
+def client_server_addr(ray_start_regular, tmp_path):
+    from ray_tpu import api as _api
+
+    gcs = _api._global_node.gcs_address
+    ready = tmp_path / "cs_ready"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu.util.client.server",
+         "--address", gcs, "--port", "0", "--ready-file", str(ready)],
+        cwd=REPO)
+    deadline = time.monotonic() + 60
+    while not ready.exists():
+        assert proc.poll() is None, "client server died"
+        assert time.monotonic() < deadline, "client server not ready"
+        time.sleep(0.05)
+    port = ready.read_text().strip()
+    try:
+        yield f"127.0.0.1:{port}"
+    finally:
+        proc.kill()
+        proc.wait()
+
+
+@pytest.fixture(scope="module")
+def demo_binary(tmp_path_factory):
+    gxx = shutil.which("g++") or shutil.which("c++")
+    if gxx is None:
+        pytest.skip("no C++ compiler")
+    out = tmp_path_factory.mktemp("cpp") / "demo"
+    subprocess.run(
+        [gxx, "-std=c++17", "-O1", "-o", str(out),
+         os.path.join(CPP_DIR, "demo.cc")],
+        check=True, capture_output=True, text=True)
+    return str(out)
+
+
+def test_cpp_msgpack_codec_roundtrip(demo_binary, tmp_path):
+    """The C++ msgpack codec interoperates with the Python msgpack the
+    server uses: verified by a pack-in-C++/unpack-in-Python loop via a
+    tiny self-test binary compiled from the header."""
+    import msgpack
+
+    src = tmp_path / "packtest.cc"
+    src.write_text("""
+#include <cstdio>
+#include "msgpack_lite.hpp"
+using namespace msgpack_lite;
+int main() {
+  Map m;
+  m.emplace("i", Value(int64_t{-77}));
+  m.emplace("f", Value(3.5));
+  m.emplace("s", Value("hello"));
+  m.emplace("b", Value::Bin(std::string("\\x00\\x01", 2)));
+  Array a; a.emplace_back(true); a.emplace_back(Value());
+  m.emplace("a", Value(a));
+  std::string out = pack(Value(m));
+  fwrite(out.data(), 1, out.size(), stdout);
+  return 0;
+}
+""")
+    gxx = shutil.which("g++")
+    exe = tmp_path / "packtest"
+    subprocess.run([gxx, "-std=c++17", "-I", CPP_DIR, "-o", str(exe),
+                    str(src)], check=True, capture_output=True)
+    blob = subprocess.run([str(exe)], capture_output=True,
+                          check=True).stdout
+    decoded = msgpack.unpackb(blob, raw=False)
+    assert decoded == {"i": -77, "f": 3.5, "s": "hello",
+                       "b": b"\x00\x01", "a": [True, None]}
+
+    # and the reverse: Python-packed bytes decode in C++ (demo covers the
+    # full protocol; here just assert python pack of nested data is
+    # parseable by round-tripping through the C++ unpack+pack self-test
+    # in the demo run below)
+
+
+def test_cpp_api_end_to_end(demo_binary, client_server_addr):
+    proc = subprocess.run([demo_binary, client_server_addr],
+                          capture_output=True, text=True, timeout=120,
+                          cwd=REPO)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert "CPP_DEMO_OK" in proc.stdout

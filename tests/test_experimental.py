@@ -1,0 +1,174 @@
+"""Experimental + util surface tests: dynamic resources, shuffle,
+async_api, user metrics, check_serialize (reference idiom:
+python/ray/tests/test_dynres.py, test_metrics.py, test_async.py)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+def _wait_for(pred, timeout=10.0):
+    import time
+
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.1)
+    return False
+
+
+def test_set_resource_adds_capacity(ray_start_regular):
+    from ray_tpu.experimental import set_resource
+
+    set_resource("lemur", 2)
+    # the update reaches api.nodes() via the GCS "nodes" pubsub push
+    assert _wait_for(
+        lambda: ray_tpu.cluster_resources().get("lemur") == 2)
+
+    # queued task waiting on the custom resource unblocks on resize
+    @ray_tpu.remote(resources={"lemur": 1})
+    def hold():
+        return "ok"
+
+    assert ray_tpu.get(hold.remote(), timeout=30) == "ok"
+
+    # shrink to zero removes it
+    set_resource("lemur", 0)
+    assert _wait_for(
+        lambda: "lemur" not in ray_tpu.cluster_resources())
+
+
+def test_set_resource_rejects_builtins(ray_start_regular):
+    from ray_tpu.experimental import set_resource
+
+    with pytest.raises(ValueError):
+        set_resource("CPU", 64)
+    with pytest.raises(ValueError):
+        set_resource("x", -1)
+
+
+def test_simple_shuffle(ray_start_regular):
+    from ray_tpu.experimental import simple_shuffle
+
+    blocks = [list(range(i * 10, (i + 1) * 10)) for i in range(4)]
+    out = simple_shuffle(blocks, num_reducers=3, key_fn=lambda r: r)
+    assert sorted(sum(out, [])) == list(range(40))
+    # partitioning respects key hash
+    for r, block in enumerate(out):
+        assert all(v % 3 == r for v in block)
+
+
+def test_simple_shuffle_reduce_fn(ray_start_regular):
+    from ray_tpu.experimental import simple_shuffle
+
+    blocks = [[1, 2], [3, 4]]
+    out = simple_shuffle(blocks, num_reducers=1,
+                         reduce_fn=lambda parts: sum(sum(parts, [])))
+    assert out == [10]
+
+
+def test_async_api(ray_start_regular):
+    import asyncio
+
+    from ray_tpu.experimental import as_concurrent_future, as_future
+
+    @ray_tpu.remote
+    def f():
+        return 41
+
+    fut = as_concurrent_future(f.remote())
+    assert fut.result(timeout=30) == 41
+
+    async def main():
+        ref = f.remote()
+        v = await as_future(ref)
+        w = await f.remote()  # ObjectRef is natively awaitable
+        return v + w
+
+    assert asyncio.run(main()) == 82
+
+
+def test_user_metrics_tags_and_types():
+    from ray_tpu._private import stats
+    from ray_tpu.util.metrics import Counter, Gauge, Histogram
+
+    c = Counter("app_requests", tag_keys=("route",))
+    c.inc(1, tags={"route": "/a"})
+    c.inc(2, tags={"route": "/b"})
+    c.inc(1, tags={"route": "/a"})
+    snap = stats.snapshot()
+    assert snap["app_requests{route=/a}"]["value"] == 2
+    assert snap["app_requests{route=/b}"]["value"] == 2
+
+    g = Gauge("app_depth")
+    g.set(7)
+    assert stats.snapshot()["app_depth"]["value"] == 7
+
+    h = Histogram("app_lat", boundaries=[1.0, 10.0])
+    h.observe(0.5)
+    h.observe(5.0)
+    h.observe(50.0)
+    hs = stats.snapshot()["app_lat"]
+    assert hs["counts"] == [1, 1, 1] and hs["count"] == 3
+
+    with pytest.raises(ValueError):
+        c.inc(1, tags={"nope": "x"})
+    with pytest.raises(ValueError):
+        c.inc(0)
+    with pytest.raises(ValueError):
+        Histogram("no_bounds")
+
+
+def test_actor_metrics_reach_cluster_metrics(ray_start_regular):
+    """User metrics defined inside an actor surface in
+    cluster_metrics() via the raylet's worker-stats pull."""
+
+    @ray_tpu.remote
+    class Svc:
+        def __init__(self):
+            from ray_tpu.util.metrics import Counter
+
+            self.c = Counter("svc_calls")
+
+        def call(self):
+            self.c.inc()
+            return True
+
+    svc = Svc.remote()
+    for _ in range(3):
+        ray_tpu.get(svc.call.remote(), timeout=30)
+    metrics = ray_tpu.cluster_metrics()
+    merged = {}
+    for node_snap in metrics.get("raylets", {}).values():
+        merged.update(node_snap)
+    assert merged.get("svc_calls", {}).get("value") == 3
+
+
+def test_inspect_serializability():
+    from ray_tpu.util.check_serialize import inspect_serializability
+
+    ok, failures = inspect_serializability({"a": 1, "b": [2, 3]})
+    assert ok and not failures
+
+    import threading
+
+    lock = threading.Lock()
+
+    def closure():
+        return lock
+
+    ok, failures = inspect_serializability(closure)
+    assert not ok
+    # blames the lock inside the closure, not the function wholesale
+    assert any(f.name == "lock" for f in failures)
+
+    class Holder:
+        def __init__(self):
+            self.fine = 1
+            self.bad = threading.Lock()
+
+    ok, failures = inspect_serializability(Holder())
+    assert not ok
+    assert any(f.name == "bad" for f in failures)

@@ -158,3 +158,29 @@ def test_norm_gradients_analytic(key):
     h2 = jax.grad(lambda *a: (ref_rms(*a) ** 2).sum(), (0, 1))(x, w)
     for a, bb in zip(h1, h2):
         np.testing.assert_allclose(np.asarray(a), np.asarray(bb), atol=1e-4)
+
+
+def test_resnet_s2d_stem_is_equivalent(key):
+    """stem_mode="s2d" (MLPerf space-to-depth trick, models/resnet.py
+    _stem_s2d) must compute EXACTLY the standard 7x7/s2 stem — same
+    params, same logits — so checkpoints/configs are interchangeable."""
+    import dataclasses
+
+    import numpy as np
+
+    cfg_std = dataclasses.replace(resnet.resnet50(num_classes=10),
+                                  dtype=jnp.float32)
+    cfg_s2d = dataclasses.replace(cfg_std, stem_mode="s2d")
+    params, state = resnet.init(key, cfg_std)
+    x = jax.random.normal(key, (2, 224, 224, 3), jnp.float32)
+
+    # stem conv alone: tight tolerance
+    ref = resnet._conv(x, params["stem_conv"], 2)
+    s2d = resnet._stem_s2d(x, params["stem_conv"], jnp.float32)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(s2d),
+                               atol=1e-4)
+
+    # whole model end-to-end
+    la, _ = resnet.apply(params, state, x, cfg_std, train=False)
+    lb, _ = resnet.apply(params, state, x, cfg_s2d, train=False)
+    np.testing.assert_allclose(np.asarray(la), np.asarray(lb), atol=3e-3)

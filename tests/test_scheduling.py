@@ -44,3 +44,36 @@ def test_saturated_node_spills_to_idle_node(ray_start_cluster):
     assert any(n != head_id for n in nodes), (
         "saturated head hoarded feasible tasks; expected spillback to the "
         "idle second node")
+
+
+def test_pending_actor_schedules_when_resources_free(ray_start_regular):
+    """Actors queued while the cluster is saturated must start once
+    earlier actors release their resources (regression: the GCS pending
+    queue was only retried on node REGISTRATION, so these waited
+    forever; reference: gcs_actor_manager pending actor rescheduling)."""
+    import ray_tpu
+
+    @ray_tpu.remote(num_cpus=1)
+    class Holder:
+        def ready(self):
+            return True
+
+        def quit(self):
+            ray_tpu.exit_actor()
+
+    # ray_start_regular has 4 CPUs: saturate them...
+    holders = [Holder.remote() for _ in range(4)]
+    ray_tpu.get([h.ready.remote() for h in holders], timeout=60)
+
+    # ...queue a 5th actor (no feasible node right now)...
+    late = Holder.remote()
+    late_ready = late.ready.remote()
+    ready, _ = ray_tpu.wait([late_ready], num_returns=1, timeout=1.0)
+    assert not ready, "5th actor should be pending while saturated"
+
+    # ...release one slot; the pending actor must now schedule.
+    for h in holders[:1]:
+        h.quit.remote()
+    assert ray_tpu.get(late_ready, timeout=60) is True
+    for h in holders[1:]:
+        h.quit.remote()

@@ -1,0 +1,154 @@
+"""TPE searcher / BOHB pairing / syncer tests (reference idiom:
+python/ray/tune/tests/test_searchers.py, test_sync.py)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from ray_tpu.tune import sample as S
+from ray_tpu.tune.search import SampleBudget, TPESearcher, TuneBOHB
+
+
+def _feed(searcher, trial_id, config, value):
+    searcher.on_trial_complete(trial_id, {"score": value})
+
+
+def test_tpe_respects_domains():
+    space = {
+        "lr": S.loguniform(1e-5, 1e-1),
+        "width": S.randint(8, 65),
+        "act": S.choice(["relu", "tanh"]),
+        "drop": S.uniform(0.0, 0.5),
+    }
+    s = TPESearcher(space, metric="score", mode="max", n_initial=5, seed=0)
+    for i in range(30):
+        cfg = s.suggest(f"t{i}")
+        assert 1e-5 <= cfg["lr"] <= 1e-1
+        assert 8 <= cfg["width"] < 65 and isinstance(cfg["width"], int)
+        assert cfg["act"] in ("relu", "tanh")
+        assert 0.0 <= cfg["drop"] <= 0.5
+        _feed(s, f"t{i}", cfg, np.random.RandomState(i).rand())
+
+
+def test_tpe_converges_toward_optimum():
+    """1-D quadratic: after warmup, TPE suggestions cluster near the
+    optimum much tighter than random search."""
+    space = {"x": S.uniform(0.0, 10.0)}
+    s = TPESearcher(space, metric="score", mode="max", n_initial=8,
+                    seed=42)
+    for i in range(40):
+        cfg = s.suggest(f"t{i}")
+        score = -(cfg["x"] - 7.3) ** 2
+        s.on_trial_complete(f"t{i}", {"score": score})
+    tail = [s.suggest(f"late{i}")["x"] for i in range(20)]
+    # random would average |x-7.3| ~= 3; model-based must be far closer
+    err = np.mean([abs(x - 7.3) for x in tail])
+    assert err < 1.5, f"TPE did not converge: mean err {err}"
+
+
+def test_tpe_min_mode():
+    space = {"x": S.uniform(-5.0, 5.0)}
+    s = TPESearcher(space, metric="loss", mode="min", n_initial=6, seed=1)
+    for i in range(30):
+        cfg = s.suggest(f"t{i}")
+        s.on_trial_complete(f"t{i}", {"loss": (cfg["x"] - 2.0) ** 2})
+    tail = [s.suggest(f"late{i}")["x"] for i in range(15)]
+    assert abs(np.mean(tail) - 2.0) < 1.5
+
+
+def test_sample_budget_caps_searcher():
+    space = {"x": S.uniform(0, 1)}
+    s = SampleBudget(TPESearcher(space, metric="score", mode="max"),
+                     num_samples=3)
+    got = [s.suggest(f"t{i}") for i in range(5)]
+    assert sum(c is not None for c in got) == 3
+    assert s.is_finished()
+
+
+def test_bohb_pairing_runs(ray_start_shared):
+    """HyperBandForBOHB + TuneBOHB through tune.run end-to-end."""
+    from ray_tpu import tune
+    from ray_tpu.tune.schedulers import HyperBandForBOHB
+
+    def trainable(config):
+        for i in range(12):
+            yield {"score": -(config["x"] - 3.0) ** 2 + i * 0.01}
+
+    analysis = tune.run(
+        trainable,
+        config={"x": tune.uniform(0.0, 10.0)},
+        search_alg=TuneBOHB(metric="score", mode="max", n_initial=4,
+                            seed=0),
+        scheduler=HyperBandForBOHB(metric="score", mode="max", max_t=9,
+                                   reduction_factor=3),
+        num_samples=10, metric="score", mode="max")
+    assert len(analysis.trials) == 10
+    assert analysis.best_config is not None
+    # every trial received a TPE-suggested x inside the domain
+    assert all(0.0 <= t.config["x"] <= 10.0 for t in analysis.trials)
+
+
+def test_syncer_mirror_and_restore(tmp_path):
+    from ray_tpu.tune.syncer import SyncConfig, Syncer
+
+    logdir = tmp_path / "exp" / "trial_0"
+    logdir.mkdir(parents=True)
+    (logdir / "result.json").write_text('{"it": 1}\n')
+    upload = tmp_path / "bucket"
+    sy = Syncer(SyncConfig(upload_dir=str(upload), sync_period=0))
+    assert sy.sync_up(str(logdir))
+    assert (upload / "trial_0" / "result.json").exists()
+
+    # updates propagate
+    (logdir / "result.json").write_text('{"it": 2}\n')
+    assert sy.sync_up(str(logdir), force=True)
+    assert "2" in (upload / "trial_0" / "result.json").read_text()
+
+    # rate limit holds without force
+    sy2 = Syncer(SyncConfig(upload_dir=str(upload), sync_period=9999))
+    assert sy2.sync_up(str(logdir))
+    assert not sy2.sync_up(str(logdir))
+
+    # sync_down restores a lost logdir
+    import shutil
+
+    shutil.rmtree(logdir)
+    assert sy.sync_down(str(logdir))
+    assert (logdir / "result.json").exists()
+
+
+def test_syncer_command_template(tmp_path):
+    from ray_tpu.tune.syncer import SyncConfig, Syncer
+
+    logdir = tmp_path / "trial_1"
+    logdir.mkdir()
+    (logdir / "ckpt").write_text("x")
+    upload = tmp_path / "up"
+    upload.mkdir()
+    sy = Syncer(SyncConfig(
+        upload_dir=str(upload),
+        sync_template="mkdir -p {target} && cp -r {source}/. {target}/",
+        sync_period=0))
+    assert sy.sync_up(str(logdir), force=True)
+    assert (upload / "trial_1" / "ckpt").exists()
+
+
+def test_tune_run_syncs_trial_dirs(tmp_path, ray_start_shared):
+    from ray_tpu import tune
+    from ray_tpu.tune.syncer import SyncConfig
+
+    def trainable(config):
+        for i in range(3):
+            yield {"score": i}
+
+    local = str(tmp_path / "results")
+    upload = str(tmp_path / "bucket")
+    analysis = tune.run(trainable, config={}, num_samples=2,
+                        metric="score", mode="max", local_dir=local,
+                        sync_config=SyncConfig(upload_dir=upload,
+                                               sync_period=0))
+    assert len(analysis.trials) == 2
+    for t in analysis.trials:
+        assert os.path.isdir(os.path.join(upload, t.trial_id)), \
+            f"trial {t.trial_id} not synced"
