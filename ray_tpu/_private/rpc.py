@@ -36,6 +36,40 @@ _HDR = struct.Struct(">I")
 _MAX_FRAME = 1 << 31
 
 
+def _chaos_config():
+    """Fault-injection knobs (the race/sanitizer tier — role parity with
+    the reference's ASAN/TSAN test strategy, SURVEY §5: instead of
+    compiler sanitizers, perturb the control plane's timing so ordering
+    assumptions break loudly under test).
+
+    RAY_TPU_CHAOS="delay_p=0.2,delay_ms=25[,kill_conn_p=0.001]"
+      delay_p      probability a frame send is delayed
+      delay_ms     max extra latency (uniform 0..delay_ms)
+      kill_conn_p  probability a send instead hard-drops the connection
+                   (exercises redial/retry paths)
+    Parsed once per process; inherited by spawned runtime processes."""
+    import os
+
+    raw = os.environ.get("RAY_TPU_CHAOS")
+    if not raw:
+        return None
+    cfg = {"delay_p": 0.0, "delay_ms": 10.0, "kill_conn_p": 0.0}
+    try:
+        for part in raw.split(","):
+            k, _, v = part.partition("=")
+            if k.strip() in cfg:
+                cfg[k.strip()] = float(v)
+    except ValueError as e:
+        raise ValueError(
+            f"malformed RAY_TPU_CHAOS={raw!r} (expected "
+            f"'delay_p=0.2,delay_ms=25[,kill_conn_p=0.001]'): {e}"
+        ) from None
+    return cfg
+
+
+_CHAOS = _chaos_config()
+
+
 class RpcError(Exception):
     pass
 
@@ -157,25 +191,55 @@ class Connection:
     async def _send(self, msg):
         if self._closed:
             raise ConnectionLost(f"connection {self.name} closed")
+        if _CHAOS is not None:
+            import random as _random
+
+            if (_CHAOS["kill_conn_p"]
+                    and _random.random() < _CHAOS["kill_conn_p"]):
+                await self._shutdown()
+                raise ConnectionLost(
+                    f"connection {self.name} killed by chaos injection")
+            if _random.random() < _CHAOS["delay_p"]:
+                await asyncio.sleep(
+                    _random.random() * _CHAOS["delay_ms"] / 1000.0)
         data = _pack(msg)
         async with self._send_lock:
-            self._writer.write(data)
-            # drain() per frame costs a syscall-sized stall on every small
-            # control message (it was the top cost in the actor-call
-            # microbenchmark). Small frames skip it, but only up to an
-            # un-drained budget — an unbounded skip would let a one-way
-            # flood (e.g. worker log lines) grow the transport buffer
-            # without backpressure.
-            self._undrained += len(data)
-            if len(data) > 65536 or self._undrained > (1 << 20):
-                await self._writer.drain()
-                self._undrained = 0
+            try:
+                self._writer.write(data)
+                # drain() per frame costs a syscall-sized stall on every
+                # small control message (it was the top cost in the
+                # actor-call microbenchmark). Small frames skip it, but
+                # only up to an un-drained budget — an unbounded skip
+                # would let a one-way flood (e.g. worker log lines) grow
+                # the transport buffer without backpressure.
+                self._undrained += len(data)
+                if len(data) > 65536 or self._undrained > (1 << 20):
+                    await self._writer.drain()
+                    self._undrained = 0
+            except (ConnectionError, OSError, RuntimeError) as e:
+                # RuntimeError: asyncio raises it for writes on a
+                # transport closed under us (chaos kill, peer reset)
+                # normalize transport failures mid-send: retry layers
+                # (ReconnectingConnection) only understand ConnectionLost
+                raise ConnectionLost(
+                    f"connection {self.name} lost mid-send: {e}") from e
 
     async def call(self, method: str, data: Any = None, timeout: float | None = None):
         msgid = next(self._msgid)
         fut = asyncio.get_running_loop().create_future()
         self._pending[msgid] = fut
-        await self._send([REQUEST, msgid, method, data])
+        try:
+            await self._send([REQUEST, msgid, method, data])
+        except BaseException:
+            # abandon our own future cleanly — _shutdown may already have
+            # set ConnectionLost on it, which would otherwise be logged
+            # as "exception was never retrieved"
+            fut = self._pending.pop(msgid, fut)
+            if not fut.done():
+                fut.cancel()
+            else:
+                fut.exception()  # mark retrieved
+            raise
         if timeout:
             return await asyncio.wait_for(fut, timeout)
         return await fut
