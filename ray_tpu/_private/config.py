@@ -33,6 +33,9 @@ class Config:
     object_store_backend: str = "files"
     # Chunk size for node-to-node object transfer.
     object_transfer_chunk_size: int = 5 * 1024**2
+    # Admission control: concurrent inbound object transfers per raylet
+    # (reference: pull_manager.h bounded active pulls).
+    max_concurrent_object_pulls: int = 4
     # Spill directory ("" = session dir /spill).
     object_spilling_path: str = ""
     # Spill when store usage exceeds this fraction.

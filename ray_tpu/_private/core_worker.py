@@ -753,12 +753,14 @@ class CoreWorker:
         self._lease_requests[key] = 1
         try:
             target = self.raylet
+            target_addr = None  # None = local raylet
             hops = 0
             while True:
                 reply = await target.call("request_worker_lease",
                                           {"spec": spec, "hops": hops})
                 if reply.get("spillback"):
-                    target = await self._peer(reply["spillback"])
+                    target_addr = reply["spillback"]
+                    target = await self._peer(target_addr)
                     hops = int(reply.get("hops", hops + 1))
                     continue
                 break
@@ -766,6 +768,25 @@ class CoreWorker:
             lease = _Lease(reply["lease_id"], reply["worker_id"],
                            reply["worker_address"], conn, target)
             self.leases.setdefault(key, []).append(lease)
+            if target_addr is not None and self.raylet is not None:
+                # Spilled-back lease: the task will run on a remote node
+                # while its plasma args live here. Hint our raylet to
+                # start pushing them so the transfer overlaps with task
+                # dispatch (PushManager parity, reference:
+                # push_manager.h:29 — dedup happens receiver-side).
+                # Purely an optimization: a hint failure must never fail
+                # the (already granted) lease.
+                try:
+                    arg_ids = [a["id"] for a in spec.get("args", [])
+                               if a.get("kind") == "ref"
+                               and a.get("plasma")]
+                    if arg_ids:
+                        self._io.submit(self.raylet.notify(
+                            "push_objects_to",
+                            {"object_ids": arg_ids,
+                             "target": target_addr}))
+                except Exception:
+                    pass
         except Exception as e:
             pending = self._pending_by_key.pop(key, [])
             for p in pending:

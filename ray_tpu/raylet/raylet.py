@@ -110,6 +110,7 @@ class Raylet:
         self.store_used = 0
         self.spill_dir = os.path.join(session_dir, "spill")
         self._pulls_inflight: set[bytes] = set()
+        self._pull_sem_obj = None
 
         # cluster view (from GCS pubsub)
         self.cluster_nodes: dict[bytes, dict] = {}
@@ -146,6 +147,8 @@ class Raylet:
             # raylet-to-raylet object transfer
             "object_info": self.h_object_info,
             "fetch_chunk": self.h_fetch_chunk,
+            "push_hint": self.h_push_hint,
+            "push_objects_to": self.h_push_objects_to,
             "ping": lambda conn, d: "pong",
         }
 
@@ -711,33 +714,62 @@ class Raylet:
             await fut
         return True
 
-    async def _pull_object(self, oid: bytes):
+    @property
+    def _pull_sem(self) -> asyncio.Semaphore:
+        # Admission control (reference: pull_manager.h:26): bound the
+        # number of concurrent inbound transfers so a burst of pulls
+        # can't monopolize bandwidth/memory; queued pulls wait here.
+        if self._pull_sem_obj is None:
+            self._pull_sem_obj = asyncio.Semaphore(
+                self.config.max_concurrent_object_pulls)
+        return self._pull_sem_obj
+
+    async def _pull_object(self, oid: bytes, hint_addr: str | None = None):
         """Pull one object from a remote node (reference: pull_manager.h:26 +
-        object_manager chunked Push). Retries while waiters exist."""
+        object_manager chunked Push). Retries while waiters exist.
+        `hint_addr`: a node known to hold the object (push path) — tried
+        immediately with NO GCS location lookup; on failure falls back to
+        the normal lookup/retry loop so a concurrent demand waiter
+        (deduped into this pull) is never stranded."""
         if oid in self._pulls_inflight:
             return
         self._pulls_inflight.add(oid)
         try:
-            while oid in self.object_waiters and oid not in self.local_objects:
+            if hint_addr is not None and oid not in self.local_objects:
+                try:
+                    async with self._pull_sem:
+                        if oid not in self.local_objects:
+                            await self._pull_from(oid, hint_addr)
+                    return
+                except Exception as e:
+                    logger.warning("hinted pull of %s from %s failed: %s",
+                                   oid[:6].hex(), hint_addr, e)
+            while oid not in self.local_objects and oid in self.object_waiters:
                 try:
                     locations = await self.gcs.call(
                         "get_object_locations", {"object_id": oid})
                 except Exception:
                     locations = []
-                locations = [l for l in locations
-                             if l != self.node_id.binary()]
-                pulled = False
+                addresses = []
                 for node_id in locations:
-                    info = self.cluster_nodes.get(node_id)
-                    if info is None:
+                    if node_id == self.node_id.binary():
                         continue
+                    info = self.cluster_nodes.get(node_id)
+                    if info is not None:
+                        addresses.append(info["address"])
+                pulled = False
+                for address in addresses:
                     try:
-                        await self._pull_from(oid, info["address"])
+                        async with self._pull_sem:
+                            if oid in self.local_objects:
+                                pulled = True
+                                break
+                            await self._pull_from(oid, address)
                         pulled = True
                         break
                     except Exception as e:
                         logger.warning("pull of %s from %s failed: %s",
-                                       oid[:6].hex(), info["address"], e)
+                                       oid[:6].hex(), address, e)
                 if pulled:
                     break
                 await asyncio.sleep(0.2)
@@ -778,6 +810,34 @@ class Raylet:
         self.store_used += size
         self.m_objects_pulled.inc()
         await self._wake_object_waiters(oid)
+
+    async def h_push_hint(self, conn, d):
+        """Proactive transfer start (the PushManager analog, reference:
+        push_manager.h:29): a node holding `object_id` tells us we'll
+        need it (task args racing a spilled-back lease). Dedup comes for
+        free from _pulls_inflight; admission from the pull semaphore."""
+        oid = d["object_id"]
+        if oid in self.local_objects or oid in self._pulls_inflight:
+            return True
+        asyncio.create_task(self._pull_object(oid, hint_addr=d["from"]))
+        return True
+
+    async def h_push_objects_to(self, conn, d):
+        """Owner side: our worker is about to run a task on `target`
+        whose plasma args live here — hint the target so arg transfer
+        overlaps with lease/worker setup."""
+        target = d["target"]
+        me = self.address
+        for oid in d["object_ids"]:
+            if oid not in self.local_objects:
+                continue
+            try:
+                tconn = await self._raylet_conn(target)
+                await tconn.notify("push_hint", {"object_id": oid,
+                                                 "from": me})
+            except Exception as e:
+                logger.debug("push hint to %s failed: %s", target, e)
+        return True
 
     async def h_object_info(self, conn, d):
         rec = self.local_objects.get(d["object_id"])
