@@ -12,6 +12,7 @@ from ray_tpu.actor import ActorClass, ActorHandle, exit_actor
 from ray_tpu.api import (
     available_resources,
     cancel,
+    cluster_events,
     cluster_metrics,
     cluster_resources,
     get,
@@ -35,6 +36,7 @@ __all__ = [
     "__version__",
     "available_resources",
     "cancel",
+    "cluster_events",
     "cluster_metrics",
     "cluster_resources",
     "exceptions",

@@ -1013,6 +1013,11 @@ class CoreWorker:
             await asyncio.sleep(2.0)
             await self._flush_profile_now(force=True)
 
+    def get_cluster_events(self, severity: str | None = None) -> list[dict]:
+        """Structured events ring from the GCS (RAY_EVENT analog)."""
+        return self._io.run(self.gcs.call(
+            "get_events", {"severity": severity}))
+
     def get_profile_events(self) -> list[dict]:
         """All profile batches recorded cluster-wide (driver surface)."""
         return self._io.run(self.gcs.call("get_profile_events", {}))

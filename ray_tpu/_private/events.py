@@ -1,0 +1,84 @@
+"""Structured cluster events (the RAY_EVENT analog; reference:
+src/ray/util/event.h:36 RAY_EVENT macro, EventManager :84,
+LogEventReporter :51 — structured severity/label events written to an
+event log dir and surfaced to operators).
+
+Each runtime process calls `init_events(source_type, source_id,
+log_dir)` once; `report_event()` then appends a JSON line to
+<log_dir>/events/event_<source_type>.log and, when a forwarder is
+registered (runtime processes forward to the GCS), mirrors the event to
+the cluster-wide ring buffer read by `ray-tpu events` and the dashboard
+`/api/events` view."""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+INFO, WARNING, ERROR, FATAL = "INFO", "WARNING", "ERROR", "FATAL"
+
+_lock = threading.Lock()
+_state = {"source_type": "unknown", "source_id": "", "path": None,
+          "forward": None}
+
+
+def init_events(source_type: str, source_id: str,
+                log_dir: str | None = None, forward=None):
+    """forward: callable(event_dict) — fire-and-forget mirror (the
+    runtime passes a GCS notify)."""
+    with _lock:
+        _state["source_type"] = source_type
+        _state["source_id"] = source_id
+        _state["forward"] = forward
+        if log_dir:
+            event_dir = os.path.join(log_dir, "events")
+            os.makedirs(event_dir, exist_ok=True)
+            _state["path"] = os.path.join(
+                event_dir, f"event_{source_type}.log")
+
+
+def report_event(severity: str, label: str, message: str, **fields):
+    """reference: RAY_EVENT(severity, label) << message."""
+    event = {
+        "timestamp": time.time(),
+        "severity": severity,
+        "label": label,
+        "message": message,
+        "source_type": _state["source_type"],
+        "source_id": _state["source_id"],
+        "source_pid": os.getpid(),
+        **({"custom_fields": fields} if fields else {}),
+    }
+    path = _state["path"]
+    if path:
+        try:
+            with _lock, open(path, "a") as f:
+                f.write(json.dumps(event) + "\n")
+        except OSError:
+            pass
+    forward = _state["forward"]
+    if forward is not None:
+        try:
+            forward(event)
+        except Exception:
+            pass
+    return event
+
+
+def read_events(log_dir: str, source_type: str | None = None) -> list[dict]:
+    """Parse events back from an event log dir (test/CLI helper)."""
+    event_dir = os.path.join(log_dir, "events")
+    if not os.path.isdir(event_dir):
+        return []
+    out = []
+    for name in sorted(os.listdir(event_dir)):
+        if source_type and name != f"event_{source_type}.log":
+            continue
+        with open(os.path.join(event_dir, name)) as f:
+            for line in f:
+                if line.strip():
+                    out.append(json.loads(line))
+    out.sort(key=lambda e: e["timestamp"])
+    return out

@@ -169,6 +169,13 @@ def timeline(filename: str | None = None) -> list[dict]:
     return trace
 
 
+def cluster_events(severity: str | None = None) -> list[dict]:
+    """Structured cluster events (node joins/removals, actor deaths,
+    worker crashes — the RAY_EVENT analog; reference: src/ray/util/
+    event.h + the dashboard event view)."""
+    return global_state.require_core_worker().get_cluster_events(severity)
+
+
 def cluster_metrics() -> dict:
     """Metric snapshots from the GCS and every raylet (reference:
     src/ray/stats/metric.h export surface)."""

@@ -134,6 +134,9 @@ class Dashboard:
 
         return to_chrome_trace(await self._gcs("get_profile_events"))
 
+    async def events(self) -> list[dict]:
+        return await self._gcs("get_events")
+
     # -- server ----------------------------------------------------------
 
     async def run(self, ready_cb=None):
@@ -152,6 +155,7 @@ class Dashboard:
         app.router.add_get("/api/metrics", jroute(self.metrics))
         app.router.add_get("/api/objects", jroute(self.objects))
         app.router.add_get("/api/timeline", jroute(self.timeline))
+        app.router.add_get("/api/events", jroute(self.events))
         runner = web.AppRunner(app)
         await runner.setup()
         site = web.TCPSite(runner, self.host, self.port)

@@ -65,9 +65,13 @@ class GcsServer:
                                  name="gcs")
         self._pending_actor_queue: list[bytes] = []
         self._pending_logged: set[bytes] = set()
+        # Structured cluster events ring (reference: src/ray/util/event.h
+        # EventManager; fed by every process via "report_event").
+        import collections as _collections
+
+        self.events: _collections.deque = _collections.deque(maxlen=1000)
         # Profile-event table (reference: the GCS profile table fed by
         # core_worker profiling.h batches), bounded ring.
-        import collections as _collections
 
         self.profile_events: _collections.deque = _collections.deque(
             maxlen=200_000)
@@ -160,6 +164,8 @@ class GcsServer:
             "list_placement_groups": self.h_list_placement_groups,
             "add_profile_events": self.h_add_profile_events,
             "get_profile_events": self.h_get_profile_events,
+            "report_event": self.h_report_event,
+            "get_events": self.h_get_events,
             "get_metrics": self.h_get_metrics,
             "ping": lambda conn, data: "pong",
         }
@@ -238,6 +244,12 @@ class GcsServer:
         logger.info("node %s: %s @ %s",
                     "re-registered" if rejoining else "registered",
                     node_id.hex()[:8], d["address"])
+        if not rejoining:
+            from ray_tpu._private.events import INFO
+
+            self._event(INFO, "NODE_ADDED",
+                        f"node {node_id.hex()[:8]} joined @ {d['address']}",
+                        node_id=node_id.hex())
         await self._try_schedule_pending_actors()
         await self._retry_pending_pgs()
         return True
@@ -305,6 +317,11 @@ class GcsServer:
         self.node_conns.pop(node_id, None)
         if info is None:
             return
+        from ray_tpu._private.events import ERROR
+
+        self._event(ERROR, "NODE_REMOVED",
+                    f"node {node_id.hex()[:8]} removed: {reason}",
+                    node_id=node_id.hex(), reason=reason)
         info["state"] = "DEAD"
         self._persist_del("nodes", node_id)
         await self.publish("nodes", {"event": "removed",
@@ -487,7 +504,18 @@ class GcsServer:
 
     async def _publish_actor(self, rec):
         # Every externally-visible actor transition goes through here, so
-        # it is also the persistence point.
+        # it is also the persistence + event point.
+        if rec["state"] in (DEAD, RESTARTING):
+            from ray_tpu._private.events import ERROR, WARNING
+
+            self._event(
+                ERROR if rec["state"] == DEAD else WARNING,
+                "ACTOR_DEAD" if rec["state"] == DEAD else "ACTOR_RESTART",
+                f"actor {rec['actor_id'].hex()[:8]} "
+                f"({rec['spec']['name']}) -> {rec['state']}: "
+                f"{rec.get('death_cause') or 'restarting'}",
+                actor_id=rec["actor_id"].hex(),
+                class_name=rec["spec"]["name"])
         self._persist_actor(rec)
         await self.publish(f"actor:{rec['actor_id'].hex()}", self._actor_public(rec))
 
@@ -573,6 +601,28 @@ class GcsServer:
                 await self._schedule_actor(actor_id)
 
     # ---- profiling / metrics ----
+    def _event(self, severity: str, label: str, message: str, **fields):
+        """GCS-originated structured event: file + own ring."""
+        from ray_tpu._private import events
+
+        self.events.append(
+            events.report_event(severity, label, message, **fields))
+
+    async def h_report_event(self, conn, d):
+        self.events.append(d)
+        return True
+
+    async def h_get_events(self, conn, d):
+        out = list(self.events)
+        sev = d.get("severity")
+        if sev:
+            out = [e for e in out if e.get("severity") == sev]
+        limit = d.get("limit")
+        limit = 1000 if limit is None else int(limit)
+        if limit <= 0:
+            return []
+        return out[-limit:]
+
     async def h_add_profile_events(self, conn, d):
         self.profile_events.append({
             "component_type": d["component_type"],
@@ -864,6 +914,10 @@ def main():
     from ray_tpu._private.log_utils import setup_process_logging
 
     setup_process_logging("gcs_server", args.log_file)
+    from ray_tpu._private.events import init_events
+
+    init_events("GCS", "gcs",
+                os.path.dirname(args.log_file) if args.log_file else None)
     set_config(Config.load())
     storage = None
     if args.store_dir:

@@ -302,8 +302,21 @@ class Raylet:
         if worker.lease_resources is not None:
             self._release(worker.lease_resources, worker.lease_pg)
             await self._dispatch_pending()
+        intended = bool(conn.context.get("intended_exit"))
+        if not intended and self.gcs is not None:
+            # structured WORKER_DIED event → GCS ring (RAY_EVENT analog)
+            from ray_tpu._private import events
+
+            event = events.report_event(
+                events.ERROR, "WORKER_DIED",
+                f"worker {worker.worker_id.hex()[:8]} "
+                f"(pid {worker.pid}) died unexpectedly",
+                worker_id=worker.worker_id.hex(), pid=worker.pid)
+            try:
+                await self.gcs.notify("report_event", event)
+            except Exception:
+                pass
         if worker.actor_id is not None and self.gcs is not None:
-            intended = bool(conn.context.get("intended_exit"))
             try:
                 await self.gcs.call("report_worker_failure", {
                     "worker_id": worker.worker_id,
@@ -1166,6 +1179,10 @@ def main():
     from ray_tpu._private.log_utils import setup_process_logging
 
     setup_process_logging("raylet", args.log_file)
+    from ray_tpu._private.events import init_events
+
+    init_events("RAYLET", args.node_id or "",
+                os.path.dirname(args.log_file) if args.log_file else None)
     set_config(Config.load())
     resources = dict(json.loads(args.resources))
     resources.setdefault("CPU", args.num_cpus

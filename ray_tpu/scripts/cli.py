@@ -280,6 +280,26 @@ def cmd_timeline(args) -> int:
     return 0
 
 
+def cmd_events(args) -> int:
+    """reference: the structured-event surface (RAY_EVENT/event.h; the
+    reference ships events to its event log dir + dashboard)."""
+    import time as _time
+
+    addr = _gcs_address(args)
+    if not addr:
+        print("no cluster found", file=sys.stderr)
+        return 1
+    events = _rpc_call(addr, "get_events",
+                       {"severity": args.severity, "limit": args.limit})
+    for e in events:
+        ts = _time.strftime("%H:%M:%S", _time.localtime(e["timestamp"]))
+        print(f"{ts} {e['severity']:<7} {e['label']:<14} "
+              f"[{e['source_type']}] {e['message']}")
+    if not events:
+        print("(no events)")
+    return 0
+
+
 def cmd_dashboard(args) -> int:
     """reference: `ray dashboard` / the dashboard head process."""
     addr = _gcs_address(args)
@@ -342,6 +362,13 @@ def main(argv=None) -> int:
     p.add_argument("--address", default=None)
     p.add_argument("--out", default=None)
     p.set_defaults(fn=cmd_timeline)
+
+    p = sub.add_parser("events", help="structured cluster events")
+    p.add_argument("--address", default=None)
+    p.add_argument("--severity", default=None,
+                   choices=["INFO", "WARNING", "ERROR", "FATAL"])
+    p.add_argument("--limit", type=int, default=100)
+    p.set_defaults(fn=cmd_events)
 
     p = sub.add_parser("dashboard", help="serve the cluster dashboard")
     p.add_argument("--address", default=None)

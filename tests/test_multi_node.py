@@ -163,27 +163,36 @@ def test_push_hint_proactive_transfer(ray_start_cluster):
     def consume(arr):
         return float(arr.sum())
 
-    # the lease must spill to the remote node; the owner-side raylet
-    # should hint-push the arg there
-    total = ray_tpu.get(consume.remote(big), timeout=60)
-    assert total == float(np.arange(250_000).sum())
-
-    # the hint (or at worst the demand pull it dedups with) must leave
-    # the object LOCAL on the executing node — ask its raylet directly
-    async def _remote_has():
+    # Phase 1 — isolate the hint path: NO task, NO waiter on the remote
+    # node; a push_objects_to notify alone must make the object local
+    # there (were the hint machinery removed, nothing else would move it
+    # and this times out).
+    async def _hint_and_poll():
         from ray_tpu._private import rpc
 
-        conn = await rpc.connect(remote_node.address, name="probe")
-        info = await conn.call("object_info",
-                               {"object_id": big.id().binary()})
-        await conn.close()
+        head = await rpc.connect(cluster.head_node.address, name="hinter")
+        await head.notify("push_objects_to", {
+            "object_ids": [big.id().binary()],
+            "target": remote_node.address,
+        })
+        await head.close()
+        probe = await rpc.connect(remote_node.address, name="probe")
+        deadline = time.monotonic() + 30
+        info = None
+        while time.monotonic() < deadline:
+            info = await probe.call("object_info",
+                                    {"object_id": big.id().binary()})
+            if info is not None:
+                break
+            await __import__("asyncio").sleep(0.1)
+        await probe.close()
         return info
 
-    info = cw._io.run(_remote_has())
+    info = cw._io.run(_hint_and_poll())
     assert info is not None and info["size"] > 0, \
-        "arg object not local on the spillback target"
+        "push hint alone did not transfer the object"
 
-    # run again on the same node: the object is already local, so no
-    # re-transfer happens
-    total2 = ray_tpu.get(consume.remote(big), timeout=60)
-    assert total2 == total
+    # Phase 2 — integration: a spilled-back task consuming the (now
+    # local) arg computes correctly
+    total = ray_tpu.get(consume.remote(big), timeout=60)
+    assert total == float(np.arange(250_000).sum())

@@ -3,6 +3,8 @@ src/ray/core_worker/profiling.h:28, python/ray/state.py:946 timeline)."""
 
 import time
 
+import pytest
+
 import ray_tpu
 from ray_tpu._private import stats
 
@@ -70,3 +72,54 @@ def test_timeline_file_export(ray_start_regular, tmp_path):
 
     data = json.loads(out.read_text())
     assert isinstance(data, list)
+
+
+def test_structured_events(ray_start_regular):
+    """RAY_EVENT analog: lifecycle transitions produce structured events
+    readable through the API, and worker crashes surface as WORKER_DIED
+    (reference: src/ray/util/event.h + dashboard event view)."""
+    import time
+
+    import ray_tpu
+
+    events = ray_tpu.cluster_events()
+    assert any(e["label"] == "NODE_ADDED" for e in events), events
+
+    # crash a worker: must yield a WORKER_DIED ERROR event
+    @ray_tpu.remote
+    class Bomb:
+        def go(self):
+            import os
+
+            os._exit(1)
+
+    b = Bomb.remote()
+    with pytest.raises(Exception):
+        ray_tpu.get(b.go.remote(), timeout=30)
+    deadline = time.monotonic() + 10
+    seen = []
+    while time.monotonic() < deadline:
+        seen = ray_tpu.cluster_events(severity="ERROR")
+        if any(e["label"] == "WORKER_DIED" for e in seen):
+            break
+        time.sleep(0.2)
+    assert any(e["label"] == "WORKER_DIED" for e in seen), seen
+    # actor death is also evented
+    assert any(e["label"] == "ACTOR_DEAD" for e in
+               ray_tpu.cluster_events()), "no ACTOR_DEAD event"
+
+
+def test_event_log_files(tmp_path):
+    from ray_tpu._private import events as ev
+
+    ev.init_events("TEST", "t1", str(tmp_path))
+    ev.report_event(ev.WARNING, "SOMETHING", "hello", detail=42)
+    out = ev.read_events(str(tmp_path))
+    assert len(out) == 1
+    e = out[0]
+    assert (e["severity"], e["label"], e["message"]) == (
+        "WARNING", "SOMETHING", "hello")
+    assert e["custom_fields"] == {"detail": 42}
+    assert e["source_type"] == "TEST"
+    # reset so other tests' global state is clean
+    ev.init_events("unknown", "", None)
