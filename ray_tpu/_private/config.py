@@ -30,7 +30,7 @@ class Config:
     object_store_memory: int = 2 * 1024**3
     # "files" = file-per-object mmap store; "native" = the C++ shared-arena
     # slab allocator (native/store/store.cc), built on demand with g++.
-    object_store_backend: str = "files"
+    object_store_backend: str = "native"
     # Chunk size for node-to-node object transfer.
     object_transfer_chunk_size: int = 5 * 1024**2
     # Admission control: concurrent inbound object transfers per raylet

@@ -157,17 +157,33 @@ class LocalObjectStore:
 
 
 def make_store(root: str, config=None):
-    """Backend factory: the python file-per-object store, or the C++
-    shared-arena slab store (native/store) when configured. Raylet and
-    workers on one node must agree (both read the same config)."""
-    backend = "files"
+    """Backend factory: the C++ shared-arena slab store (native/store,
+    the default — pinned zero-copy reads make deletion safe) or the
+    python file-per-object store ("files", also the automatic fallback
+    when no C++ toolchain is present). Raylet and workers on one node
+    must agree; the fallback is deterministic per box (same compiler
+    probe), so they do."""
+    backend = "native"
     if config is not None:
-        backend = getattr(config, "object_store_backend", "files")
+        backend = getattr(config, "object_store_backend", "native")
     if backend == "native":
-        from ray_tpu.native.store import NativeObjectStore
+        from ray_tpu.native.store import native_store_available
 
-        capacity = getattr(config, "object_store_memory", 1 << 30)
-        return NativeObjectStore(root, capacity=capacity)
+        if native_store_available():
+            # Any failure past this point must be FATAL, not a fallback:
+            # a per-process fallback would split one node across two
+            # incompatible backends (raylet arena vs worker files) and
+            # every cross-process get would hang.
+            from ray_tpu.native.store import NativeObjectStore
+
+            capacity = getattr(config, "object_store_memory", 1 << 30)
+            return NativeObjectStore(root, capacity=capacity)
+        import logging
+
+        logging.getLogger("ray_tpu").warning(
+            "native object store unavailable (no C++ toolchain / build "
+            "failure — deterministic per box); using the "
+            "file-per-object backend")
     return LocalObjectStore(root)
 
 

@@ -28,9 +28,13 @@ def build_library(name: str, sources: list[str],
     out = os.path.join(build_dir, f"lib{name}-{tag.hexdigest()[:12]}.so")
     if os.path.exists(out):
         return out
+    # per-pid tmp: concurrent cold-starting processes (raylet + workers)
+    # each compile privately, then atomically publish — a shared tmp
+    # path would interleave two g++ runs into one corrupt .so
+    tmp = f"{out}.{os.getpid()}.tmp"
     cmd = [cxx, "-O2", "-g", "-fPIC", "-shared", "-std=c++17",
-           "-o", out + ".tmp", *src_paths, "-lpthread",
+           "-o", tmp, *src_paths, "-lpthread",
            *(extra_flags or [])]
     subprocess.run(cmd, check=True, capture_output=True, text=True)
-    os.rename(out + ".tmp", out)
+    os.rename(tmp, out)
     return out

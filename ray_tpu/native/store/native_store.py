@@ -9,14 +9,15 @@ store process + its dlmalloc arena, src/ray/object_manager/plasma/).
 Measured on this image: 10MB put+get 3.3 -> 4.7 GB/s, 200KB objects
 885 -> 1206/s vs the files backend.
 
-Semantics note (why "files" stays the default): deleted blocks are
-REUSED, so a zero-copy numpy view must not outlive every ObjectRef to its
-object (the files backend keeps unlinked mappings alive until the view
-drops). The raylet disables spill-eviction for this backend (only
-owner-driven frees delete), so the remaining hazard is user code keeping
-arrays after dropping the last ObjectRef — copy in that case. The
-plasma-style fix is per-client pin/release bookkeeping on get — a
-follow-up."""
+Reader safety (why this can be the DEFAULT backend): every `get` takes a
+native pin held by the returned buffer's exporter (_PinnedBlock); a
+delete while pins are outstanding turns the slot into a zombie — gone
+from lookups, block freed by the last release — so zero-copy views can
+never read reused memory (the per-client Get/Release bookkeeping plasma
+does in the reference, plasma/client.h). A crashed process leaks its
+pins (bounded by what it had mapped); the arena is per-session, so the
+leak dies with the session. Spill-eviction stays disabled for this
+backend (capacity is the configured arena size)."""
 
 from __future__ import annotations
 
@@ -55,6 +56,9 @@ def _load():
         lib.rts_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                 ctypes.POINTER(ctypes.c_uint64),
                                 ctypes.POINTER(ctypes.c_uint64)]
+        lib.rts_release.restype = ctypes.c_int
+        lib.rts_release.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                    ctypes.c_uint64]
         lib.rts_contains.restype = ctypes.c_int
         lib.rts_contains.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
         lib.rts_delete.restype = ctypes.c_uint64
@@ -86,6 +90,39 @@ class _ArenaBuffer:
             self.view.release()
         except (BufferError, ValueError):
             pass
+
+
+class _PinnedBlock:
+    """Zero-copy reader view that holds an arena PIN for its lifetime.
+
+    Buffer-protocol exporter (PEP 688): `memoryview(block)` and every
+    slice of it share one export; when the LAST view is released —
+    including numpy arrays deserialized zero-copy out of the payload —
+    __release_buffer__ fires and drops the native pin, letting a
+    deleted-while-read block (zombie) actually free. This is the
+    per-client Release bookkeeping plasma does in the reference
+    (plasma/client.h Get/Release)."""
+
+    __slots__ = ("_store", "_oid", "_offset", "_view")
+
+    def __init__(self, store: "NativeObjectStore", oid: bytes,
+                 offset: int, view: memoryview):
+        self._store = store
+        self._oid = oid
+        self._offset = offset  # names the exact block generation
+        self._view = view
+
+    def __buffer__(self, flags):
+        return self._view
+
+    def __release_buffer__(self, view):
+        try:
+            self._store._release(self._oid, self._offset)
+        finally:
+            try:
+                self._view.release()
+            except (BufferError, ValueError):
+                pass
 
 
 class NativeObjectStore:
@@ -132,8 +169,10 @@ class NativeObjectStore:
             off = self._lib.rts_create(self._h, oid, size)
         if not off:
             raise MemoryError(
-                f"native store: cannot allocate {size} bytes "
-                f"for {object_id.hex()[:12]}")
+                f"native store: cannot allocate {size} bytes for "
+                f"{object_id.hex()[:12]} — the arena is full (the native "
+                f"backend does not spill; raise object_store_memory or "
+                f"set object_store_backend='files' for spill-to-disk)")
         return _ArenaBuffer(self._mv[off:off + size], size)
 
     def seal(self, object_id: ObjectID) -> None:
@@ -146,15 +185,25 @@ class NativeObjectStore:
     def contains(self, object_id: ObjectID) -> bool:
         return bool(self._lib.rts_contains(self._h, object_id.binary()))
 
+    def _release(self, oid: bytes, offset: int):
+        if self._h:
+            self._lib.rts_release(self._h, oid, offset)
+
     def get(self, object_id: ObjectID) -> _ArenaBuffer | None:
+        """Pinned zero-copy read: the returned buffer (and anything
+        deserialized out of it) holds a native pin until every view
+        dies, so owner-driven deletes can never corrupt live readers
+        (they defer via zombie blocks)."""
         off = ctypes.c_uint64()
         size = ctypes.c_uint64()
-        rc = self._lib.rts_get(self._h, object_id.binary(),
+        oid = object_id.binary()
+        rc = self._lib.rts_get(self._h, oid,
                                ctypes.byref(off), ctypes.byref(size))
         if rc != 0:
             return None
-        return _ArenaBuffer(self._mv[off.value:off.value + size.value],
-                            size.value)
+        raw = self._mv[off.value:off.value + size.value]
+        pinned = _PinnedBlock(self, oid, off.value, raw)
+        return _ArenaBuffer(memoryview(pinned), size.value)
 
     def size_of(self, object_id: ObjectID) -> int:
         buf = self.get(object_id)

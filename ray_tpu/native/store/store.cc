@@ -31,7 +31,7 @@
 
 namespace {
 
-constexpr uint64_t kMagic = 0x52545055464f5254ULL;  // "RTPUFORT" (v2 layout)
+constexpr uint64_t kMagic = 0x52545055464f5255ULL;  // "RTPUFORU" (v3: refs)
 constexpr uint32_t kIdBytes = 24;  // ObjectID size (ids.py: TaskID16+tag4+rand4)
 constexpr uint32_t kAlign = 64;  // cacheline; also keeps numpy views aligned
 
@@ -39,6 +39,10 @@ enum SlotState : uint32_t {
   kFree = 0,
   kCreating = 1,
   kSealed = 2,
+  // Deleted while readers still hold pins: invisible to lookups, block
+  // freed when the last pin releases (plasma-style deferred deletion,
+  // reference: plasma clients hold objects in use until Release).
+  kZombie = 3,
 };
 
 struct Slot {
@@ -47,6 +51,7 @@ struct Slot {
   uint64_t size;
   uint32_t state;
   uint32_t probe_live;  // 1 while this slot participates in probe chains
+  uint32_t refs;        // outstanding reader pins (rts_get/rts_release)
 };
 
 struct Block {  // free-list node, stored at block start inside the arena
@@ -109,6 +114,9 @@ Slot* find_slot(Handle* h, const uint8_t* id, bool want_sealed) {
     Slot* s = &h->slots[(idx + probes) % n];
     if (s->state == kFree && !s->probe_live) return nullptr;
     if (s->state != kFree && memcmp(s->id, id, kIdBytes) == 0) {
+      if (s->state == kZombie) continue;  // invisible; a fresh slot with
+                                          // the same id may live further
+                                          // down the chain
       if (want_sealed && s->state != kSealed) return nullptr;
       return s;
     }
@@ -127,7 +135,8 @@ Slot* claim_slot(Handle* h, const uint8_t* id) {
       s->probe_live = 1;
       return s;
     }
-    if (memcmp(s->id, id, kIdBytes) == 0) return nullptr;  // duplicate
+    if (memcmp(s->id, id, kIdBytes) == 0 && s->state != kZombie)
+      return nullptr;  // duplicate (zombies of the id may coexist)
   }
   return nullptr;  // index full
 }
@@ -139,8 +148,12 @@ void maybe_rehash(Handle* h) {
   Header* hdr = h->hdr;
   if (hdr->tombstones <= hdr->num_slots / 2) return;
   uint32_t n = hdr->num_slots;
-  // Collect live slots (bounded by num_objects).
-  Slot* live = new Slot[hdr->num_objects ? hdr->num_objects : 1];
+  // Collect live slots — count first: num_objects excludes zombies,
+  // which must survive a rehash (their pins are still outstanding).
+  uint64_t live_n = 0;
+  for (uint32_t i = 0; i < n; i++)
+    if (h->slots[i].state != kFree) live_n++;
+  Slot* live = new Slot[live_n ? live_n : 1];
   uint64_t m = 0;
   for (uint32_t i = 0; i < n; i++) {
     if (h->slots[i].state != kFree) live[m++] = h->slots[i];
@@ -326,6 +339,7 @@ uint64_t rts_create(void* handle, const uint8_t* id, uint64_t size) {
   }
   s->offset = payload;
   s->size = size;
+  s->refs = 0;
   __atomic_store_n(&s->state, kCreating, __ATOMIC_RELEASE);
   h->hdr->num_objects++;
   return payload;
@@ -340,16 +354,47 @@ int rts_seal(void* handle, const uint8_t* id) {
   return 0;
 }
 
-// Look up a sealed object; fills offset+size. Returns 0 on hit, -1 miss.
+// Look up a sealed object; fills offset+size and takes a reader PIN
+// (caller must balance with rts_release). Returns 0 on hit, -1 miss.
 int rts_get(void* handle, const uint8_t* id, uint64_t* offset,
             uint64_t* size) {
   Handle* h = static_cast<Handle*>(handle);
   Lock lock(h->hdr);
   Slot* s = find_slot(h, id, /*want_sealed=*/true);
   if (!s) return -1;
+  s->refs++;
   *offset = s->offset;
   *size = s->size;
   return 0;
+}
+
+// Drop one reader pin. `offset` (from the matching rts_get) names the
+// exact BLOCK: an id alone is ambiguous once an object is overwritten
+// while pinned (old zombie generation + new sealed generation share the
+// id, and freeing the wrong one would corrupt the other's readers).
+// The last release of a zombie frees its block. Returns 0, or -1 if no
+// pinned slot matches.
+int rts_release(void* handle, const uint8_t* id, uint64_t offset) {
+  Handle* h = static_cast<Handle*>(handle);
+  Lock lock(h->hdr);
+  uint32_t n = h->hdr->num_slots;
+  uint64_t idx = hash_id(id) % n;
+  for (uint32_t probes = 0; probes < n; probes++) {
+    Slot* s = &h->slots[(idx + probes) % n];
+    if (s->state == kFree && !s->probe_live) break;
+    if (s->state != kFree && s->offset == offset && s->refs > 0 &&
+        memcmp(s->id, id, kIdBytes) == 0) {
+      s->refs--;
+      if (s->state == kZombie && s->refs == 0) {
+        free_block(h, s->offset);
+        s->state = kFree;
+        h->hdr->tombstones++;
+        maybe_rehash(h);
+      }
+      return 0;
+    }
+  }
+  return -1;
 }
 
 int rts_contains(void* handle, const uint8_t* id) {
@@ -358,17 +403,23 @@ int rts_contains(void* handle, const uint8_t* id) {
   return find_slot(h, id, true) ? 1 : 0;
 }
 
-// Delete (sealed or aborted) object; frees its block. Returns freed bytes.
+// Delete (sealed or aborted) object. Unpinned: frees the block now.
+// Pinned: becomes a zombie — invisible immediately, block freed by the
+// last rts_release. Returns the object's (logical) size either way.
 uint64_t rts_delete(void* handle, const uint8_t* id) {
   Handle* h = static_cast<Handle*>(handle);
   Lock lock(h->hdr);
   Slot* s = find_slot(h, id, false);
   if (!s || s->state == kFree) return 0;
   uint64_t freed = s->size;
+  h->hdr->num_objects--;
+  if (s->refs > 0) {
+    __atomic_store_n(&s->state, kZombie, __ATOMIC_RELEASE);
+    return freed;
+  }
   free_block(h, s->offset);
   s->state = kFree;  // probe_live stays 1 so longer chains keep working
   h->hdr->tombstones++;
-  h->hdr->num_objects--;
   maybe_rehash(h);
   return freed;
 }

@@ -90,3 +90,61 @@ def test_runtime_end_to_end_with_native_backend():
         assert int(ray_tpu.get(big).sum()) == 3_000_000
     finally:
         ray_tpu.shutdown()
+
+
+def test_pinned_read_survives_delete(store):
+    """Reader pins: deleting (or overwriting) an object under a live
+    zero-copy view must not corrupt the view; the block frees only when
+    the last view dies (plasma Get/Release parity)."""
+    oid = ObjectID.from_random()
+    payload = bytes(range(256)) * 40
+    store.put_bytes(oid, payload)
+    buf = store.get(oid)
+    view = bytes(buf.view[:16])  # touch before delete
+    assert view == payload[:16]
+
+    # delete while pinned: lookups must miss immediately...
+    assert store.delete(oid) > 0
+    assert store.get(oid) is None
+    # ...but the pinned view still reads the ORIGINAL bytes, even after
+    # allocation churn that would reuse a freed block
+    for _ in range(20):
+        churn = ObjectID.from_random()
+        store.put_bytes(churn, b"\xff" * len(payload))
+        store.delete(churn)
+    assert bytes(buf.view[: len(payload)]) == payload
+    buf.close()  # last view dies -> block actually frees
+
+    # the freed block is reusable afterwards
+    before = store.stats()["used"]
+    oid3 = ObjectID.from_random()
+    store.put_bytes(oid3, b"y" * len(payload))
+    assert store.stats()["used"] <= before + len(payload) + 128
+
+
+def test_overwrite_while_pinned_keeps_generations_apart(store):
+    """Overwrite of a pinned object creates a NEW block; releases must
+    target their own generation (regression: an id-keyed release freed
+    the old generation out from under its reader)."""
+    oid = ObjectID.from_random()
+    store.put_bytes(oid, b"a" * 4096)
+    old = store.get(oid)  # pin generation 1
+
+    store.put_bytes(oid, b"b" * 4096)  # overwrite: gen-1 zombies
+    new = store.get(oid)  # pin generation 2
+    assert bytes(new.view[:4]) == b"bbbb"
+
+    # releasing the NEW generation must not free the OLD block
+    new.close()
+    for _ in range(10):
+        churn = ObjectID.from_random()
+        store.put_bytes(churn, b"\xee" * 4096)
+        store.delete(churn)
+    assert bytes(old.view[:4]) == b"aaaa", \
+        "old generation corrupted by new generation's release"
+    old.close()
+
+    # both generations now released; current value still readable
+    cur = store.get(oid)
+    assert bytes(cur.view[:4]) == b"bbbb"
+    cur.close()
