@@ -215,9 +215,53 @@ def _probe_tpu(timeout: float = 420.0) -> bool:
         return False
 
 
+# Successful TPU runs cache their result here; when the tunnel is
+# wedged at bench time (it goes dark for hours — see PERF.md), the
+# cached real-TPU number is reported WITH an explicit stale marker
+# instead of a meaningless CPU-fallback number. Not committed to git:
+# it only bridges runs within one build window on one box.
+CACHE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          ".bench_tpu_cache.json")
+
+
+def _git_head() -> str:
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True, text=True, timeout=10)
+        return out.stdout.strip()
+    except Exception:
+        return ""
+
+
+def _stale_from_cache() -> bool:
+    """Only called when the TUNNEL is down (never to mask a real bench
+    failure). Caches older than 24h are discarded; a commit mismatch is
+    disclosed in the output rather than hidden."""
+    try:
+        with open(CACHE_PATH) as f:
+            cached = json.load(f)
+        age_h = (time.time() - cached["measured_ts"]) / 3600.0
+    except (OSError, ValueError, KeyError):
+        return False
+    if age_h > 24:
+        return False
+    cached["stale"] = True
+    cached["stale_reason"] = (
+        "TPU tunnel unreachable at bench time; cached from a successful "
+        f"run {age_h:.1f}h ago at commit "
+        f"{cached.get('commit') or '?'} (now at {_git_head() or '?'})")
+    print(json.dumps(cached))
+    return True
+
+
 def _supervise():
     attempts = [({}, 900), ({"JAX_PLATFORMS": "cpu"}, 600)]
-    if not _probe_tpu():
+    tpu_dead = not _probe_tpu()
+    if tpu_dead:
+        if _stale_from_cache():
+            return
         attempts = attempts[1:]
     for env_extra, timeout in attempts:
         fw = _run_child("--inner-framework", env_extra, timeout,
@@ -228,7 +272,7 @@ def _supervise():
         on_accel = "JAX_PLATFORMS" not in env_extra and _tpu_visible()
         img_s = fw["_framework_img_s"]
         raw_img_s = (raw or {}).get("_raw_img_s", 0.0)
-        print(json.dumps({
+        result = {
             "metric": "resnet50_train_img_s_per_chip" if on_accel
             else "resnet18_cifar_train_img_s_cpu_fallback",
             "value": round(img_s, 1),
@@ -238,7 +282,21 @@ def _supervise():
             "framework_fraction": round(img_s / raw_img_s, 3)
             if raw_img_s else None,
             "batch": fw.get("batch"),
-        }))
+        }
+        print(json.dumps(result))
+        if on_accel:
+            try:
+                with open(CACHE_PATH, "w") as f:
+                    json.dump({**result, "measured_ts": time.time(),
+                               "commit": _git_head(),
+                               "measured_at": time.strftime(
+                                   "%Y-%m-%d %H:%M:%S")}, f)
+            except OSError:
+                pass
+        return
+    # both attempts failed. Only a dead tunnel justifies the cache —
+    # with a healthy probe this is a REAL bench failure and must say so.
+    if tpu_dead and _stale_from_cache():
         return
     print(json.dumps({"metric": "resnet50_train_img_s_per_chip",
                       "value": 0.0, "unit": "img/s/chip",
