@@ -294,10 +294,9 @@ def _supervise():
             except OSError:
                 pass
         return
-    # both attempts failed. Only a dead tunnel justifies the cache —
-    # with a healthy probe this is a REAL bench failure and must say so.
-    if tpu_dead and _stale_from_cache():
-        return
+    # both attempts failed with a healthy tunnel probe: a REAL bench
+    # failure — never masked by the cache (which only serves the
+    # probe-failed path above).
     print(json.dumps({"metric": "resnet50_train_img_s_per_chip",
                       "value": 0.0, "unit": "img/s/chip",
                       "vs_baseline": 0.0,

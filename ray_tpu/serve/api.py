@@ -64,6 +64,21 @@ class Client:
         ray_tpu.get(self._controller.delete_endpoint.remote(name),
                     timeout=60)
 
+    def set_traffic(self, endpoint: str, traffic: dict):
+        """Split an endpoint's traffic across backends by weight —
+        the canary/rollout primitive (reference: serve/api.py
+        set_traffic). Weights normalize: {"v1": 0.9, "v2": 0.1}."""
+        ray_tpu.get(self._controller.set_traffic.remote(
+            endpoint, dict(traffic)), timeout=60)
+
+    def shadow_traffic(self, endpoint: str, backend: str,
+                       proportion: float):
+        """Mirror `proportion` of the endpoint's requests to `backend`,
+        dropping results (reference: serve/api.py shadow_traffic);
+        proportion=0 stops shadowing."""
+        ray_tpu.get(self._controller.shadow_traffic.remote(
+            endpoint, backend, proportion), timeout=60)
+
     def list_endpoints(self) -> dict:
         return ray_tpu.get(self._controller.list_endpoints.remote(),
                            timeout=60)

@@ -76,7 +76,7 @@ class ServeController:
 
     def delete_backend(self, name: str):
         used_by = [ep for ep, rec in self.endpoints.items()
-                   if rec["backend"] == name]
+                   if name in rec["traffic"] or name in rec["shadow"]]
         if used_by:
             # Reference semantics: a backend can't vanish under a live
             # endpoint — routers would keep dispatching to dead replicas.
@@ -142,13 +142,60 @@ class ServeController:
                         methods: list[str] | None = None):
         self._backend(backend)
         self.endpoints[name] = {
-            "backend": backend,
+            "backend": backend,  # primary (back-compat/introspection)
+            "traffic": {backend: 1.0},
+            "shadow": {},
             "route": route,
             "methods": [m.upper() for m in (methods or ["GET"])],
         }
         self.version += 1
         self._notify_change()
         return True
+
+    def set_traffic(self, endpoint: str, traffic: dict):
+        """Weighted split across backends (reference: serve/api.py
+        set_traffic — the canary/rollout primitive). Weights normalize;
+        every named backend must exist."""
+        ep = self._endpoint(endpoint)
+        if not traffic:
+            raise ValueError("traffic dict must not be empty")
+        total = 0.0
+        for backend, weight in traffic.items():
+            self._backend(backend)
+            w = float(weight)
+            if w < 0:
+                raise ValueError(f"negative weight for {backend!r}")
+            total += w
+        if total <= 0:
+            raise ValueError("traffic weights sum to zero")
+        ep["traffic"] = {b: float(w) / total for b, w in traffic.items()
+                        if float(w) > 0}
+        ep["backend"] = max(ep["traffic"], key=ep["traffic"].get)
+        self.version += 1
+        self._notify_change()
+        return True
+
+    def shadow_traffic(self, endpoint: str, backend: str,
+                       proportion: float):
+        """Mirror a fraction of requests to `backend`, results dropped
+        (reference: serve/api.py shadow_traffic). proportion=0 stops."""
+        ep = self._endpoint(endpoint)
+        proportion = float(proportion)
+        if not 0.0 <= proportion <= 1.0:
+            raise ValueError("proportion must be in [0, 1]")
+        if proportion == 0.0:
+            ep["shadow"].pop(backend, None)
+        else:
+            self._backend(backend)
+            ep["shadow"][backend] = proportion
+        self.version += 1
+        self._notify_change()
+        return True
+
+    def _endpoint(self, name: str) -> dict:
+        if name not in self.endpoints:
+            raise ValueError(f"no endpoint {name!r}")
+        return self.endpoints[name]
 
     def delete_endpoint(self, name: str):
         out = self.endpoints.pop(name, None) is not None
@@ -166,16 +213,22 @@ class ServeController:
         return self.version
 
     def get_routing_state(self, endpoint: str) -> dict:
-        """Everything a router needs to drive one endpoint."""
+        """Everything a router needs to drive one endpoint: the traffic
+        split plus per-backend config/replicas."""
         ep = self.endpoints.get(endpoint)
         if ep is None:
             raise ValueError(f"no endpoint {endpoint!r}")
-        rec = self._backend(ep["backend"])
+        involved = set(ep["traffic"]) | set(ep["shadow"])
         return {
             "version": self.version,
             "backend": ep["backend"],
-            "config": dict(rec["config"]),
-            "replicas": list(rec["replicas"]),
+            "traffic": dict(ep["traffic"]),
+            "shadow": dict(ep["shadow"]),
+            "backends": {
+                b: {"config": dict(self._backend(b)["config"]),
+                    "replicas": list(self._backend(b)["replicas"])}
+                for b in involved
+            },
         }
 
     # -- long poll (reference: serve/long_poll.py:26) --------------------
@@ -235,8 +288,11 @@ class ServeController:
             auto = rec["config"].get("autoscaling")
             if not auto:
                 continue
-            queued = sum(q for ep, q in self._queue_lens.items()
-                         if self.endpoints.get(ep, {}).get("backend") == name)
+            queued = sum(
+                q * (self.endpoints[ep]["traffic"].get(name, 0.0)
+                     + self.endpoints[ep]["shadow"].get(name, 0.0))
+                for ep, q in self._queue_lens.items()
+                if ep in self.endpoints)
             cur = len(rec["replicas"])
             target = auto.get("target_queued", 2.0) or 2.0
             desired = max(auto.get("min_replicas", 1),

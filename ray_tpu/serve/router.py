@@ -113,39 +113,75 @@ class Router:
 
     # -- flusher ---------------------------------------------------------
 
-    def _pick_replica(self):
-        cfg = self._state["config"]
-        cap = cfg["max_concurrent_queries"]
+    @staticmethod
+    def _pick_backend(state: dict) -> str | None:
+        """Weighted-random backend per batch (reference: serve v1
+        set_traffic — router splits by endpoint traffic policy)."""
+        import random
+
+        traffic = state.get("traffic")
+        if not traffic:
+            return state.get("backend")
+        names = list(traffic)
+        if len(names) == 1:
+            return names[0]
+        return random.choices(names, weights=[traffic[n] for n in names])[0]
+
+    def _pick_replica(self, state: dict, backend: str):
+        st = state["backends"].get(backend)
+        if st is None:
+            return None
+        cap = st["config"]["max_concurrent_queries"]
         with self._lock:
             best, best_load = None, None
-            for handle in self._state["replicas"]:
+            for handle in st["replicas"]:
                 load = self._inflight.get(handle._actor_id.binary(), 0)
                 if load < cap and (best_load is None or load < best_load):
                     best, best_load = handle, load
         return best
 
     def _flush_loop(self):
+        import random
+
         while not self._closed:
             self._wake.wait(timeout=0.05)
             self._wake.clear()
             while not self._closed:
-                cfg = self._state["config"]
-                max_bs = cfg["max_batch_size"] or 1
+                # one consistent snapshot per iteration: the poller
+                # thread swaps self._state on traffic cutover, and mixing
+                # two snapshots' backend maps would KeyError the flusher
+                state = self._state
                 with self._lock:
                     if not self._queue:
                         break
+                backend = self._pick_backend(state)
+                if backend is None or backend not in state["backends"]:
+                    time.sleep(0.01)
+                    continue
+                cfg = state["backends"][backend]["config"]
                 # fill a batch (or give stragglers batch_wait_timeout)
                 if cfg["max_batch_size"]:
                     deadline = time.monotonic() + cfg["batch_wait_timeout"]
                     while (not self._closed
-                           and len(self._queue) < max_bs
+                           and len(self._queue) < cfg["max_batch_size"]
                            and time.monotonic() < deadline):
                         time.sleep(0.001)
-                replica = self._pick_replica()
+                replica = self._pick_replica(state, backend)
                 if replica is None:
-                    # every replica saturated — wait for capacity
+                    # chosen backend saturated — try any other traffic
+                    # backend with capacity before waiting
+                    for other in state.get("traffic", {}):
+                        if other != backend:
+                            replica = self._pick_replica(state, other)
+                            if replica is not None:
+                                backend = other
+                                cfg = state["backends"][other]["config"]
+                                break
+                if replica is None:
                     time.sleep(0.002)
                     continue
+                # batch sized by the backend that will actually serve it
+                max_bs = cfg["max_batch_size"] or 1
                 with self._lock:
                     batch = [q for q in self._queue[:max_bs]
                              if not q.abandoned]
@@ -153,8 +189,16 @@ class Router:
                 if not batch:
                     continue
                 self._dispatch(replica, batch)
+                # shadow traffic: mirror the batch, results dropped
+                # (reference: serve/api.py shadow_traffic)
+                for sb, prop in (state.get("shadow") or {}).items():
+                    if random.random() < prop:
+                        sreplica = self._pick_replica(state, sb)
+                        if sreplica is not None:
+                            self._dispatch(sreplica, batch, shadow=True)
 
-    def _dispatch(self, replica, batch: list[_PendingQuery]):
+    def _dispatch(self, replica, batch: list[_PendingQuery],
+                  shadow: bool = False):
         key = replica._actor_id.binary()
         with self._lock:
             self._inflight[key] = self._inflight.get(key, 0) + 1
@@ -163,15 +207,19 @@ class Router:
             out = replica.handle_batch.options(
                 num_returns=len(batch)).remote([q.data for q in batch])
             refs = [out] if len(batch) == 1 else list(out)
-            for q, ref in zip(batch, refs):
-                q.ref = ref
-                q.event.set()
+            if not shadow:
+                for q, ref in zip(batch, refs):
+                    q.ref = ref
+                    q.event.set()
         except Exception as e:
-            for q in batch:
-                q.error = e
-                q.event.set()
+            if not shadow:
+                for q in batch:
+                    q.error = e
+                    q.event.set()
         with self._lock:
             if refs:
+                # shadow batches still occupy a replica slot until done
+                # (backpressure), their results just go nowhere
                 self._outstanding.append((key, refs))
             else:
                 self._inflight[key] -= 1

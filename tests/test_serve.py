@@ -1,6 +1,8 @@
 """Serve tests (reference idiom: python/ray/serve/tests/test_api.py,
 test_batching.py, test_handle.py)."""
 
+import time
+
 import numpy as np
 import pytest
 
@@ -144,3 +146,74 @@ def test_http_proxy_roundtrip(serve_client):
         assert False, "expected 404"
     except urllib.error.HTTPError as e:
         assert e.code == 404
+
+
+def test_traffic_split_and_shadow(serve_client):
+    """set_traffic splits requests by weight across backends; shadow
+    traffic mirrors without affecting results (reference: serve v1
+    set_traffic/shadow_traffic)."""
+    client = serve_client
+
+    def v1(data):
+        return "v1"
+
+    def v2(data):
+        return "v2"
+
+    client.create_backend("split_v1", v1)
+    client.create_backend("split_v2", v2)
+    client.create_endpoint("split_ep", backend="split_v1")
+    handle = client.get_handle("split_ep")
+
+    # all traffic on v1 initially
+    out = [ray_tpu.get(handle.remote(None), timeout=30) for _ in range(5)]
+    assert set(out) == {"v1"}
+
+    # 50/50 split: both backends must appear
+    client.set_traffic("split_ep", {"split_v1": 0.5, "split_v2": 0.5})
+    time.sleep(0.5)  # long-poll push propagation
+    out = [ray_tpu.get(handle.remote(None), timeout=30)
+           for _ in range(40)]
+    assert set(out) == {"v1", "v2"}, set(out)
+
+    # full cutover to v2
+    client.set_traffic("split_ep", {"split_v2": 1.0})
+    time.sleep(0.5)
+    out = [ray_tpu.get(handle.remote(None), timeout=30)
+           for _ in range(10)]
+    assert set(out) == {"v2"}
+
+    # weights must validate
+    with pytest.raises(Exception):
+        client.set_traffic("split_ep", {"no_such_backend": 1.0})
+
+    # shadow: mirrors requests to a probe backend without changing
+    # results; the probe proves the mirror actually arrived
+    def shadow_probe(data):
+        from ray_tpu.experimental.internal_kv import _kv_get, _kv_put
+
+        n = int(_kv_get("shadow_hits") or 0)
+        _kv_put("shadow_hits", str(n + 1).encode())
+        return "shadow"
+
+    client.create_backend("split_probe", shadow_probe)
+    client.shadow_traffic("split_ep", "split_probe", 1.0)
+    time.sleep(0.5)
+    out = [ray_tpu.get(handle.remote(None), timeout=30)
+           for _ in range(5)]
+    assert set(out) == {"v2"}  # results still from the traffic backend
+    from ray_tpu.experimental.internal_kv import _kv_get
+
+    deadline = time.monotonic() + 10
+    hits = 0
+    while time.monotonic() < deadline:
+        hits = int(_kv_get("shadow_hits") or 0)
+        if hits > 0:
+            break
+        time.sleep(0.1)
+    assert hits > 0, "shadow backend never received mirrored requests"
+    client.shadow_traffic("split_ep", "split_probe", 0.0)
+
+    # deleting a backend still referenced by traffic fails
+    with pytest.raises(Exception):
+        client.delete_backend("split_v2")
