@@ -21,6 +21,10 @@ COMMON_CONFIG: dict = {
     "lr": 5e-4,
     "fcnet_hiddens": [64, 64],
     "seed": None,
+    # greedy-policy evaluation episodes every N train() calls
+    # (reference: trainer.py evaluation_interval/evaluation_num_episodes)
+    "evaluation_interval": 0,
+    "evaluation_num_episodes": 5,
 }
 
 
@@ -69,7 +73,61 @@ class Trainer(Trainable):
     def step(self) -> dict:
         metrics = self.train_step()
         metrics.update(self.workers.collect_metrics())
+        interval = self.config.get("evaluation_interval") or 0
+        # iteration is 0-based DURING a step: +1 so interval=N evaluates
+        # on calls N, 2N, ... (not on the untrained first call)
+        if (interval and (self.iteration + 1) % interval == 0
+                and not hasattr(self.workers.local_worker, "policies")):
+            metrics["evaluation"] = self.evaluate()
         return metrics
+
+    def evaluate(self, num_episodes: int | None = None) -> dict:
+        """Greedy-policy episodes on a fresh env (reference:
+        rllib/agents/trainer.py _evaluate / evaluation_workers — here a
+        driver-side env since the greedy forward is cheap).
+        Single-agent only: multi-agent envs act through dict obs the
+        greedy loop doesn't speak."""
+        import numpy as np
+
+        from ray_tpu.rllib.env import make_env
+
+        if hasattr(self.workers.local_worker, "policies"):
+            raise ValueError(
+                "evaluate() supports single-agent trainers only; roll "
+                "multi-agent evaluation with your env's dict API")
+        n = num_episodes or self.config.get("evaluation_num_episodes", 5)
+        env = make_env(self.config["env"],
+                       self.config.get("env_config", {}))
+        policy = self.get_policy()
+        rewards, lengths = [], []
+        try:
+            for ep in range(n):
+                obs, _ = env.reset(seed=10_000 + ep)
+                total, steps = 0.0, 0
+                done = False
+                while not done and steps < 10_000:
+                    acts, _ = policy.compute_actions(
+                        np.asarray(obs, np.float32).ravel()[None],
+                        explore=False)
+                    act = int(acts[0]) if policy.discrete else acts[0]
+                    obs, r, term, trunc, _ = env.step(act)
+                    total += float(r)
+                    steps += 1
+                    done = term or trunc
+                rewards.append(total)
+                lengths.append(steps)
+        finally:
+            try:
+                env.close()
+            except Exception:
+                pass
+        return {
+            "episode_reward_mean": float(np.mean(rewards)),
+            "episode_reward_min": float(np.min(rewards)),
+            "episode_reward_max": float(np.max(rewards)),
+            "episode_len_mean": float(np.mean(lengths)),
+            "episodes": n,
+        }
 
     def save_checkpoint(self, checkpoint_dir: str):
         return {"weights": self.workers.local_worker.get_weights()}

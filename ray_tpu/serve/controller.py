@@ -215,9 +215,7 @@ class ServeController:
     def get_routing_state(self, endpoint: str) -> dict:
         """Everything a router needs to drive one endpoint: the traffic
         split plus per-backend config/replicas."""
-        ep = self.endpoints.get(endpoint)
-        if ep is None:
-            raise ValueError(f"no endpoint {endpoint!r}")
+        ep = self._endpoint(endpoint)
         involved = set(ep["traffic"]) | set(ep["shadow"])
         return {
             "version": self.version,

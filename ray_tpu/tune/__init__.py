@@ -16,7 +16,7 @@ from ray_tpu.tune.placement_groups import PlacementGroupFactory
 from ray_tpu.tune.progress_reporter import CLIReporter
 from ray_tpu.tune.syncer import SyncConfig, Syncer
 from ray_tpu.tune.trainable import Trainable, report
-from ray_tpu.tune.tune import ExperimentAnalysis, run
+from ray_tpu.tune.tune import ExperimentAnalysis, run, with_parameters
 
 __all__ = [
     "CLIReporter",
@@ -38,4 +38,5 @@ __all__ = [
     "run",
     "sample_from",
     "uniform",
+    "with_parameters",
 ]

@@ -65,6 +65,39 @@ class ExperimentAnalysis:
         return self.results_df()
 
 
+def with_parameters(trainable, **params):
+    """Bind large/unpicklable-by-value objects to a trainable via the
+    object store (reference: tune/utils/trainable.py with_parameters):
+    each trial's actor gets them from plasma instead of shipping a copy
+    inside every trial config."""
+    import functools
+
+    import ray_tpu
+
+    refs = {k: ray_tpu.put(v) for k, v in params.items()}
+
+    if inspect.isclass(trainable) and issubclass(trainable, Trainable):
+        class _WithParams(trainable):
+            def setup(self, config):
+                import ray_tpu as _ray
+
+                resolved = {k: _ray.get(r, timeout=120)
+                            for k, r in refs.items()}
+                super().setup({**config, **resolved})
+
+        _WithParams.__name__ = f"{trainable.__name__}WithParams"
+        return _WithParams
+
+    @functools.wraps(trainable)
+    def _fn(config):
+        import ray_tpu as _ray
+
+        resolved = {k: _ray.get(r, timeout=120) for k, r in refs.items()}
+        return trainable({**config, **resolved})
+
+    return _fn
+
+
 def run(run_or_experiment, *, config: dict | None = None,
         num_samples: int = 1, metric: str | None = None, mode: str = "max",
         search_alg=None, scheduler=None, stop: dict | None = None,

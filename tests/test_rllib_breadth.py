@@ -310,3 +310,26 @@ def test_policy_server_client_roundtrip():
     assert np.all(batch[SampleBatch.ACTION_LOGP] <= 0)
     server.stop()
     env.close()
+
+
+def test_trainer_evaluate(ray_start_shared):
+    from ray_tpu.rllib.agents.ppo import PPOTrainer
+
+    trainer = PPOTrainer(config={
+        "env": "CartPole-v1",
+        "train_batch_size": 256,
+        "rollout_fragment_length": 128,
+        "sgd_minibatch_size": 128,
+        "num_sgd_iter": 2,
+        "evaluation_interval": 1,
+        "evaluation_num_episodes": 3,
+        "seed": 0,
+    })
+    result = trainer.train()
+    ev = result["evaluation"]
+    assert ev["episodes"] == 3
+    assert ev["episode_reward_mean"] >= ev["episode_reward_min"]
+    # explicit call works too
+    ev2 = trainer.evaluate(num_episodes=2)
+    assert ev2["episodes"] == 2
+    trainer.cleanup()

@@ -152,3 +152,23 @@ def test_tune_run_syncs_trial_dirs(tmp_path, ray_start_shared):
     for t in analysis.trials:
         assert os.path.isdir(os.path.join(upload, t.trial_id)), \
             f"trial {t.trial_id} not synced"
+
+
+def test_with_parameters(ray_start_shared):
+    """Large objects bind via the object store, not per-trial configs."""
+    import numpy as np
+
+    from ray_tpu import tune
+
+    data = np.arange(50_000)
+
+    def trainable(config):
+        assert config["data"].sum() == sum(range(50_000))
+        yield {"score": config["x"] + 1}
+
+    analysis = tune.run(
+        tune.with_parameters(trainable, data=data),
+        config={"x": tune.grid_search([1, 2])},
+        metric="score", mode="max")
+    assert len(analysis.trials) == 2
+    assert analysis.best_result["score"] == 3
