@@ -1,0 +1,66 @@
+"""Asynchronous parameter server on actors (reference:
+doc/examples/plot_parameter_server.py) — the classic pattern: one
+parameter-server actor, N gradient workers pushing asynchronously.
+
+    python examples/parameter_server.py [num_workers] [iters]
+"""
+
+import os
+import sys
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+import ray_tpu
+
+
+@ray_tpu.remote
+class ParameterServer:
+    def __init__(self, dim: int):
+        self.w = np.zeros(dim)
+
+    def apply_gradient(self, grad):
+        self.w -= 0.1 * grad
+        return len(self.w)
+
+    def get_weights(self):
+        return self.w
+
+
+@ray_tpu.remote
+def worker_grad(w, seed: int):
+    """One synthetic least-squares gradient step."""
+    rng = np.random.RandomState(seed)
+    x = rng.randn(64, len(w))
+    y = x @ np.ones(len(w))
+    pred = x @ w
+    return x.T @ (pred - y) / len(y)
+
+
+def main(num_workers: int = 4, iters: int = 20):
+    ray_tpu.init(num_cpus=max(2, num_workers))
+    try:
+        ps = ParameterServer.remote(16)
+        grads = [worker_grad.remote(ps.get_weights.remote(), i)
+                 for i in range(num_workers)]
+        for it in range(iters):
+            # asynchronous: apply whichever gradient lands first
+            [ready], grads = ray_tpu.wait(grads, num_returns=1, timeout=60)
+            ray_tpu.get(ps.apply_gradient.remote(ray_tpu.get(ready)))
+            grads.append(worker_grad.remote(ps.get_weights.remote(),
+                                            it + num_workers))
+        ray_tpu.get(grads, timeout=60)
+        w = ray_tpu.get(ps.get_weights.remote())
+        err = float(np.abs(w - 1.0).mean())
+        print(f"mean |w - w*| after {iters} async updates: {err:.3f}")
+        assert err < 0.5, "did not converge toward w*=1"
+    finally:
+        ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main(*(int(a) for a in sys.argv[1:3]))

@@ -1,0 +1,51 @@
+"""Canary rollout with serve traffic splitting + shadow traffic
+(reference: serve v1 set_traffic/shadow_traffic).
+
+    python examples/serve_canary.py
+"""
+
+import collections
+import os
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import time
+
+import ray_tpu
+from ray_tpu import serve
+
+
+def main():
+    ray_tpu.init(num_cpus=4)
+    try:
+        client = serve.start()
+        client.create_backend("model_v1", lambda d: {"model": "v1"})
+        client.create_backend("model_v2", lambda d: {"model": "v2"})
+        client.create_endpoint("predict", backend="model_v1")
+        handle = client.get_handle("predict")
+
+        # canary 20% of traffic to v2, shadow 100% to it for load test
+        client.set_traffic("predict", {"model_v1": 0.8, "model_v2": 0.2})
+        time.sleep(0.5)
+        counts = collections.Counter(
+            ray_tpu.get(handle.remote(None), timeout=30)["model"]
+            for _ in range(50))
+        print("canary traffic:", dict(counts))
+        assert counts["v1"] > counts["v2"] > 0
+
+        # full cutover
+        client.set_traffic("predict", {"model_v2": 1.0})
+        time.sleep(0.5)
+        assert ray_tpu.get(handle.remote(None),
+                           timeout=30)["model"] == "v2"
+        print("cutover complete")
+        serve.shutdown()
+    finally:
+        ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

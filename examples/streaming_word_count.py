@@ -1,0 +1,40 @@
+"""Streaming word count with checkpoint barriers (reference:
+streaming/python wordcount e2e).
+
+    python examples/streaming_word_count.py
+"""
+
+import os
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import ray_tpu
+from ray_tpu.streaming import StreamingContext
+
+LINES = ["the quick brown fox", "jumps over the lazy dog",
+         "the dog barks"] * 30
+
+
+def main():
+    ray_tpu.init(num_cpus=4)
+    try:
+        ctx = StreamingContext(batch_size=16, checkpoint_interval=2,
+                               max_restarts=1)
+        (ctx.from_collection(LINES).set_parallelism(2)
+            .flat_map(lambda line: [(w, 1) for w in line.split()])
+            .key_by(lambda kv: kv[0]).set_parallelism(2)
+            .reduce(lambda a, b: (a[0], a[1] + b[1]))
+            .sink())
+        counts = dict(ctx.run(timeout=120))
+        top = sorted(counts.items(), key=lambda kv: -kv[1][1])[:3]
+        print("top words:", [(w, n) for w, (_, n) in top])
+        assert counts["the"][1] == 90
+    finally:
+        ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,52 @@
+"""Model-based hyperparameter search: native TPE + ASHA early stopping
+(reference: tune with BOHB/hyperopt searchers).
+
+    python examples/tune_tpe.py
+"""
+
+import os
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.tune.schedulers import ASHAScheduler
+from ray_tpu.tune.search import TPESearcher
+
+
+def trainable(config):
+    # a noisy quadratic: optimum at lr=0.03, width=64
+    import math
+    import random
+
+    for step in range(8):
+        score = (-(math.log10(config["lr"]) + 1.52) ** 2
+                 - (config["width"] - 64) ** 2 / 4096
+                 + step * 0.01 + random.random() * 0.01)
+        yield {"score": score}
+
+
+def main():
+    ray_tpu.init(num_cpus=4)
+    try:
+        analysis = tune.run(
+            trainable,
+            config={"lr": tune.loguniform(1e-4, 1e-1),
+                    "width": tune.randint(8, 129)},
+            search_alg=TPESearcher(metric="score", mode="max",
+                                   n_initial=6, seed=0),
+            scheduler=ASHAScheduler(metric="score", mode="max",
+                                    max_t=8, grace_period=2),
+            num_samples=16, metric="score", mode="max")
+        best = analysis.best_config
+        print("best config:", best, "score:", analysis.best_result["score"])
+        assert 1e-3 < best["lr"] < 1e-1
+    finally:
+        ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -76,8 +76,9 @@ class Trainer(Trainable):
         interval = self.config.get("evaluation_interval") or 0
         # iteration is 0-based DURING a step: +1 so interval=N evaluates
         # on calls N, 2N, ... (not on the untrained first call)
-        if (interval and (self.iteration + 1) % interval == 0
-                and not hasattr(self.workers.local_worker, "policies")):
+        if interval and (self.iteration + 1) % interval == 0:
+            # multi-agent raises a clear unsupported error from
+            # evaluate() itself — no silent skip
             metrics["evaluation"] = self.evaluate()
         return metrics
 
@@ -95,7 +96,12 @@ class Trainer(Trainable):
             raise ValueError(
                 "evaluate() supports single-agent trainers only; roll "
                 "multi-agent evaluation with your env's dict API")
-        n = num_episodes or self.config.get("evaluation_num_episodes", 5)
+        n = (self.config.get("evaluation_num_episodes", 5)
+             if num_episodes is None else num_episodes)
+        if n <= 0:
+            raise ValueError(
+                "evaluation_num_episodes must be >= 1 (unset "
+                "evaluation_interval to disable evaluation)")
         env = make_env(self.config["env"],
                        self.config.get("env_config", {}))
         policy = self.get_policy()
