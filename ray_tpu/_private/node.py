@@ -110,6 +110,15 @@ def restore_tpu_plugin_env(env: dict) -> dict:
 def _spawn(cmd: list[str], config: Config, name: str) -> ServiceProcess:
     env = strip_tpu_plugin_env(dict(os.environ))
     env.update(config.child_env())
+    # `python -m ray_tpu...` children must import the package regardless
+    # of the caller's cwd (the CLI runs from anywhere; without this,
+    # `ray-tpu start` only worked inside the repo checkout)
+    pkg_root = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    existing = env.get("PYTHONPATH")
+    if pkg_root not in (existing or "").split(os.pathsep):
+        env["PYTHONPATH"] = (pkg_root + os.pathsep + existing
+                             if existing else pkg_root)
     proc = subprocess.Popen(
         cmd, env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
         start_new_session=True)

@@ -280,6 +280,27 @@ def cmd_timeline(args) -> int:
     return 0
 
 
+def cmd_submit(args) -> int:
+    """Run a driver script against the recorded cluster (reference:
+    `ray submit` — there via the cluster launcher; here the cluster is
+    local/recorded, so submit = exec with RAY_TPU_ADDRESS wired)."""
+    addr = _gcs_address(args)
+    if not addr:
+        print("no cluster found", file=sys.stderr)
+        return 1
+    env = dict(os.environ)
+    env["RAY_TPU_ADDRESS"] = addr
+    # the driver runs with ITS script dir as sys.path[0]; make the
+    # framework importable from anywhere the user submits from
+    pkg_root = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    existing = env.get("PYTHONPATH")
+    env["PYTHONPATH"] = (pkg_root + os.pathsep + existing
+                         if existing else pkg_root)
+    cmd = [sys.executable, args.script, *args.script_args]
+    return subprocess.call(cmd, env=env)
+
+
 def cmd_events(args) -> int:
     """reference: the structured-event surface (RAY_EVENT/event.h; the
     reference ships events to its event log dir + dashboard)."""
@@ -362,6 +383,14 @@ def main(argv=None) -> int:
     p.add_argument("--address", default=None)
     p.add_argument("--out", default=None)
     p.set_defaults(fn=cmd_timeline)
+
+    p = sub.add_parser("submit", help="run a driver script on the cluster")
+    p.add_argument("--address", default=None)
+    p.add_argument("script")
+    # REMAINDER: everything after the script (including --flags) belongs
+    # to the driver, not to this parser
+    p.add_argument("script_args", nargs=argparse.REMAINDER)
+    p.set_defaults(fn=cmd_submit)
 
     p = sub.add_parser("events", help="structured cluster events")
     p.add_argument("--address", default=None)

@@ -84,3 +84,38 @@ def test_worker_prints_stream_to_driver(ray_start_regular, capfd):
         time.sleep(0.2)
     assert "MARKER_FROM_WORKER_7c3" in seen
     assert "(pid=" in seen
+
+
+def test_cli_submit(tmp_path):
+    env = dict(os.environ)
+    env["RAY_TPU_TMPDIR"] = str(tmp_path)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+
+    out = _cli(["start", "--head", "--num-cpus", "2"], env)
+    assert out.returncode == 0, out.stderr
+    script = tmp_path / "driver.py"
+    script.write_text("""
+import os
+import sys
+
+import ray_tpu
+
+ray_tpu.init(address=os.environ["RAY_TPU_ADDRESS"])
+
+@ray_tpu.remote
+def triple(x):
+    return 3 * x
+
+assert sys.argv[1] == "--value"
+print("RESULT:", ray_tpu.get(triple.remote(int(sys.argv[2])), timeout=60))
+ray_tpu.shutdown()
+""")
+    try:
+        # dash-prefixed driver args must reach the script, not argparse
+        out = _cli(["submit", str(script), "--value", "14"], env,
+                   timeout=120)
+        assert out.returncode == 0, (out.stdout, out.stderr)
+        assert "RESULT: 42" in out.stdout
+    finally:
+        _cli(["stop"], env)
