@@ -1,0 +1,162 @@
+"""Long-context MoE transformer — the model-level composition of the
+framework's parallelism primitives (capability absent from the
+reference's model zoo, SURVEY §2.4 mandate: TP/SP/EP must be first-class;
+here they meet in one flagship architecture).
+
+Switch-style decoder: every block is [attention over the sp axis] +
+[top-1 MoE MLP over the ep axis], with dense (tp-sharded) projections
+around both. Attention is selectable:
+  "ring"    — ppermute ring over sequence shards (huge S)
+  "ulysses" — all-to-all head/sequence transpose (short rings)
+  "dense"   — single-shard reference path (tests, sp=1)
+
+The model is MESH-AWARE: `apply(params, tokens, cfg, mesh)` — attention
+and expert dispatch are shard_map'd over the mesh inside the jit, dense
+math is left to GSPMD via the logical-axis shardings (sharding.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.ops.layernorm import layernorm
+from ray_tpu.parallel import moe
+from ray_tpu.parallel.ring_attention import (reference_attention,
+                                             ring_attention_sharded)
+from ray_tpu.parallel.ulysses import ulysses_attention_sharded
+
+
+@dataclasses.dataclass(frozen=True)
+class MoETransformerConfig:
+    vocab_size: int = 32000
+    n_layers: int = 4
+    n_heads: int = 8
+    d_model: int = 512
+    d_ff: int = 1024          # per-expert hidden
+    num_experts: int = 8
+    capacity_factor: float = 1.25
+    max_seq: int = 4096
+    dtype: Any = jnp.bfloat16
+    attention: str = "ring"   # "ring" | "ulysses" | "dense"
+    aux_loss_coeff: float = 0.01
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+TINY_MOE = MoETransformerConfig(
+    vocab_size=128, n_layers=2, n_heads=4, d_model=32, d_ff=64,
+    num_experts=4, max_seq=64, dtype=jnp.float32)
+
+
+def _init_dense(key, shape, fan_in):
+    return jax.random.normal(key, shape, jnp.float32) / math.sqrt(fan_in)
+
+
+def init(key, cfg: MoETransformerConfig):
+    """Param pytree; block params stacked on axis 0 (scanned)."""
+    keys = jax.random.split(key, 8)
+    d, f, L, E = cfg.d_model, cfg.d_ff, cfg.n_layers, cfg.num_experts
+    return {
+        "wte": jax.random.normal(keys[0], (cfg.vocab_size, d),
+                                 jnp.float32) * 0.02,
+        "wpe": jax.random.normal(keys[1], (cfg.max_seq, d),
+                                 jnp.float32) * 0.01,
+        "blocks": {
+            "ln1_w": jnp.ones((L, d)), "ln1_b": jnp.zeros((L, d)),
+            "ln2_w": jnp.ones((L, d)), "ln2_b": jnp.zeros((L, d)),
+            "wqkv": _init_dense(keys[2], (L, d, 3 * d), d),
+            "wo": _init_dense(keys[3], (L, d, d), d),
+            "router": _init_dense(keys[4], (L, d, E), d),
+            "w_in": _init_dense(keys[5], (L, E, d, f), d),
+            "w_out": _init_dense(keys[6], (L, E, f, d), f),
+        },
+        "lnf_w": jnp.ones((d,)), "lnf_b": jnp.zeros((d,)),
+    }
+
+
+def logical_axes(cfg: MoETransformerConfig):
+    """Logical axes for sharding.tree_shardings: experts shard over ep,
+    attention/mlp projections over tp ("mlp"/"heads" rules)."""
+    return {
+        "wte": ("vocab", "embed"),
+        "wpe": (None, "embed"),
+        "blocks": {
+            "ln1_w": ("layers", "norm"), "ln1_b": ("layers", "norm"),
+            "ln2_w": ("layers", "norm"), "ln2_b": ("layers", "norm"),
+            "wqkv": ("layers", "embed", "mlp"),
+            "wo": ("layers", "mlp", "embed"),
+            "router": ("layers", "embed", None),
+            "w_in": ("layers", "expert", "embed", None),
+            "w_out": ("layers", "expert", None, "embed"),
+        },
+        "lnf_w": ("norm",), "lnf_b": ("norm",),
+    }
+
+
+def apply(params, tokens, cfg: MoETransformerConfig, mesh):
+    """tokens [B, T] int32 → (logits [B, T, vocab] fp32, aux_loss)."""
+    b, t = tokens.shape
+    h, hd = cfg.n_heads, cfg.head_dim
+    x = params["wte"][tokens].astype(cfg.dtype)
+    x = x + params["wpe"][:t].astype(cfg.dtype)[None]
+
+    def attend(q, k, v):
+        if cfg.attention == "ring":
+            return ring_attention_sharded(q, k, v, mesh, causal=True)
+        if cfg.attention == "ulysses":
+            return ulysses_attention_sharded(q, k, v, mesh, causal=True)
+        if cfg.attention == "dense":
+            return reference_attention(q, k, v, causal=True)
+        raise ValueError(
+            f"unknown attention {cfg.attention!r}: expected "
+            "'ring', 'ulysses', or 'dense'")
+
+    aux_total = 0.0
+    # python loop over blocks (not scan): each layer's shard_map'd MoE /
+    # attention calls close over the mesh; L is small and static
+    for i in range(cfg.n_layers):
+        p = jax.tree.map(lambda a: a[i], params["blocks"])
+        y = layernorm(x, p["ln1_w"].astype(x.dtype),
+                      p["ln1_b"].astype(x.dtype))
+        qkv = y @ p["wqkv"].astype(x.dtype)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        attn = attend(q.reshape(b, t, h, hd), k.reshape(b, t, h, hd),
+                      v.reshape(b, t, h, hd))
+        x = x + attn.reshape(b, t, cfg.d_model) @ p["wo"].astype(x.dtype)
+        # Switch MoE over flattened pre-normed tokens: dispatch rides ep
+        y = layernorm(x, p["ln2_w"].astype(x.dtype),
+                      p["ln2_b"].astype(x.dtype))
+        flat = y.reshape(b * t, cfg.d_model)
+        # tokens shard over BOTH dp (batch) and sp (sequence): the
+        # flattened [B*T, D] rows stay fully partitioned, so no shard
+        # recomputes another's routing/experts
+        out, aux = moe.moe_apply(
+            flat, p["router"], p["w_in"], p["w_out"], mesh=mesh,
+            capacity_factor=cfg.capacity_factor,
+            token_axis=("dp", "sp"))
+        aux_total = aux_total + aux
+        x = x + out.reshape(b, t, cfg.d_model).astype(x.dtype)
+
+    x = layernorm(x, params["lnf_w"].astype(x.dtype),
+                  params["lnf_b"].astype(x.dtype))
+    logits = (x @ params["wte"].T.astype(x.dtype)).astype(jnp.float32)
+    return logits, aux_total / cfg.n_layers
+
+
+def loss_fn(params, tokens, cfg: MoETransformerConfig, mesh):
+    """Next-token NLL + load-balancing aux (Switch transformer loss)."""
+    logits, aux = apply(params, tokens, cfg, mesh)
+    logp = jax.nn.log_softmax(logits[:, :-1])
+    nll = -jnp.take_along_axis(logp, tokens[:, 1:, None], axis=-1).mean()
+    return nll + cfg.aux_loss_coeff * aux, aux
+
+
+def num_params(params) -> int:
+    return sum(p.size for p in jax.tree.leaves(params))

@@ -184,3 +184,53 @@ def test_resnet_s2d_stem_is_equivalent(key):
     la, _ = resnet.apply(params, state, x, cfg_std, train=False)
     lb, _ = resnet.apply(params, state, x, cfg_s2d, train=False)
     np.testing.assert_allclose(np.asarray(la), np.asarray(lb), atol=3e-3)
+
+
+def test_moe_transformer_forward_and_grads(key):
+    """Flagship long-context MoE model: ring-attention + expert dispatch
+    compose on one dp×sp×ep mesh; grads flow and the load-balance aux
+    stays in a sane range."""
+    from ray_tpu.models import moe_transformer as M
+    from ray_tpu.parallel.mesh import MeshSpec
+    from ray_tpu.parallel import sharding
+
+    mesh = MeshSpec(dp=2, sp=2, ep=2).build()
+    cfg = M.TINY_MOE
+    params = M.init(key, cfg)
+    params = jax.device_put(
+        params, sharding.tree_shardings(mesh, M.logical_axes(cfg)))
+    tokens = jax.random.randint(key, (4, 64), 0, cfg.vocab_size)
+
+    apply_jit = jax.jit(lambda p, t: M.apply(p, t, cfg, mesh))
+    logits, aux = apply_jit(params, tokens)
+    assert logits.shape == (4, 64, cfg.vocab_size)
+    assert np.isfinite(np.asarray(logits)).all()
+    # top-1 routing over E experts: a balanced aux is ~1.0
+    assert 0.5 < float(aux) < 4.0, float(aux)
+
+    grad_jit = jax.jit(jax.value_and_grad(
+        lambda p, t: M.loss_fn(p, t, cfg, mesh), has_aux=True))
+    (loss, aux2), grads = grad_jit(params, tokens)
+    assert np.isfinite(float(loss))
+    flat = jax.tree.leaves(grads)
+    assert all(np.isfinite(np.asarray(g)).all() for g in flat)
+    # experts receive gradient (dispatch is differentiable)
+    assert float(jnp.abs(grads["blocks"]["w_in"]).sum()) > 0
+
+
+def test_moe_transformer_ring_vs_ulysses(key):
+    """The two SP attention variants agree inside the full model."""
+    import dataclasses
+
+    from ray_tpu.models import moe_transformer as M
+    from ray_tpu.parallel.mesh import MeshSpec
+
+    mesh = MeshSpec(sp=4, ep=2).build()
+    cfg_r = dataclasses.replace(M.TINY_MOE, attention="ring")
+    cfg_u = dataclasses.replace(M.TINY_MOE, attention="ulysses")
+    params = M.init(key, cfg_r)
+    tokens = jax.random.randint(key, (2, 64), 0, cfg_r.vocab_size)
+    lr, _ = jax.jit(lambda p, t: M.apply(p, t, cfg_r, mesh))(params, tokens)
+    lu, _ = jax.jit(lambda p, t: M.apply(p, t, cfg_u, mesh))(params, tokens)
+    np.testing.assert_allclose(np.asarray(lr), np.asarray(lu),
+                               atol=2e-4, rtol=2e-4)
