@@ -39,6 +39,8 @@ class _Shard:
                 it = (x for item in it for x in item)
             elif kind == "shuffle":
                 it = _shuffle_iter(it, *arg)
+            elif kind == "transform":
+                it = iter(arg(it))
         return it
 
     def next_items(self, n: int = 1) -> list:
@@ -155,6 +157,56 @@ class ParallelIterator:
     def local_shuffle(self, shuffle_buffer_size: int,
                       seed: int | None = None) -> "ParallelIterator":
         return self._derive("shuffle", (shuffle_buffer_size, seed))
+
+    def combine(self, fn) -> "ParallelIterator":
+        """fn(item) -> list of items; map + flatten in one op
+        (reference: iter.py combine)."""
+        return self._derive("for_each", fn)._derive("flatten", None)
+
+    def transform(self, fn) -> "ParallelIterator":
+        """Whole-iterable transform: fn(iterable) -> iterable, applied
+        inside each shard actor (reference: iter.py transform — the
+        generic op the pointwise ones are built from)."""
+        return self._derive("transform", fn)
+
+    def select_shards(self, indices: list[int]) -> "ParallelIterator":
+        """A view over a subset of shards (reference: select_shards)."""
+        for i in indices:
+            if not 0 <= i < len(self._sources):
+                raise IndexError(f"shard {i} out of {len(self._sources)}")
+        return ParallelIterator([self._sources[i] for i in indices],
+                                self._ops, self._prefetch)
+
+    def shards(self) -> list["LocalIterator"]:
+        """One LocalIterator per shard (reference: shards)."""
+        return [self.get_shard(i) for i in range(len(self._sources))]
+
+    def repartition(self, num_partitions: int) -> "ParallelIterator":
+        """Re-shard to `num_partitions` shards. Each new shard re-runs
+        the parent chain inside its own actor and keeps its stride
+        (deterministic re-iterable sources required, same contract as
+        union/streaming) — k-fold recompute instead of the reference's
+        pull-queue shuffle, but nothing flows through the driver
+        (reference: iter.py repartition)."""
+        # capture only the RECIPE (sources/ops), never self: a pickled
+        # live ParallelIterator would carry actor HANDLES, making every
+        # partition consume/reset the same parent shard actors
+        # concurrently and silently drop items
+        sources, ops, prefetch = self._sources, self._ops, self._prefetch
+
+        def build_partition(j):
+            def gen():
+                fresh = ParallelIterator(sources, ops, prefetch)
+                for i, item in enumerate(fresh.gather_sync()):
+                    if i % num_partitions == j:
+                        yield item
+            return gen
+
+        import cloudpickle
+
+        return ParallelIterator(
+            [cloudpickle.dumps(build_partition(j))
+             for j in range(num_partitions)], [], prefetch)
 
     def union(self, other: "ParallelIterator") -> "ParallelIterator":
         if self._ops != other._ops:

@@ -104,3 +104,53 @@ def test_microbenchmark_harness(ray_start_shared):
     results = []
     timeit("noop", lambda: None, seconds=0.15, results=results)
     assert results[0]["per_second"] > 1000
+
+
+def test_parallel_iterator_breadth(ray_start_shared):
+    """combine/transform/select_shards/shards/batch_across.../repartition
+    (reference: util/iter.py full surface)."""
+    from ray_tpu.util.iter import from_range
+
+    it = from_range(12, num_shards=3)
+
+    # combine = map + flatten
+    doubled = it.combine(lambda x: [x, x])
+    assert sorted(doubled.gather_sync()) == sorted(
+        list(range(12)) + list(range(12)))
+
+    # transform: whole-iterable op inside the shard
+    def running_sum(items):
+        total = 0
+        for x in items:
+            total += x
+            yield total
+
+    # shard 0 of from_range(12,3) holds [0,3,6,9] -> prefix sums
+    sums = it.transform(running_sum)
+    assert list(sums.get_shard(0)) == [0, 3, 9, 18]
+
+    # repartition after the parent was already iterated must still see
+    # every element (regression: shared parent actor handles dropped
+    # items once streams exceeded one prefetch batch)
+    from ray_tpu.util.iter import from_range as _fr
+
+    big = _fr(100, num_shards=2)
+    list(big.gather_sync())  # materialize parent actors first
+    rep100 = big.repartition(2)
+    assert sorted(rep100.gather_sync()) == list(range(100))
+
+    # select_shards / shards
+    sub = it.select_shards([0, 2])
+    assert sub.num_shards() == 2
+    assert sorted(sub.gather_sync()) == sorted(
+        list(range(0, 12, 3)) + list(range(2, 12, 3)))
+    per_shard = it.shards()
+    assert sorted(x for s in per_shard for x in s) == list(range(12))
+
+    # repartition: same elements, new shard count
+    rep = it.repartition(2)
+    assert rep.num_shards() == 2
+    assert sorted(rep.gather_sync()) == list(range(12))
+
+    with pytest.raises(IndexError):
+        it.select_shards([5])
