@@ -67,8 +67,10 @@ class BasicVariantGenerator(Searcher):
         super().__init__()
         self._config = config or {}
         self._num_samples = num_samples
+        self._seed = seed
         self._rng = random.Random(seed)
         self._iter = None
+        self._consumed = 0
         self._finished = False
 
     def set_search_properties(self, metric, mode, config):
@@ -85,10 +87,34 @@ class BasicVariantGenerator(Searcher):
         if self._iter is None:
             self._iter = self._variants()
         try:
-            return next(self._iter)
+            out = next(self._iter)
+            self._consumed += 1
+            return out
         except StopIteration:
             self._finished = True
             return None
 
     def is_finished(self):
         return self._finished
+
+    # -- persistence (experiment resume): the live generator can't
+    # pickle; persist the recipe + position, fast-forward on restore ----
+
+    def get_state(self) -> dict:
+        return {"config": self._config, "num_samples": self._num_samples,
+                "seed": self._seed, "consumed": self._consumed,
+                "finished": self._finished,
+                "metric": self.metric, "mode": self.mode}
+
+    def set_state(self, state: dict):
+        self._config = state["config"]
+        self._num_samples = state["num_samples"]
+        self._seed = state["seed"]
+        self.metric = state["metric"]
+        self.mode = state["mode"]
+        self._finished = state["finished"]
+        self._rng = random.Random(self._seed)
+        self._iter = self._variants()
+        self._consumed = 0
+        for _ in range(state["consumed"]):  # deterministic fast-forward
+            self.suggest("__restore__")

@@ -35,8 +35,36 @@ class Searcher:
     def is_finished(self) -> bool:
         return False
 
+    # -- persistence (reference: suggest/suggestion.py Searcher.save/
+    # restore — experiment-level resume snapshots searcher state) -------
 
-class SampleBudget(Searcher):
+    def get_state(self) -> dict:
+        """Default: the full __dict__ (fine for searchers whose state is
+        plain data — TPE, median, etc.). Searchers holding live
+        iterators/handles override."""
+        return dict(self.__dict__)
+
+    def set_state(self, state: dict):
+        self.__dict__.update(state)
+
+
+class _WrapperStateMixin:
+    """get/set_state for searchers wrapping an inner searcher."""
+
+    def get_state(self) -> dict:
+        state = {k: v for k, v in self.__dict__.items()
+                 if k != "searcher"}
+        state["__inner__"] = self.searcher.get_state()
+        return state
+
+    def set_state(self, state: dict):
+        inner = state.pop("__inner__", None)
+        self.__dict__.update(state)
+        if inner is not None:
+            self.searcher.set_state(inner)
+
+
+class SampleBudget(_WrapperStateMixin, Searcher):
     """Caps total suggestions at num_samples — gives model-based
     searchers (which never self-exhaust) the reference's
     tune.run(num_samples=N) stopping semantics (reference:
@@ -73,7 +101,7 @@ class SampleBudget(Searcher):
                 or self.searcher.is_finished())
 
 
-class ConcurrencyLimiter(Searcher):
+class ConcurrencyLimiter(_WrapperStateMixin, Searcher):
     """Caps concurrent unfinished suggestions (reference:
     suggest/suggestion.py ConcurrencyLimiter)."""
 
@@ -105,7 +133,7 @@ class ConcurrencyLimiter(Searcher):
         return self.searcher.is_finished()
 
 
-class Repeater(Searcher):
+class Repeater(_WrapperStateMixin, Searcher):
     """Repeats each suggestion N times and reports the averaged metric to
     the wrapped searcher (reference: suggest/repeater.py)."""
 

@@ -311,15 +311,123 @@ class TrialRunner:
             self._syncer.sync_up(
                 os.path.join(self._local_dir, trial.trial_id), force=True)
 
+    # -- experiment-level checkpoint/resume ------------------------------
+    # (reference: trial_runner.py checkpoint() + tune.run(resume=True))
+
+    def _experiment_state_path(self) -> str | None:
+        if not self._local_dir:
+            return None
+        import os
+
+        return os.path.join(self._local_dir, "experiment_state.pkl")
+
+    def _experiment_fingerprint(self) -> tuple:
+        return tuple((t.trial_id, t.status, t.iteration,
+                      id(t.checkpoint)) for t in self.trials)
+
+    # reference: trial_runner checkpoints at most every
+    # TUNE_GLOBAL_CHECKPOINT_S (10s) — checkpoints can be large
+    _save_period_s = 10.0
+
+    def save_experiment_state(self, force: bool = False):
+        """Snapshot every trial's config/status/last checkpoint AND the
+        searcher's own state so a killed driver can resume the sweep.
+        Skipped when nothing changed, rate-limited to _save_period_s,
+        and NEVER allowed to kill the sweep (persistence is a
+        side-channel; serialization failures log once and disable it)."""
+        path = self._experiment_state_path()
+        if path is None or getattr(self, "_save_disabled", False):
+            return
+        fp = self._experiment_fingerprint()
+        if fp == getattr(self, "_last_saved_fp", None):
+            return
+        now = time.monotonic()
+        if (not force and now - getattr(self, "_last_save_t", 0.0)
+                < self._save_period_s):
+            return
+        import os
+
+        try:
+            state = {
+                "trials": [{
+                    "trial_id": t.trial_id,
+                    "config": t.config,
+                    "status": t.status,
+                    "last_result": t.last_result,
+                    "checkpoint": t.checkpoint,
+                    "error": t.error,
+                } for t in self.trials],
+                "searcher": self._search.get_state(),
+            }
+            os.makedirs(self._local_dir, exist_ok=True)
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as f:
+                cloudpickle.dump(state, f)
+            os.replace(tmp, path)
+            self._last_saved_fp = fp
+            self._last_save_t = now
+        except Exception as e:
+            self._save_disabled = True
+            logger.warning(
+                "experiment-state persistence disabled: %s (resume will "
+                "not be available for this run)", e)
+
+    def restore_experiment_state(self) -> bool:
+        """Load a prior run's state: finished trials keep their results,
+        interrupted ones re-queue from their last checkpoint, and the
+        searcher resumes exactly where it stopped (its own persisted
+        state — no replay; reference: Searcher.save/restore). Returns
+        False when no usable state file exists."""
+        import os
+
+        path = self._experiment_state_path()
+        if path is None or not os.path.exists(path):
+            return False
+        try:
+            with open(path, "rb") as f:
+                state = cloudpickle.load(f)
+        except Exception as e:
+            # an EXISTING state file that won't load must not be
+            # silently clobbered by the next save — surface it
+            raise RuntimeError(
+                f"resume=True but {path} failed to load ({e}); move or "
+                f"delete it to start fresh") from e
+        self._search.set_state(state["searcher"])
+        for rec in state["trials"]:
+            trial = Trial(rec["config"], trial_id=rec["trial_id"])
+            trial.last_result = rec["last_result"]
+            trial.checkpoint = rec["checkpoint"]
+            trial.error = rec["error"]
+            if rec["status"] in (TERMINATED, ERROR):
+                trial.status = rec["status"]
+                # distinguishes prior-run failures from this run's
+                # (tune.run's raise_on_failed_trial ignores restored)
+                trial.restored = True
+            else:
+                trial.status = PENDING  # interrupted: restart from ckpt
+                # the searcher still counts it live; completion arrives
+                # when the resumed trial finishes this run
+            self.trials.append(trial)
+            self._scheduler.on_trial_add(self, trial)
+            if trial.status == TERMINATED and trial.last_result:
+                # rebuild what scheduler state we can (rung records etc.);
+                # mid-rung pauses/brackets are NOT reconstructed — a
+                # resumed ASHA/PBT sweep schedules fresh from here
+                self._scheduler.on_trial_complete(self, trial,
+                                                  trial.last_result)
+        return True
+
     def run(self):
         while not self.is_finished():
             self.step()
+            self.save_experiment_state()
             if self._reporter is not None and self._reporter.should_report():
                 self._reporter.report(self.trials)
         # final sweep: make sure nothing is left running
         for trial in self.trials:
             if trial.status in (RUNNING, PAUSED, PENDING):
                 self._stop_trial(trial, TERMINATED)
+        self.save_experiment_state(force=True)
         for lg in self._loggers.values():
             lg.close()
         self._loggers.clear()

@@ -105,7 +105,7 @@ def run(run_or_experiment, *, config: dict | None = None,
         max_concurrent_trials: int = 0, checkpoint_freq: int = 0,
         max_failures: int = 0, verbose: int = 1,
         local_dir: str | None = None, loggers=None,
-        progress_reporter=None, sync_config=None,
+        progress_reporter=None, sync_config=None, resume: bool = False,
         raise_on_failed_trial: bool = True) -> ExperimentAnalysis:
     """Run a hyperparameter sweep (reference: tune/tune.py:71).
 
@@ -149,8 +149,20 @@ def run(run_or_experiment, *, config: dict | None = None,
         progress_reporter=progress_reporter,
         sync_config=sync_config,
     )
+    if resume:
+        if not local_dir:
+            raise ValueError("resume=True needs local_dir (the experiment "
+                             "state lives there)")
+        restored = runner.restore_experiment_state()
+        if not restored:
+            import logging
+
+            logging.getLogger("ray_tpu.tune").warning(
+                "resume=True but no experiment state under %s; starting "
+                "fresh", local_dir)
     runner.run()
-    errored = [t for t in runner.trials if t.status == "ERROR"]
+    errored = [t for t in runner.trials if t.status == "ERROR"
+               and not getattr(t, "restored", False)]
     if errored and raise_on_failed_trial:
         raise RuntimeError(
             f"{len(errored)} trial(s) errored; first: "

@@ -172,3 +172,59 @@ def test_with_parameters(ray_start_shared):
         metric="score", mode="max")
     assert len(analysis.trials) == 2
     assert analysis.best_result["score"] == 3
+
+
+def test_experiment_resume(tmp_path, ray_start_shared):
+    """A killed sweep resumes: finished trials keep results, interrupted
+    ones restart from their checkpoints, and the total trial budget is
+    honored (reference: tune.run(resume=True) + TrialRunner experiment
+    checkpointing)."""
+    from ray_tpu import tune
+
+    local = str(tmp_path / "exp")
+
+    class Slow(tune.Trainable):
+        def setup(self, config):
+            self.x = config["x"]
+            self.count = 0
+
+        def step(self):
+            self.count += 1
+            return {"score": self.x * self.count,
+                    "done": self.count >= 3}
+
+        def save_checkpoint(self, d):
+            return {"count": self.count}
+
+        def load_checkpoint(self, state):
+            self.count = state["count"]
+
+    # first run completes normally; its state file is the resume input
+    a1 = tune.run(Slow, config={"x": tune.grid_search([1, 2, 3])},
+                  metric="score", mode="max", local_dir=local,
+                  checkpoint_freq=1)
+    assert len(a1.trials) == 3
+
+    # simulate an interruption: mark one trial as if it had been running
+    import cloudpickle
+
+    state_path = tmp_path / "exp" / "experiment_state.pkl"
+    full = cloudpickle.loads(state_path.read_bytes())
+    state = full["trials"]
+    assert all(s["status"] == "TERMINATED" for s in state)
+    state[1]["status"] = "RUNNING"   # pretend the driver died mid-trial
+    state[1]["last_result"] = {"score": 2, "training_iteration": 1}
+    state_path.write_bytes(cloudpickle.dumps(full))
+
+    # resume: trial 1 restarts (from checkpoint), 0 and 2 stay finished
+    a2 = tune.run(Slow, config={"x": tune.grid_search([1, 2, 3])},
+                  metric="score", mode="max", local_dir=local,
+                  checkpoint_freq=1, resume=True)
+    assert len(a2.trials) == 3, [t.trial_id for t in a2.trials]
+    by_id = {t.trial_id: t for t in a2.trials}
+    # the interrupted trial resumed FROM ITS CHECKPOINT (count=3 from
+    # run 1) and ran one more step to done: score = 2 * 4
+    assert by_id[state[1]["trial_id"]].status == "TERMINATED"
+    assert by_id[state[1]["trial_id"]].last_result["score"] == 8
+    # untouched trials kept their run-1 results (x=3 * 3 steps = 9)
+    assert a2.best_result["score"] == 9
