@@ -1,9 +1,10 @@
 from ray_tpu.rllib.agents.a3c import A3CTrainer
 from ray_tpu.rllib.agents.dqn import DQNTrainer
+from ray_tpu.rllib.agents.es import ESTrainer
 from ray_tpu.rllib.agents.impala import ImpalaTrainer
 from ray_tpu.rllib.agents.pg import PGTrainer
 from ray_tpu.rllib.agents.ppo import PPOTrainer
 from ray_tpu.rllib.agents.trainer import Trainer, build_trainer
 
-__all__ = ["A3CTrainer", "DQNTrainer", "ImpalaTrainer", "PGTrainer",
-           "PPOTrainer", "Trainer", "build_trainer"]
+__all__ = ["A3CTrainer", "DQNTrainer", "ESTrainer", "ImpalaTrainer",
+           "PGTrainer", "PPOTrainer", "Trainer", "build_trainer"]

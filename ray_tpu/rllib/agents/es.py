@@ -1,0 +1,258 @@
+"""Evolution strategies (reference: rllib/agents/es/es.py — Salimans et
+al. 2017): gradient-free search that parallelizes perfectly over
+actors. Each iteration: workers evaluate antithetic parameter
+perturbations on full episodes; the learner combines returns into one
+weight update (rank-normalized, mirrored sampling).
+
+Shape here: perturbations are generated worker-side from a shared noise
+seed + offsets (only integers cross the wire, reference: es.py
+SharedNoiseTable), episode evaluation is the unit of actor work, the
+update is a single vectorized combine on the driver."""
+
+from __future__ import annotations
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.agents.trainer import Trainer
+from ray_tpu.rllib.env import make_env
+from ray_tpu.rllib.policy.jax_policy import JAXPolicy
+
+ES_CONFIG: dict = {
+    "num_workers": 2,
+    "episodes_per_batch": 16,    # perturbation PAIRS per iteration
+    "noise_std": 0.05,
+    "step_size": 0.02,
+    "noise_table_size": 4_000_000,
+    # noise_seed defaults from config["seed"] when unset
+    "noise_seed": None,
+    "eval_episode_len": 1000,
+}
+
+
+def _flatten(params) -> tuple[np.ndarray, list]:
+    import jax
+
+    leaves, treedef = jax.tree.flatten(params)
+    flat = np.concatenate([np.asarray(l).ravel() for l in leaves])
+    shapes = [np.asarray(l).shape for l in leaves]
+    return flat.astype(np.float32), (treedef, shapes)
+
+
+def _unflatten(flat: np.ndarray, spec):
+    import jax
+
+    treedef, shapes = spec
+    out, off = [], 0
+    for shape in shapes:
+        n = int(np.prod(shape)) if shape else 1
+        out.append(flat[off:off + n].reshape(shape))
+        off += n
+    return jax.tree.unflatten(treedef, out)
+
+
+def _noise_table(size: int, seed: int) -> np.ndarray:
+    return np.random.RandomState(seed).randn(size).astype(np.float32)
+
+
+def rank_transform(returns: np.ndarray) -> np.ndarray:
+    """Centered-rank normalization (reference: es/utils.py
+    compute_centered_ranks) — robust to return scale/outliers."""
+    ranks = np.empty(returns.size, dtype=np.float32)
+    ranks[returns.ravel().argsort()] = np.arange(returns.size)
+    ranks = ranks.reshape(returns.shape)
+    return ranks / (returns.size - 1) - 0.5
+
+
+class _ESWorker:
+    """Actor: evaluates antithetic perturbations on full episodes."""
+
+    def __init__(self, env_spec, env_config, policy_config, table_size,
+                 noise_seed, worker_seed):
+        self.env = make_env(env_spec, env_config or {})
+        self.policy = JAXPolicy(self.env.observation_space,
+                                self.env.action_space, policy_config)
+        self.noise = _noise_table(table_size, noise_seed)
+        self._rng = np.random.RandomState(worker_seed)
+        flat, self._spec = _flatten(self.policy.params)
+        self._dim = flat.size
+
+    def _episode_return(self, flat_params, max_steps) -> float:
+        self.policy.set_weights(_unflatten(flat_params, self._spec))
+        obs, _ = self.env.reset(
+            seed=int(self._rng.randint(0, 2**31 - 1)))
+        total, steps, done = 0.0, 0, False
+        while not done and steps < max_steps:
+            acts, _ = self.policy.compute_actions(
+                np.asarray(obs, np.float32).ravel()[None], explore=False)
+            act = int(acts[0]) if self.policy.discrete else acts[0]
+            obs, r, term, trunc, _ = self.env.step(act)
+            total += float(r)
+            steps += 1
+            done = term or trunc
+        return total
+
+    def evaluate_pairs(self, flat_params: np.ndarray, num_pairs: int,
+                       noise_std: float, max_steps: int):
+        """[(noise_offset, return_pos, return_neg), ...] — mirrored
+        sampling cancels the baseline (reference: es.py antithetic)."""
+        flat_params = np.asarray(flat_params, np.float32)
+        out = []
+        for _ in range(num_pairs):
+            off = int(self._rng.randint(
+                0, self.noise.size - self._dim))
+            eps = self.noise[off:off + self._dim]
+            r_pos = self._episode_return(flat_params + noise_std * eps,
+                                         max_steps)
+            r_neg = self._episode_return(flat_params - noise_std * eps,
+                                         max_steps)
+            out.append((off, r_pos, r_neg))
+        return out
+
+    def stop(self):
+        try:
+            self.env.close()
+        except Exception:
+            pass
+
+
+class ESTrainer(Trainer):
+    _name = "ES"
+    _default_config = ES_CONFIG
+
+    def setup(self, config: dict):
+        if config.get("env") is None:
+            raise ValueError("config['env'] must be set")
+        # driver-side policy holds the current parameters
+        env = make_env(config["env"], config.get("env_config", {}))
+        self.policy = JAXPolicy(env.observation_space, env.action_space,
+                                config)
+        env.close()
+        self.flat, self._spec = _flatten(self.policy.params)
+        noise_seed = config.get("noise_seed")
+        if noise_seed is None:
+            noise_seed = (config.get("seed") or 0) + 42
+        self._noise_seed = noise_seed
+        self.noise = _noise_table(config["noise_table_size"], noise_seed)
+        worker_cls = ray_tpu.remote(
+            resources={"CPU": config.get("num_cpus_per_worker", 1)})(
+            _ESWorker)
+        n = max(1, config["num_workers"])
+        self.workers = [
+            worker_cls.remote(config["env"], config.get("env_config"),
+                              {k: v for k, v in config.items()
+                               if k not in ("env",)},
+                              config["noise_table_size"],
+                              self._noise_seed,
+                              (config.get("seed") or 0) * 10_000
+                              + 1000 + i)
+            for i in range(n)
+        ]
+        self._episodes_total = 0
+
+    def train_step(self) -> dict:  # pragma: no cover - step() overrides
+        raise NotImplementedError
+
+    def evaluate(self, num_episodes=None) -> dict:
+        """Greedy episodes with the current parameters (the base
+        Trainer.evaluate assumes a WorkerSet; ES evaluates driver-side
+        with its own policy)."""
+        import numpy as np
+
+        n = (self.config.get("evaluation_num_episodes", 5)
+             if num_episodes is None else num_episodes)
+        if n <= 0:
+            raise ValueError("evaluation_num_episodes must be >= 1")
+        env = make_env(self.config["env"],
+                       self.config.get("env_config", {}))
+        rewards, lengths = [], []
+        try:
+            for ep in range(n):
+                obs, _ = env.reset(seed=10_000 + ep)
+                total, steps, done = 0.0, 0, False
+                while not done and steps < 10_000:
+                    acts, _ = self.policy.compute_actions(
+                        np.asarray(obs, np.float32).ravel()[None],
+                        explore=False)
+                    act = (int(acts[0]) if self.policy.discrete
+                           else acts[0])
+                    obs, r, term, trunc, _ = env.step(act)
+                    total += float(r)
+                    steps += 1
+                    done = term or trunc
+                rewards.append(total)
+                lengths.append(steps)
+        finally:
+            try:
+                env.close()
+            except Exception:
+                pass
+        return {"episode_reward_mean": float(np.mean(rewards)),
+                "episode_reward_min": float(np.min(rewards)),
+                "episode_reward_max": float(np.max(rewards)),
+                "episode_len_mean": float(np.mean(lengths)),
+                "episodes": n}
+
+    def step(self) -> dict:
+        cfg = self.config
+        total_pairs = max(1, cfg["episodes_per_batch"])
+        base, extra = divmod(total_pairs, len(self.workers))
+        counts = [base + (1 if i < extra else 0)
+                  for i in range(len(self.workers))]
+        results = ray_tpu.get(
+            [w.evaluate_pairs.remote(self.flat, c, cfg["noise_std"],
+                                     cfg["eval_episode_len"])
+             for w, c in zip(self.workers, counts) if c], timeout=600)
+        offsets, pos, neg = [], [], []
+        for worker_out in results:
+            for off, r_pos, r_neg in worker_out:
+                offsets.append(off)
+                pos.append(r_pos)
+                neg.append(r_neg)
+        pos = np.asarray(pos, np.float32)
+        neg = np.asarray(neg, np.float32)
+        ranks = rank_transform(np.stack([pos, neg]))
+        weights = ranks[0] - ranks[1]          # mirrored-sample combine
+        dim = self.flat.size
+        grad = np.zeros(dim, np.float32)
+        for w, off in zip(weights, offsets):
+            grad += w * self.noise[off:off + dim]
+        grad /= len(offsets) * cfg["noise_std"]
+        self.flat = self.flat + cfg["step_size"] * grad
+        self.policy.set_weights(_unflatten(self.flat, self._spec))
+        self._episodes_total += 2 * len(offsets)
+        metrics = {
+            "episode_reward_mean": float(np.mean(np.concatenate(
+                [pos, neg]))),
+            "episode_reward_max": float(max(pos.max(), neg.max())),
+            "episodes_total": self._episodes_total,
+            "grad_norm": float(np.linalg.norm(grad)),
+        }
+        interval = cfg.get("evaluation_interval") or 0
+        if interval and (self.iteration + 1) % interval == 0:
+            metrics["evaluation"] = self.evaluate()
+        return metrics
+
+    def get_policy(self, policy_id=None):
+        return self.policy
+
+    def save_checkpoint(self, checkpoint_dir: str):
+        return {"flat": self.flat,
+                "episodes_total": self._episodes_total}
+
+    def load_checkpoint(self, state):
+        self.flat = state["flat"]
+        self._episodes_total = state.get("episodes_total", 0)
+        self.policy.set_weights(_unflatten(self.flat, self._spec))
+
+    def cleanup(self):
+        try:
+            ray_tpu.get([w.stop.remote() for w in self.workers],
+                        timeout=30)
+        except Exception:
+            pass
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass
