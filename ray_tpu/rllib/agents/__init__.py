@@ -4,7 +4,9 @@ from ray_tpu.rllib.agents.es import ESTrainer
 from ray_tpu.rllib.agents.impala import ImpalaTrainer
 from ray_tpu.rllib.agents.pg import PGTrainer
 from ray_tpu.rllib.agents.ppo import PPOTrainer
+from ray_tpu.rllib.agents.sac import SACTrainer
 from ray_tpu.rllib.agents.trainer import Trainer, build_trainer
 
 __all__ = ["A3CTrainer", "DQNTrainer", "ESTrainer", "ImpalaTrainer",
-           "PGTrainer", "PPOTrainer", "Trainer", "build_trainer"]
+           "PGTrainer", "PPOTrainer", "SACTrainer", "Trainer",
+           "build_trainer"]

@@ -129,6 +129,10 @@ class ESTrainer(Trainer):
                                 config)
         env.close()
         self.flat, self._spec = _flatten(self.policy.params)
+        if self.flat.size >= config["noise_table_size"]:
+            raise ValueError(
+                f"noise_table_size ({config['noise_table_size']}) must "
+                f"exceed the policy's parameter count ({self.flat.size})")
         noise_seed = config.get("noise_seed")
         if noise_seed is None:
             noise_seed = (config.get("seed") or 0) + 42
@@ -152,46 +156,6 @@ class ESTrainer(Trainer):
 
     def train_step(self) -> dict:  # pragma: no cover - step() overrides
         raise NotImplementedError
-
-    def evaluate(self, num_episodes=None) -> dict:
-        """Greedy episodes with the current parameters (the base
-        Trainer.evaluate assumes a WorkerSet; ES evaluates driver-side
-        with its own policy)."""
-        import numpy as np
-
-        n = (self.config.get("evaluation_num_episodes", 5)
-             if num_episodes is None else num_episodes)
-        if n <= 0:
-            raise ValueError("evaluation_num_episodes must be >= 1")
-        env = make_env(self.config["env"],
-                       self.config.get("env_config", {}))
-        rewards, lengths = [], []
-        try:
-            for ep in range(n):
-                obs, _ = env.reset(seed=10_000 + ep)
-                total, steps, done = 0.0, 0, False
-                while not done and steps < 10_000:
-                    acts, _ = self.policy.compute_actions(
-                        np.asarray(obs, np.float32).ravel()[None],
-                        explore=False)
-                    act = (int(acts[0]) if self.policy.discrete
-                           else acts[0])
-                    obs, r, term, trunc, _ = env.step(act)
-                    total += float(r)
-                    steps += 1
-                    done = term or trunc
-                rewards.append(total)
-                lengths.append(steps)
-        finally:
-            try:
-                env.close()
-            except Exception:
-                pass
-        return {"episode_reward_mean": float(np.mean(rewards)),
-                "episode_reward_min": float(np.min(rewards)),
-                "episode_reward_max": float(np.max(rewards)),
-                "episode_len_mean": float(np.mean(lengths)),
-                "episodes": n}
 
     def step(self) -> dict:
         cfg = self.config

@@ -1,0 +1,273 @@
+"""Soft actor-critic (reference: rllib/agents/sac/sac.py +
+sac_torch_policy.py — Haarnoja et al.): off-policy continuous control
+with twin Q critics, a squashed-Gaussian actor, learned temperature
+against a target entropy, and polyak-averaged target critics.
+
+Execution shape mirrors the DQN family here: rollout actors fill a
+replay buffer, the (TPU-hostable) learner runs one fused jitted update
+per minibatch — actor, both critics, and temperature step in a single
+jit with donated state."""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from ray_tpu.rllib.agents.trainer import Trainer
+from ray_tpu.rllib.execution.replay_buffer import ReplayBuffer
+from ray_tpu.rllib.policy.jax_policy import (JAXPolicy, _mlp_apply,
+                                             _mlp_init)
+from ray_tpu.rllib.policy.policy import Policy
+from ray_tpu.rllib.policy.sample_batch import SampleBatch
+
+SAC_CONFIG: dict = {
+    "rollout_fragment_length": 64,
+    "learning_starts": 500,
+    "buffer_size": 100_000,
+    "train_batch_size": 128,
+    "sgd_iters_per_step": 32,
+    "gamma": 0.99,
+    "tau": 0.01,                 # polyak coefficient
+    "lr": 3e-4,
+    "initial_alpha": 0.2,
+    "target_entropy": None,      # default: -act_dim
+    "fcnet_hiddens": [64, 64],
+}
+
+_LOG_STD_MIN, _LOG_STD_MAX = -10.0, 2.0
+
+
+class SACPolicy(Policy):
+    """Squashed-Gaussian actor + twin Q critics, all as one pytree."""
+
+    def __init__(self, observation_space, action_space, config: dict):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        merged = {**SAC_CONFIG, **config}
+        super().__init__(observation_space, action_space, merged)
+        if hasattr(action_space, "n"):
+            raise ValueError("SAC here is continuous-control only; use "
+                             "DQN for discrete actions")
+        self.discrete = False
+        obs_dim = int(np.prod(observation_space.shape))
+        act_dim = int(np.prod(action_space.shape))
+        self._act_dim = act_dim
+        self._act_scale = (action_space.high - action_space.low) / 2.0
+        self._act_mid = (action_space.high + action_space.low) / 2.0
+        hiddens = list(merged.get("fcnet_hiddens", [64, 64]))
+        seed = merged.get("seed") or 0
+        keys = jax.random.split(jax.random.key(seed), 4)
+        q_sizes = [obs_dim + act_dim] + hiddens + [1]
+        self.params = {
+            "pi": _mlp_init(keys[0], [obs_dim] + hiddens + [2 * act_dim]),
+            "q1": _mlp_init(keys[1], q_sizes),
+            "q2": _mlp_init(keys[2], q_sizes),
+            "log_alpha": jnp.asarray(
+                math.log(merged["initial_alpha"]), jnp.float32),
+        }
+        self.target = {"q1": jax.tree.map(lambda x: x, self.params["q1"]),
+                       "q2": jax.tree.map(lambda x: x, self.params["q2"])}
+        self._target_entropy = (merged["target_entropy"]
+                                if merged["target_entropy"] is not None
+                                else -float(act_dim))
+        self._optimizer = optax.adam(merged["lr"])
+        self.opt_state = self._optimizer.init(self.params)
+        self._rng = jax.random.key(seed + 1)
+        self._build()
+
+    # -- nets ------------------------------------------------------------
+
+    @staticmethod
+    def _pi_dist(params, obs):
+        import jax.numpy as jnp
+
+        out = _mlp_apply(params["pi"], obs)
+        mean, log_std = jnp.split(out, 2, axis=-1)
+        log_std = jnp.clip(log_std, _LOG_STD_MIN, _LOG_STD_MAX)
+        return mean, log_std
+
+    @staticmethod
+    def _sample_squashed(params, obs, key):
+        """-> (action in [-1,1], logp) with tanh-squash correction."""
+        import jax
+        import jax.numpy as jnp
+
+        mean, log_std = SACPolicy._pi_dist(params, obs)
+        std = jnp.exp(log_std)
+        raw = mean + std * jax.random.normal(key, mean.shape)
+        logp = jnp.sum(
+            -0.5 * ((raw - mean) / std) ** 2 - log_std
+            - 0.5 * math.log(2 * math.pi), axis=-1)
+        act = jnp.tanh(raw)
+        # change of variables for tanh (stable form)
+        logp -= jnp.sum(2.0 * (math.log(2.0) - raw
+                               - jax.nn.softplus(-2.0 * raw)), axis=-1)
+        return act, logp
+
+    @staticmethod
+    def _q(params_q, obs, act):
+        import jax.numpy as jnp
+
+        return _mlp_apply(params_q, jnp.concatenate([obs, act], -1))[:, 0]
+
+    def _build(self):
+        import jax
+        import jax.numpy as jnp
+
+        gamma = self.config["gamma"]
+        tau = self.config["tau"]
+        target_entropy = self._target_entropy
+        optimizer = self._optimizer
+
+        @jax.jit
+        def act(params, obs, key):
+            a, _ = SACPolicy._sample_squashed(params, obs, key)
+            return a
+
+        @jax.jit
+        def act_greedy(params, obs):
+            mean, _ = SACPolicy._pi_dist(params, obs)
+            return jnp.tanh(mean)
+
+        def loss_fn(params, target, batch, key):
+            obs = batch["obs"]
+            nxt = batch["new_obs"]
+            k1, k2 = jax.random.split(key)
+            alpha = jnp.exp(params["log_alpha"])
+            # critic targets from the target nets + fresh next actions
+            a2, logp2 = SACPolicy._sample_squashed(params, nxt, k2)
+            q_next = jnp.minimum(
+                SACPolicy._q(target["q1"], nxt, a2),
+                SACPolicy._q(target["q2"], nxt, a2))
+            backup = batch["rewards"] + gamma * (1 - batch["dones"]) * (
+                q_next - jax.lax.stop_gradient(alpha) * logp2)
+            backup = jax.lax.stop_gradient(backup)
+            q1 = SACPolicy._q(params["q1"], obs, batch["actions"])
+            q2 = SACPolicy._q(params["q2"], obs, batch["actions"])
+            critic_loss = ((q1 - backup) ** 2).mean() + (
+                (q2 - backup) ** 2).mean()
+            # actor: maximize min-Q of reparameterized action - alpha*logp
+            a_new, logp_new = SACPolicy._sample_squashed(params, obs, k1)
+            q_new = jnp.minimum(
+                SACPolicy._q(jax.lax.stop_gradient(params["q1"]), obs,
+                             a_new),
+                SACPolicy._q(jax.lax.stop_gradient(params["q2"]), obs,
+                             a_new))
+            actor_loss = (jax.lax.stop_gradient(alpha) * logp_new
+                          - q_new).mean()
+            # temperature toward target entropy
+            alpha_loss = (-jnp.exp(params["log_alpha"])
+                          * jax.lax.stop_gradient(
+                              logp_new + target_entropy)).mean()
+            total = critic_loss + actor_loss + alpha_loss
+            return total, {"critic_loss": critic_loss,
+                           "actor_loss": actor_loss,
+                           "alpha": alpha}
+
+        @jax.jit
+        def update(params, target, opt_state, batch, key):
+            (loss, metrics), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, target, batch, key)
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            params = jax.tree.map(lambda p, u: p + u, params, updates)
+            target = jax.tree.map(
+                lambda t, p: (1 - tau) * t + tau * p, target,
+                {"q1": params["q1"], "q2": params["q2"]})
+            return params, target, opt_state, loss, metrics
+
+        self._act = act
+        self._act_greedy = act_greedy
+        self._update = update
+
+    # -- Policy surface --------------------------------------------------
+
+    def compute_actions(self, obs_batch, explore: bool = True):
+        import jax
+        import jax.numpy as jnp
+
+        obs = jnp.asarray(obs_batch, jnp.float32).reshape(
+            len(obs_batch), -1)
+        if explore:
+            self._rng, sub = jax.random.split(self._rng)
+            act = self._act(self.params, obs, sub)
+        else:
+            act = self._act_greedy(self.params, obs)
+        scaled = np.asarray(act) * self._act_scale + self._act_mid
+        return scaled, {SampleBatch.ACTION_LOGP: np.zeros(len(obs_batch)),
+                        SampleBatch.VF_PREDS: np.zeros(len(obs_batch))}
+
+    def postprocess_trajectory(self, batch, other_agent_batches=None,
+                               episode=None):
+        return batch
+
+    def learn_on_batch(self, batch: SampleBatch) -> dict:
+        import jax
+        import jax.numpy as jnp
+
+        # actions come back in env scale; train in squashed [-1,1]
+        norm_act = ((batch[SampleBatch.ACTIONS] - self._act_mid)
+                    / self._act_scale)
+        jb = {
+            "obs": jnp.asarray(batch[SampleBatch.OBS], jnp.float32),
+            "new_obs": jnp.asarray(batch[SampleBatch.NEXT_OBS],
+                                   jnp.float32),
+            "actions": jnp.asarray(
+                np.clip(norm_act, -0.999, 0.999), jnp.float32),
+            "rewards": jnp.asarray(batch[SampleBatch.REWARDS],
+                                   jnp.float32),
+            "dones": jnp.asarray(batch[SampleBatch.DONES], jnp.float32),
+        }
+        self._rng, sub = jax.random.split(self._rng)
+        (self.params, self.target, self.opt_state, loss,
+         metrics) = self._update(self.params, self.target,
+                                 self.opt_state, jb, sub)
+        out = {"total_loss": float(loss)}
+        out.update({k: float(v) for k, v in metrics.items()})
+        return out
+
+    def get_weights(self):
+        import jax
+
+        return {"params": jax.tree.map(np.asarray, self.params),
+                "target": jax.tree.map(np.asarray, self.target)}
+
+    def set_weights(self, weights):
+        import jax
+        import jax.numpy as jnp
+
+        self.params = jax.tree.map(jnp.asarray, weights["params"])
+        self.target = jax.tree.map(jnp.asarray, weights["target"])
+
+
+class SACTrainer(Trainer):
+    """reference: rllib/agents/sac/sac.py execution plan (store →
+    replay → fused train), same shape as the DQN family here."""
+
+    _default_config = SAC_CONFIG
+    _name = "SAC"
+
+    @staticmethod
+    def policy_builder(obs_space, action_space, config):
+        return SACPolicy(obs_space, action_space, config)
+
+    def setup(self, config):
+        super().setup(config)
+        self._buffer = ReplayBuffer(config["buffer_size"],
+                                    seed=config.get("seed"))
+
+    def train_step(self) -> dict:
+        config = self.config
+        batch = self.workers.sample(config["rollout_fragment_length"])
+        self._buffer.add_batch(batch)
+        metrics: dict = {"buffer_size": len(self._buffer)}
+        if len(self._buffer) >= config["learning_starts"]:
+            policy = self.workers.local_worker.policy
+            for _ in range(config["sgd_iters_per_step"]):
+                replay = self._buffer.sample(config["train_batch_size"])
+                metrics.update(policy.learn_on_batch(replay))
+            self.workers.sync_weights()
+        metrics["num_env_steps_sampled"] = len(batch)
+        return metrics

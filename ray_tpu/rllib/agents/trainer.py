@@ -92,7 +92,8 @@ class Trainer(Trainable):
 
         from ray_tpu.rllib.env import make_env
 
-        if hasattr(self.workers.local_worker, "policies"):
+        lw = getattr(self.workers, "local_worker", None)
+        if lw is not None and hasattr(lw, "policies"):
             raise ValueError(
                 "evaluate() supports single-agent trainers only; roll "
                 "multi-agent evaluation with your env's dict API")
