@@ -36,12 +36,20 @@ def _tpu_visible() -> bool:
 
 def _bench_config():
     on_accel = _tpu_visible()
+    # RAY_TPU_BENCH_STEM=s2d flips the exactly-equivalent space-to-depth
+    # stem (models/resnet.py); read once here so the raw child and the
+    # framework worker provably use the same value
+    stem = os.environ.get("RAY_TPU_BENCH_STEM", "standard")
+    if stem not in ("standard", "s2d"):
+        raise ValueError(f"RAY_TPU_BENCH_STEM={stem!r}: expected "
+                         "'standard' or 's2d'")
     return {
         "model": "resnet50" if on_accel else "resnet18",
         "batch": BATCH if on_accel else 8,
         "hw": 224 if on_accel else 32,
         "steps": STEPS if on_accel else 2,
         "on_accel": on_accel,
+        "stem": stem,
     }
 
 
@@ -55,7 +63,8 @@ def _make_batch(cfg_dict):
 
     from ray_tpu.models import resnet
 
-    cfg = (resnet.resnet50() if cfg_dict["model"] == "resnet50"
+    cfg = (resnet.resnet50(stem_mode=cfg_dict.get("stem", "standard"))
+           if cfg_dict["model"] == "resnet50"
            else resnet.resnet18(num_classes=10, small_images=True))
     key = jax.random.key(0)
     images = jax.random.normal(
@@ -257,6 +266,7 @@ def _stale_from_cache() -> bool:
 
 
 def _supervise():
+    _bench_config()  # fail fast on bad knobs before the slow TPU probe
     attempts = [({}, 900), ({"JAX_PLATFORMS": "cpu"}, 600)]
     tpu_dead = not _probe_tpu()
     if tpu_dead:
