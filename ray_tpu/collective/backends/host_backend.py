@@ -294,8 +294,142 @@ class HostGroup:
             raise TimeoutError(reply["error"])
         return reply, data
 
+    # ---- ring data plane (large tensors) ----
+    # The hub is latency-optimal for control-sized tensors but serializes
+    # all-to-hub bandwidth through one socket — wrong for gradients
+    # (reference role: gloo's ring algorithms behind torch.distributed).
+    # Large allreduces use a bidirectional ring of direct rank-to-rank
+    # TCP connections: reduce-scatter + allgather, 2*(w-1) steps, each
+    # rank moving 2*(w-1)/w of the tensor total.
+
+    RING_MIN_BYTES = 1 << 16
+
+    def _ensure_ring(self) -> bool:
+        if self.world_size <= 2:
+            return False  # ring degenerates to pairwise; hub is fine
+        if getattr(self, "_ring_next", None) is not None:
+            return True
+        listener = socket.socket()
+        listener.bind(("127.0.0.1", 0))
+        listener.listen(2)
+        port = listener.getsockname()[1]
+        addr = f"127.0.0.1:{port}".encode().ljust(32, b"\0")
+        addrs = self.allgather(np.frombuffer(addr, np.uint8))
+        nxt = bytes(addrs[(self.rank + 1) % self.world_size]
+                    ).rstrip(b"\0").decode()
+        host, p = nxt.rsplit(":", 1)
+
+        out: dict = {}
+
+        def _connect():
+            try:
+                out["sock"] = socket.create_connection(
+                    (host, int(p)), timeout=self._timeout)
+            except OSError as e:  # surfaced by the join below
+                out["err"] = e
+
+        t = threading.Thread(target=_connect, daemon=True)
+        t.start()
+        listener.settimeout(self._timeout)
+        prev_sock, _ = listener.accept()
+        prev_sock.settimeout(None)
+        t.join(self._timeout)
+        listener.close()
+        if "sock" not in out:
+            prev_sock.close()
+            raise ConnectionError(
+                f"ring connect to rank {(self.rank + 1) % self.world_size}"
+                f" failed: {out.get('err')}")
+        out["sock"].settimeout(None)
+        self._ring_next = out["sock"]
+        self._ring_prev = prev_sock
+        return True
+
+    @staticmethod
+    def _ring_send(sock: socket.socket, data: bytes):
+        sock.sendall(_HDR.pack(len(data)) + data)
+
+    @staticmethod
+    def _ring_recv(sock: socket.socket) -> bytes:
+        (n,) = _HDR.unpack(_recv_exact(sock, 4))
+        return _recv_exact(sock, n)
+
+    def _ring_step(self, send_bytes: bytes) -> bytes:
+        """Full-duplex: push to next while pulling from prev (the send
+        rides a thread so neither side can deadlock on full buffers;
+        socket timeouts bound both directions)."""
+        err: list = []
+
+        def _send():
+            try:
+                self._ring_send(self._ring_next, send_bytes)
+            except Exception as e:
+                err.append(e)
+
+        t = threading.Thread(target=_send, daemon=True)
+        t.start()
+        data = self._ring_recv(self._ring_prev)
+        t.join(self._timeout)
+        if t.is_alive() or err:
+            # a lingering send thread would interleave with the next
+            # step's frames — the ring is no longer trustworthy
+            raise TimeoutError(
+                f"ring send stalled/failed: {err or 'timeout'}")
+        return data
+
+    def _ring_allreduce(self, arr: np.ndarray, op: ReduceOp) -> np.ndarray:
+        w = self.world_size
+        flat = arr.reshape(-1)
+        pad = (-len(flat)) % w
+        if pad:
+            flat = np.concatenate([flat, np.zeros(pad, arr.dtype)])
+        # MEAN matches the hub's np.mean semantics: float64 accumulate
+        # and a float result for integer inputs (also dodges overflow)
+        if op == ReduceOp.MEAN and not np.issubdtype(arr.dtype,
+                                                     np.floating):
+            flat = flat.astype(np.float64)
+        work = flat.copy()
+        chunk = len(work) // w
+        combine = getattr(
+            np, _NUMPY_REDUCE[ReduceOp.SUM if op == ReduceOp.MEAN
+                              else ReduceOp(op)])
+
+        def view(i):
+            i %= w
+            return work[i * chunk:(i + 1) * chunk]
+
+        for step in range(w - 1):  # reduce-scatter
+            send_idx = self.rank - step
+            recv_idx = self.rank - step - 1
+            incoming = self._ring_step(view(send_idx).tobytes())
+            recv = view(recv_idx)
+            np.copyto(recv, combine(
+                recv, np.frombuffer(incoming, arr.dtype)))
+        for step in range(w - 1):  # allgather of reduced chunks
+            send_idx = self.rank + 1 - step
+            recv_idx = self.rank - step
+            incoming = self._ring_step(view(send_idx).tobytes())
+            np.copyto(view(recv_idx), np.frombuffer(incoming, arr.dtype))
+        if op == ReduceOp.MEAN:
+            work = work / w
+            out = work[:flat.size - pad] if pad else work
+            return out[:arr.size].reshape(arr.shape)  # float, like hub
+        out = work[:flat.size - pad] if pad else work
+        return out[:arr.size].reshape(arr.shape).astype(arr.dtype,
+                                                        copy=False)
+
     def allreduce(self, arr: np.ndarray, op: ReduceOp = ReduceOp.SUM):
         arr = np.ascontiguousarray(arr)
+        if (arr.nbytes >= self.RING_MIN_BYTES and self.world_size > 2
+                and not self._destroyed):
+            if self._ensure_ring():  # collective all-or-nothing setup
+                try:
+                    return self._ring_allreduce(arr, ReduceOp(op))
+                except (ConnectionError, TimeoutError, OSError):
+                    # abort-not-hang invariant: surface the failure (the
+                    # SGD layer resizes); the broken ring never reused
+                    self._ring_teardown()
+                    raise
         reply, data = self._collective(
             "allreduce", {**_arr_meta(arr), "op": op.value}, arr.tobytes())
         return _arr_from(reply["meta"], data)
@@ -363,6 +497,13 @@ class HostGroup:
         if self._destroyed:
             return
         self._destroyed = True
+        for ring_sock in (getattr(self, "_ring_next", None),
+                          getattr(self, "_ring_prev", None)):
+            if ring_sock is not None:
+                try:
+                    ring_sock.close()
+                except Exception:
+                    pass
         if self.rank == 0 and self.world_size > 1:
             try:
                 self._listener.close()

@@ -148,3 +148,50 @@ def test_xla_group_ops():
     shifted = np.asarray(group.shift_right(stacked))
     np.testing.assert_allclose(shifted[1], stacked[0])
     np.testing.assert_allclose(shifted[0], stacked[n - 1])
+
+
+def test_host_ring_allreduce_large(ray_start_shared):
+    """Large tensors take the ring data plane (direct rank-to-rank TCP,
+    reduce-scatter + allgather) instead of the star hub; results match
+    across ops and odd sizes, and the hub path still serves small ops."""
+    import ray_tpu
+    from ray_tpu import collective
+
+    @ray_tpu.remote
+    class W:
+        def __init__(self, rank, world):
+            collective.init_collective_group(world, rank, backend="host",
+                                            group_name="ring_test")
+            self.rank = rank
+            self.world = world
+
+        def run(self):
+            import numpy as np
+
+            from ray_tpu.collective.types import ReduceOp
+            from ray_tpu.collective import collective as C
+
+            group = C._manager.get_group("ring_test")
+            # big odd-sized tensor -> ring path (pads internally)
+            big = np.full(50_001, float(self.rank + 1), np.float32)
+            out = group.allreduce(big, ReduceOp.SUM)
+            expect = sum(range(1, self.world + 1))
+            assert out.shape == (50_001,)
+            assert np.allclose(out, expect), out[:4]
+            assert getattr(group, "_ring_next", None) is not None, \
+                "large allreduce did not take the ring"
+            # MEAN over the ring
+            mean = group.allreduce(big, ReduceOp.MEAN)
+            assert np.allclose(mean, expect / self.world)
+            # small tensor stays on the hub (no new semantics)
+            small = group.allreduce(
+                np.ones(8, np.float32) * (self.rank + 1), ReduceOp.MAX)
+            assert np.allclose(small, self.world)
+            return True
+
+    world = 4
+    workers = [W.remote(r, world) for r in range(world)]
+    assert all(ray_tpu.get([w.run.remote() for w in workers],
+                           timeout=120))
+    for w in workers:
+        ray_tpu.kill(w)
