@@ -321,29 +321,67 @@ class HostGroup:
 
         out: dict = {}
 
+        lock = threading.Lock()
+
         def _connect():
             try:
-                out["sock"] = socket.create_connection(
+                sock = socket.create_connection(
                     (host, int(p)), timeout=self._timeout)
             except OSError as e:  # surfaced by the join below
                 out["err"] = e
+                return
+            with lock:
+                if out.get("abandoned"):  # caller already gave up
+                    sock.close()
+                else:
+                    out["sock"] = sock
 
         t = threading.Thread(target=_connect, daemon=True)
         t.start()
-        listener.settimeout(self._timeout)
-        prev_sock, _ = listener.accept()
-        prev_sock.settimeout(None)
-        t.join(self._timeout)
-        listener.close()
-        if "sock" not in out:
-            prev_sock.close()
-            raise ConnectionError(
-                f"ring connect to rank {(self.rank + 1) % self.world_size}"
-                f" failed: {out.get('err')}")
-        out["sock"].settimeout(None)
+        prev_sock = None
+        try:
+            listener.settimeout(self._timeout)
+            prev_sock, _ = listener.accept()
+            # keep the configured timeout on both ring sockets so a
+            # stalled (connected but silent) peer raises socket.timeout
+            # instead of hanging recv forever — abort-not-hang applies
+            # to the data plane
+            prev_sock.settimeout(self._timeout)
+            t.join(self._timeout)
+            with lock:
+                if "sock" not in out:
+                    out["abandoned"] = True  # late connect self-closes
+                    raise ConnectionError(
+                        f"ring connect to rank "
+                        f"{(self.rank + 1) % self.world_size}"
+                        f" failed: {out.get('err')}")
+        except BaseException:
+            if prev_sock is not None:
+                prev_sock.close()
+            sock = out.get("sock")
+            if sock is not None:
+                sock.close()
+            raise
+        finally:
+            listener.close()
+        out["sock"].settimeout(self._timeout)
         self._ring_next = out["sock"]
         self._ring_prev = prev_sock
         return True
+
+    def _ring_teardown(self):
+        """Close and forget both ring sockets. A failed ring op leaves
+        peers at different steps, so the connections are unusable; the
+        next large allreduce rebuilds the ring from scratch (or fails the
+        collective setup, which the caller handles)."""
+        for name in ("_ring_next", "_ring_prev"):
+            sock = getattr(self, name, None)
+            if sock is not None:
+                try:
+                    sock.close()
+                except Exception:
+                    pass
+            setattr(self, name, None)
 
     @staticmethod
     def _ring_send(sock: socket.socket, data: bytes):
@@ -403,20 +441,22 @@ class HostGroup:
             recv_idx = self.rank - step - 1
             incoming = self._ring_step(view(send_idx).tobytes())
             recv = view(recv_idx)
+            # parse with the wire dtype (work.dtype): for integer MEAN the
+            # work buffer — and therefore every frame on the ring — is
+            # float64, not arr.dtype
             np.copyto(recv, combine(
-                recv, np.frombuffer(incoming, arr.dtype)))
+                recv, np.frombuffer(incoming, work.dtype)))
         for step in range(w - 1):  # allgather of reduced chunks
             send_idx = self.rank + 1 - step
             recv_idx = self.rank - step
             incoming = self._ring_step(view(send_idx).tobytes())
-            np.copyto(view(recv_idx), np.frombuffer(incoming, arr.dtype))
+            np.copyto(view(recv_idx), np.frombuffer(incoming, work.dtype))
         if op == ReduceOp.MEAN:
-            work = work / w
-            out = work[:flat.size - pad] if pad else work
-            return out[:arr.size].reshape(arr.shape)  # float, like hub
-        out = work[:flat.size - pad] if pad else work
-        return out[:arr.size].reshape(arr.shape).astype(arr.dtype,
-                                                        copy=False)
+            work = work / w  # float result, like the hub's np.mean
+        out = work[:arr.size].reshape(arr.shape)
+        if op == ReduceOp.MEAN:
+            return out
+        return out.astype(arr.dtype, copy=False)
 
     def allreduce(self, arr: np.ndarray, op: ReduceOp = ReduceOp.SUM):
         arr = np.ascontiguousarray(arr)
@@ -425,9 +465,11 @@ class HostGroup:
             if self._ensure_ring():  # collective all-or-nothing setup
                 try:
                     return self._ring_allreduce(arr, ReduceOp(op))
-                except (ConnectionError, TimeoutError, OSError):
+                except Exception:
                     # abort-not-hang invariant: surface the failure (the
-                    # SGD layer resizes); the broken ring never reused
+                    # SGD layer resizes); the broken ring never reused.
+                    # Any exception mid-ring (transport OR dtype/shape
+                    # mismatch) leaves peers desynced — always tear down.
                     self._ring_teardown()
                     raise
         reply, data = self._collective(
@@ -497,13 +539,7 @@ class HostGroup:
         if self._destroyed:
             return
         self._destroyed = True
-        for ring_sock in (getattr(self, "_ring_next", None),
-                          getattr(self, "_ring_prev", None)):
-            if ring_sock is not None:
-                try:
-                    ring_sock.close()
-                except Exception:
-                    pass
+        self._ring_teardown()
         if self.rank == 0 and self.world_size > 1:
             try:
                 self._listener.close()

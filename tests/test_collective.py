@@ -187,6 +187,23 @@ def test_host_ring_allreduce_large(ray_start_shared):
             small = group.allreduce(
                 np.ones(8, np.float32) * (self.rank + 1), ReduceOp.MAX)
             assert np.allclose(small, self.world)
+            # integer dtypes over the ring: SUM keeps the dtype; MEAN
+            # promotes the whole wire to float64 (hub np.mean semantics)
+            for idtype in (np.int32, np.int64):
+                ibig = np.full(50_001, self.rank + 1, idtype)
+                isum = group.allreduce(ibig, ReduceOp.SUM)
+                assert isum.dtype == idtype, isum.dtype
+                assert (isum == expect).all(), isum[:4]
+                imean = group.allreduce(ibig, ReduceOp.MEAN)
+                assert np.issubdtype(imean.dtype, np.floating), imean.dtype
+                assert np.allclose(imean, expect / self.world), imean[:4]
+            # float16 rides the ring at its own width
+            hbig = np.full(50_001, np.float16(self.rank + 1), np.float16)
+            hsum = group.allreduce(hbig, ReduceOp.SUM)
+            assert hsum.dtype == np.float16
+            assert np.allclose(hsum, expect, atol=1e-2)
+            hmean = group.allreduce(hbig, ReduceOp.MEAN)
+            assert np.allclose(hmean, expect / self.world, atol=1e-2)
             return True
 
     world = 4
