@@ -23,6 +23,15 @@ ACTOR_TASK = 2
 RESOURCE_QUANTUM = 10000
 
 
+class InsufficientResources(RuntimeError):
+    """Raylet-side admission miss: the GCS's availability snapshot raced a
+    lease grant. Travels pickled inside rpc.RemoteError so the GCS can
+    distinguish a benign scheduling bounce from a real actor-creation
+    failure by type, not by matching error text (reference analog: the
+    SCHEDULING_FAILED status on CreateActorReply,
+    src/ray/protobuf/gcs_service.proto)."""
+
+
 def quantize(value: float) -> int:
     return int(round(value * RESOURCE_QUANTUM))
 

@@ -29,7 +29,7 @@ import random
 import time
 
 from ray_tpu._private import rpc
-from ray_tpu._private.common import ResourceSet
+from ray_tpu._private.common import InsufficientResources, ResourceSet
 from ray_tpu._private.config import Config, get_config, set_config
 
 logger = logging.getLogger("ray_tpu.gcs")
@@ -463,7 +463,7 @@ class GcsServer:
         try:
             reply = await conn.call("create_actor", {"spec": spec})
         except Exception as e:
-            if "insufficient resources" in str(e):
+            if isinstance(getattr(e, "exc", None), InsufficientResources):
                 # The GCS's availability view was stale (lease grants race
                 # the heartbeat): that is a scheduling miss, not an actor
                 # failure — requeue, and correct the view so the next

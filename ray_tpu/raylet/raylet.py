@@ -26,7 +26,7 @@ import sys
 import time
 
 from ray_tpu._private import rpc
-from ray_tpu._private.common import ResourceSet
+from ray_tpu._private.common import InsufficientResources, ResourceSet
 from ray_tpu._private.config import Config, get_config, set_config
 from ray_tpu._private.ids import NodeID, ObjectID
 from ray_tpu._private.object_store import make_store
@@ -79,6 +79,7 @@ class Raylet:
         # _pop_worker forever.
         self._starting_procs: list = []  # [(Popen, flavor)]
         self._warned_infeasible: set[tuple] = set()
+        self._metric_merge_logged: set[str] = set()
 
         # metrics (reference: src/ray/stats/metric_defs.cc raylet set)
         from ray_tpu._private import stats
@@ -560,7 +561,7 @@ class Raylet:
         acquired = self._try_acquire(spec)
         if acquired is None:
             # GCS checked the resource snapshot, but we may have raced.
-            raise RuntimeError("insufficient resources for actor")
+            raise InsufficientResources("insufficient resources for actor")
         res, pg_key = acquired
         try:
             worker = await asyncio.wait_for(
@@ -1002,6 +1003,10 @@ class Raylet:
         worker_snaps = await asyncio.gather(
             *[_pull(w) for w in list(self.workers.values())
               if not w.conn.closed])
+        # raylet-owned names are never clobbered by a worker metric that
+        # happens to share the name; incompatible merges log once
+        reserved = set(snap)
+        logged = self._metric_merge_logged
         for ws in worker_snaps:
             for name, m in ws.items():
                 cur = snap.get(name)
@@ -1016,8 +1021,22 @@ class Raylet:
                                      zip(cur["counts"], m["counts"])]
                     cur["sum"] = cur.get("sum", 0) + m.get("sum", 0)
                     cur["count"] = cur.get("count", 0) + m.get("count", 0)
+                elif (name in reserved or m.get("type") != cur.get("type")
+                      or m.get("type") == "histogram"):
+                    # reserved-name collision, cross-type collision, or
+                    # histograms whose bucket boundaries disagree:
+                    # dropping is the only merge that doesn't corrupt one
+                    # side (only same-type worker gauges may overwrite)
+                    if name not in logged:
+                        logged.add(name)
+                        logger.warning(
+                            "worker metric %r (%s) conflicts with an "
+                            "existing %s metric (reserved=%s); worker "
+                            "values are dropped from the merged snapshot",
+                            name, m.get("type"), cur.get("type"),
+                            name in reserved)
                 else:
-                    snap[name] = dict(m)  # gauges: last writer wins
+                    snap[name] = dict(m)  # worker gauges: last writer wins
         return snap
 
     async def h_cluster_info(self, conn, d):

@@ -77,3 +77,24 @@ def test_pending_actor_schedules_when_resources_free(ray_start_regular):
     assert ray_tpu.get(late_ready, timeout=60) is True
     for h in holders[1:]:
         h.quit.remote()
+
+
+def test_insufficient_resources_bounce_is_typed():
+    """The raylet's admission miss travels as a typed exception through
+    the RPC layer (pickled inside RemoteError) so the GCS detects the
+    benign scheduling bounce by isinstance, never by matching error text
+    (reference analog: CreateActorReply SCHEDULING_FAILED status)."""
+    import pickle
+
+    from ray_tpu._private.common import InsufficientResources
+    from ray_tpu._private.rpc import RemoteError
+
+    # the exact round-trip rpc.py performs for a raised handler exception
+    exc = pickle.loads(pickle.dumps(
+        InsufficientResources("insufficient resources for actor")))
+    wrapped = RemoteError(exc, "trace")
+    # ...and the exact check server.py's _schedule_actor applies
+    assert isinstance(getattr(wrapped, "exc", None), InsufficientResources)
+    assert not isinstance(
+        getattr(RemoteError(RuntimeError("boom"), "t"), "exc", None),
+        InsufficientResources)
