@@ -43,6 +43,12 @@ def _bench_config():
     if stem not in ("standard", "s2d"):
         raise ValueError(f"RAY_TPU_BENCH_STEM={stem!r}: expected "
                          "'standard' or 's2d'")
+    # RAY_TPU_BENCH_BN=pallas swaps the BN training backward for the
+    # fused dual-reduction kernel (ops/batchnorm.py); same math
+    bn = os.environ.get("RAY_TPU_BENCH_BN", "xla")
+    if bn not in ("xla", "pallas"):
+        raise ValueError(f"RAY_TPU_BENCH_BN={bn!r}: expected "
+                         "'xla' or 'pallas'")
     return {
         "model": "resnet50" if on_accel else "resnet18",
         "batch": BATCH if on_accel else 8,
@@ -50,6 +56,7 @@ def _bench_config():
         "steps": STEPS if on_accel else 2,
         "on_accel": on_accel,
         "stem": stem,
+        "bn": bn,
     }
 
 
@@ -63,7 +70,8 @@ def _make_batch(cfg_dict):
 
     from ray_tpu.models import resnet
 
-    cfg = (resnet.resnet50(stem_mode=cfg_dict.get("stem", "standard"))
+    cfg = (resnet.resnet50(stem_mode=cfg_dict.get("stem", "standard"),
+                           bn_mode=cfg_dict.get("bn", "xla"))
            if cfg_dict["model"] == "resnet50"
            else resnet.resnet18(num_classes=10, small_images=True))
     key = jax.random.key(0)

@@ -36,6 +36,10 @@ class ResNetConfig:
     # 4x4 stride-1 conv over 12 channels instead of a 7x7 stride-2 conv
     # over 3 (a 3-deep reduction wastes the 128-deep MXU contraction).
     stem_mode: str = "standard"
+    # "pallas": train-mode BN backward runs ops/batchnorm.py's one-pass
+    # dual-reduction kernel (Σdy and Σdy·x̂ from a single read of x/dy)
+    # instead of XLA's conv-fused reductions. Same math either way.
+    bn_mode: str = "xla"
 
 
 def resnet18(num_classes=1000, **kw) -> ResNetConfig:
@@ -67,12 +71,20 @@ def _bn_init(c):
             {"mean": jnp.zeros((c,)), "var": jnp.ones((c,))})
 
 
-def _bn(x, p, s, train: bool, momentum=0.9, eps=1e-5):
+def _bn(x, p, s, train: bool, momentum=0.9, eps=1e-5, mode="xla"):
     """Batchnorm, bandwidth-lean: the two stat reductions run with fp32
     accumulation (XLA fuses the convert into the reduce — no fp32 copy of
     the activation is materialized), and the normalization itself is a
     per-channel scale/offset applied in the compute dtype so the only
-    full-size tensors that touch HBM stay bfloat16."""
+    full-size tensors that touch HBM stay bfloat16. mode="pallas" swaps
+    the training backward for ops/batchnorm.py's fused dual reduction."""
+    if train and mode == "pallas":
+        from ray_tpu.ops.batchnorm import bn_train
+
+        y, mean, var = bn_train(x, p["scale"], p["bias"], eps)
+        new_s = {"mean": momentum * s["mean"] + (1 - momentum) * mean,
+                 "var": momentum * s["var"] + (1 - momentum) * var}
+        return y, new_s
     if train:
         xf = x.astype(jnp.float32)
         mean = jnp.mean(xf, axis=(0, 1, 2))
@@ -164,22 +176,22 @@ def init(key, cfg: ResNetConfig):
     return params, state
 
 
-def _apply_block(x, p, s, stride, bottleneck, train):
+def _apply_block(x, p, s, stride, bottleneck, train, bn_mode="xla"):
     new_s = {}
     residual = x
     if "proj" in p:
         residual = _conv(x, p["proj"], stride)
         residual, new_s["proj_bn"] = _bn(residual, p["proj_bn"],
-                                         s["proj_bn"], train)
+                                         s["proj_bn"], train, mode=bn_mode)
     y = _conv(x, p["conv1"], stride if not bottleneck else 1)
-    y, new_s["bn1"] = _bn(y, p["bn1"], s["bn1"], train)
+    y, new_s["bn1"] = _bn(y, p["bn1"], s["bn1"], train, mode=bn_mode)
     y = jax.nn.relu(y)
     y = _conv(y, p["conv2"], stride if bottleneck else 1)
-    y, new_s["bn2"] = _bn(y, p["bn2"], s["bn2"], train)
+    y, new_s["bn2"] = _bn(y, p["bn2"], s["bn2"], train, mode=bn_mode)
     if bottleneck:
         y = jax.nn.relu(y)
         y = _conv(y, p["conv3"])
-        y, new_s["bn3"] = _bn(y, p["bn3"], s["bn3"], train)
+        y, new_s["bn3"] = _bn(y, p["bn3"], s["bn3"], train, mode=bn_mode)
     return jax.nn.relu(residual + y), new_s
 
 
@@ -192,7 +204,7 @@ def apply(params, state, x, cfg: ResNetConfig, train: bool = True):
     else:
         y = _conv(x, params["stem_conv"], 1 if cfg.small_images else 2)
     y, new_state["stem_bn"] = _bn(y, params["stem_bn"], state["stem_bn"],
-                                  train)
+                                  train, mode=cfg.bn_mode)
     y = jax.nn.relu(y)
     if not cfg.small_images:
         y = lax.reduce_window(y, -jnp.inf, lax.max, (1, 3, 3, 1),
@@ -203,7 +215,8 @@ def apply(params, state, x, cfg: ResNetConfig, train: bool = True):
             name = f"s{s}b{b}"
             stride = 2 if (b == 0 and s > 0) else 1
             y, new_state[name] = _apply_block(
-                y, params[name], state[name], stride, cfg.bottleneck, train)
+                y, params[name], state[name], stride, cfg.bottleneck, train,
+                cfg.bn_mode)
 
     y = jnp.mean(y.astype(jnp.float32), axis=(1, 2))
     logits = y @ params["fc_w"] + params["fc_b"]
