@@ -155,7 +155,8 @@ def start_raylet(session_dir: str, gcs_address: str, config: Config, *,
                  node_id: NodeID | None = None, num_cpus: float | None = None,
                  num_tpus: float = 0, resources: dict | None = None,
                  labels: dict | None = None, is_head=False,
-                 store_root: str | None = None) -> tuple[ServiceProcess, str, NodeID, str]:
+                 store_root: str | None = None,
+                 tpu_slice: dict | None = None) -> tuple[ServiceProcess, str, NodeID, str]:
     node_id = node_id or NodeID.from_random()
     ready = os.path.join(session_dir, f"raylet_ready_{node_id.hex()[:8]}")
     log_file = os.path.join(session_dir, "logs",
@@ -178,6 +179,10 @@ def start_raylet(session_dir: str, gcs_address: str, config: Config, *,
         cmd += ["--num-cpus", str(num_cpus)]
     if num_tpus:
         cmd += ["--num-tpus", str(num_tpus)]
+    if tpu_slice:
+        if hasattr(tpu_slice, "to_dict"):  # TpuSliceDescriptor
+            tpu_slice = tpu_slice.to_dict()
+        cmd += ["--tpu-slice", json.dumps(tpu_slice)]
     if is_head:
         cmd += ["--is-head"]
     svc = _spawn(cmd, config, f"raylet-{node_id.hex()[:8]}")
@@ -190,7 +195,7 @@ class Node:
 
     def __init__(self, *, config: Config, session_dir: str | None = None,
                  gcs_address: str | None = None, num_cpus=None, num_tpus=0,
-                 resources=None, labels=None):
+                 resources=None, labels=None, tpu_slice=None):
         self.config = config
         self.session_dir = session_dir or new_session_dir()
         self.processes: list[ServiceProcess] = []
@@ -203,7 +208,7 @@ class Node:
         raylet_proc, raylet_addr, node_id, store_root = start_raylet(
             self.session_dir, gcs_address, config,
             num_cpus=num_cpus, num_tpus=num_tpus, resources=resources,
-            labels=labels, is_head=self.is_head)
+            labels=labels, is_head=self.is_head, tpu_slice=tpu_slice)
         self.processes.append(raylet_proc)
         self.raylet_address = raylet_addr
         self.node_id = node_id

@@ -97,7 +97,7 @@ def _rehydrate_handle(state) -> ActorHandle:
 
 class ActorClass:
     def __init__(self, cls, *, num_cpus=None, num_tpus=None, resources=None,
-                 max_restarts=0, max_concurrency=1):
+                 max_restarts=0, max_concurrency=1, accelerator_type=None):
         self._cls = cls
         self._class_name = cls.__name__
         self._num_cpus = num_cpus
@@ -105,6 +105,7 @@ class ActorClass:
         self._resources = resources or {}
         self._max_restarts = max_restarts
         self._max_concurrency = max_concurrency
+        self._accelerator_type = accelerator_type
         self._pickled = None
         self._cls_id = None
 
@@ -147,6 +148,11 @@ class ActorClass:
             resources["CPU"] = 0
         if num_tpus:
             resources["TPU"] = num_tpus
+        accel = opts.get("accelerator_type", self._accelerator_type)
+        if accel:
+            from ray_tpu.util.accelerators import accelerator_resource
+
+            resources.setdefault(accelerator_resource(accel), 0.001)
         pg = opts.get("placement_group")
         actor_id = cw.create_actor(
             cls_id=cls_id,

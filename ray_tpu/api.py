@@ -205,13 +205,13 @@ def remote(*args, **kwargs):
 def _make_remote(obj, opts):
     if inspect.isclass(obj):
         allowed = {"num_cpus", "num_tpus", "resources", "max_restarts",
-                   "max_concurrency"}
+                   "max_concurrency", "accelerator_type"}
         bad = set(opts) - allowed
         if bad:
             raise ValueError(f"unsupported actor options: {bad}")
         return ActorClass(obj, **opts)
     allowed = {"num_cpus", "num_tpus", "resources", "num_returns",
-               "max_retries"}
+               "max_retries", "accelerator_type"}
     bad = set(opts) - allowed
     if bad:
         raise ValueError(f"unsupported task options: {bad}")
@@ -284,6 +284,7 @@ def nodes() -> list[dict]:
             "Resources": {k: v / 10000 for k, v in n["resources"].items()},
             "IsHead": n.get("is_head", False),
             "Labels": n.get("labels", {}),
+            "TpuSlice": n.get("tpu_slice"),
         }
         for n in info["nodes"]
     ]

@@ -52,11 +52,12 @@ class Cluster:
 
     def add_node(self, *, num_cpus: float | None = None, num_tpus: float = 0,
                  resources: dict | None = None, labels: dict | None = None,
-                 is_head: bool = False) -> ClusterNode:
+                 is_head: bool = False,
+                 tpu_slice: dict | None = None) -> ClusterNode:
         svc, address, node_id, store_root = start_raylet(
             self.session_dir, self.gcs_address, self.config,
             num_cpus=num_cpus, num_tpus=num_tpus, resources=resources,
-            labels=labels, is_head=is_head)
+            labels=labels, is_head=is_head, tpu_slice=tpu_slice)
         node = ClusterNode(svc, address, node_id, store_root)
         self.nodes.append(node)
         return node

@@ -227,6 +227,9 @@ class GcsServer:
             "hostname": d.get("hostname", ""),
             "is_head": d.get("is_head", False),
             "labels": d.get("labels", {}),
+            # util/accelerators.TpuSliceDescriptor dict or None: this
+            # host's ICI domain, consumed by _place_bundles
+            "tpu_slice": d.get("tpu_slice"),
             "state": "ALIVE",
             "start_time": time.time(),
         }
@@ -785,8 +788,26 @@ class GcsServer:
         self._persist_pg(rec)
         return "CREATED"
 
+    def _nodes_by_slice(self, node_ids):
+        """Group nodes by TPU slice_id (ICI domain). Nodes without a
+        slice descriptor are excluded."""
+        slices: dict[str, list] = {}
+        for nid in node_ids:
+            desc = self.nodes.get(nid, {}).get("tpu_slice")
+            if desc and desc.get("slice_id"):
+                slices.setdefault(desc["slice_id"], []).append(nid)
+        return slices
+
     def _place_bundles(self, bundles, strategy):
-        """Map bundle_index -> node_id, or None if infeasible now."""
+        """Map bundle_index -> node_id, or None if infeasible now.
+
+        TPU topology (SURVEY §7 step 1; reference strategy analog:
+        gcs_placement_group_scheduler.h:133-160): STRICT_PACK means "one
+        ICI domain" — a single node, or, for TPU bundles, the hosts of
+        ONE slice (equal slice_id ⇔ ICI-connected; never spans slices).
+        STRICT_SPREAD prefers distinct hosts of one slice before falling
+        back to arbitrary distinct nodes, so a dp group's gradient
+        allreduce rides ICI when a big-enough slice exists."""
         avail = {nid: r.copy() for nid, r in self.available.items()}
         placement: dict[int, bytes] = {}
         node_ids = list(avail.keys())
@@ -800,6 +821,26 @@ class GcsServer:
             avail[node_id].subtract(res)
 
         needs = [ResourceSet.from_raw(b["resources"]) for b in bundles]
+        wants_tpu = any(n.get("TPU") > 0 for n in needs)
+
+        def pack_within(cand_ids):
+            """Fit all bundles onto `cand_ids`, placing the LARGEST need
+            first onto the emptiest node (first-fit-decreasing — a
+            smaller bundle grabbing the big node can't strand a larger
+            one); returns placement dict or None. Mutates avail."""
+            local: dict[int, bytes] = {}
+            order = sorted(range(len(needs)),
+                           key=lambda i: -needs[i].get("TPU"))
+            for i in order:
+                need = needs[i]
+                cs = [n for n in cand_ids if fits(n, need)]
+                if not cs:
+                    return None
+                node = max(cs, key=lambda n: avail[n].get("TPU"))
+                take(node, need)
+                local[i] = node
+            return local
+
         if strategy in ("PACK", "STRICT_PACK"):
             # try to fit all on one node first
             for node_id in sorted(node_ids,
@@ -816,12 +857,47 @@ class GcsServer:
                         placement[i] = node_id
                     return placement
             if strategy == "STRICT_PACK":
+                if not wants_tpu:
+                    return None
+                # one ICI domain: all bundles within a single slice
+                for slice_id, members in sorted(
+                        self._nodes_by_slice(node_ids).items(),
+                        key=lambda kv: -sum(avail[n].get("TPU")
+                                            for n in kv[1])):
+                    saved = {n: avail[n].copy() for n in members}
+                    local = pack_within(members)
+                    if local is not None:
+                        return local
+                    avail.update(saved)
                 return None
             # PACK falls back to spread-fit
         if strategy == "STRICT_SPREAD":
             if len(bundles) > len(node_ids):
                 return None
-            used: set[bytes] = set()
+            if wants_tpu:
+                # prefer distinct hosts of ONE slice (ICI for the group)
+                for slice_id, members in sorted(
+                        self._nodes_by_slice(node_ids).items(),
+                        key=lambda kv: -len(kv[1])):
+                    if len(members) < len(bundles):
+                        continue
+                    saved = {n: avail[n].copy() for n in members}
+                    used: set[bytes] = set()
+                    local: dict[int, bytes] = {}
+                    for i, need in enumerate(needs):
+                        cs = [n for n in members
+                              if n not in used and fits(n, need)]
+                        if not cs:
+                            local = None
+                            break
+                        node = random.choice(cs)
+                        used.add(node)
+                        take(node, need)
+                        local[i] = node
+                    if local is not None:
+                        return local
+                    avail.update(saved)
+            used = set()
             for i, need in enumerate(needs):
                 cands = [n for n in node_ids if n not in used and fits(n, need)]
                 if not cands:
@@ -898,9 +974,9 @@ class GcsServer:
 
 
 def _node_public(info):
-    return {k: info[k] for k in ("node_id", "address", "object_manager_address",
-                                 "resources", "hostname", "is_head", "state",
-                                 "labels")}
+    return {k: info.get(k) for k in (
+        "node_id", "address", "object_manager_address", "resources",
+        "hostname", "is_head", "state", "labels", "tpu_slice")}
 
 
 def main():

@@ -60,6 +60,33 @@ class MeshSpec:
         arr = np.array(devices).reshape(self.axis_sizes())
         return Mesh(arr, AXES)
 
+    @classmethod
+    def from_placement_group(cls, pg, *, tp: int | None = None, pp: int = 1,
+                             sp: int = 1, ep: int = 1) -> "MeshSpec":
+        """Derive the mesh from an actual TPU reservation, so shardings
+        follow placement instead of convention (closing SURVEY §7 step 4:
+        "STRICT_PACK = one ICI host" used to be a docstring).
+
+        Each bundle is one slice host contributing its TPU chips. tp
+        defaults to chips-per-host — tp is the innermost mesh axis, so
+        tensor-parallel collectives ride the within-host ICI island; dp
+        fills the remaining (cross-host) factor.
+        """
+        bundles = pg.bundle_specs if hasattr(pg, "bundle_specs") else pg
+        chips = [int(b.get("TPU", 0)) for b in bundles]
+        if not chips or any(c <= 0 for c in chips):
+            raise ValueError(
+                "placement group has bundles without TPU chips; "
+                f"bundle resources: {bundles}")
+        if len(set(chips)) != 1:
+            raise ValueError(
+                f"heterogeneous chips per bundle {chips}: a mesh needs "
+                "equal chips per host")
+        total = sum(chips)
+        if tp is None:
+            tp = chips[0]
+        return cls.auto(total, tp=tp, pp=pp, sp=sp, ep=ep)
+
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:
     """Inputs: batch over dp, sequence over sp."""

@@ -50,13 +50,26 @@ class WorkerHandle:
 class Raylet:
     def __init__(self, *, node_id: NodeID, session_dir: str, gcs_address: str,
                  resources: dict[str, float], store_root: str,
-                 is_head: bool, labels: dict[str, str], config: Config):
+                 is_head: bool, labels: dict[str, str], config: Config,
+                 tpu_slice: dict | None = None):
         self.node_id = node_id
         self.session_dir = session_dir
         self.gcs_address = gcs_address
         self.config = config
         self.is_head = is_head
         self.labels = labels
+        # TPU slice membership (util/accelerators.TpuSliceDescriptor as a
+        # dict): declares this host's ICI domain. Implies TPU chips and
+        # the accelerator_type:<gen> constraint resource if absent.
+        self.tpu_slice = tpu_slice
+        if tpu_slice:
+            from ray_tpu.util.accelerators import accelerator_resource
+
+            resources = dict(resources)
+            resources.setdefault("TPU",
+                                 float(tpu_slice["chips_per_host"]))
+            resources.setdefault(
+                accelerator_resource(tpu_slice["generation"]), 1.0)
         self.total = ResourceSet(resources)
         self.available = self.total.copy()
         self.store = make_store(store_root, config)
@@ -1143,6 +1156,7 @@ class Raylet:
                 "hostname": os.uname().nodename,
                 "is_head": self.is_head,
                 "labels": self.labels,
+                "tpu_slice": self.tpu_slice,
             })
 
         def _gcs_gone():
@@ -1188,6 +1202,7 @@ def main():
     parser.add_argument("--num-tpus", type=float, default=0)
     parser.add_argument("--resources", default="{}")
     parser.add_argument("--labels", default="{}")
+    parser.add_argument("--tpu-slice", default="")
     parser.add_argument("--is-head", action="store_true")
     parser.add_argument("--ready-file", default=None)
     parser.add_argument("--log-file", default=None)
@@ -1219,6 +1234,7 @@ def main():
         is_head=args.is_head,
         labels=json.loads(args.labels),
         config=get_config(),
+        tpu_slice=json.loads(args.tpu_slice) if args.tpu_slice else None,
     )
     asyncio.run(raylet.run(args.port, args.ready_file))
 

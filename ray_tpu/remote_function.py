@@ -10,7 +10,7 @@ from ray_tpu._private import global_state
 
 class RemoteFunction:
     def __init__(self, fn, *, num_returns=1, num_cpus=None, num_tpus=None,
-                 resources=None, max_retries=None):
+                 resources=None, max_retries=None, accelerator_type=None):
         self._function = fn
         self._name = getattr(fn, "__qualname__", str(fn))
         self._num_returns = num_returns
@@ -18,6 +18,7 @@ class RemoteFunction:
         self._num_tpus = num_tpus
         self._resources = resources or {}
         self._max_retries = max_retries
+        self._accelerator_type = accelerator_type
         self._pickled = None
         self._fn_id = None
         self.__doc__ = fn.__doc__
@@ -48,6 +49,13 @@ class RemoteFunction:
         resources["CPU"] = 1 if num_cpus is None else num_cpus
         if num_tpus:
             resources["TPU"] = num_tpus
+        accel = opts.get("accelerator_type", self._accelerator_type)
+        if accel:
+            # constraint resource advertised by matching nodes (reference:
+            # util/accelerators — accelerator_type:<name> sliver request)
+            from ray_tpu.util.accelerators import accelerator_resource
+
+            resources.setdefault(accelerator_resource(accel), 0.001)
         return resources
 
     def _remote(self, args, kwargs, opts):

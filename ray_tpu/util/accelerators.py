@@ -1,0 +1,154 @@
+"""TPU accelerator types and slice topology descriptors.
+
+The TPU-native analog of the reference's accelerator-type registry
+(reference: python/ray/util/accelerators/accelerators.py:1-5, which lists
+NVIDIA_TESLA_* GPU constants and feeds `accelerator_type=` scheduling via
+an `accelerator_type:<name>` node resource). Here the registry models
+what actually matters on TPU hardware: the ICI domain. A *slice* is a set
+of hosts whose chips are connected by ICI; collectives ride ICI within a
+slice and fall to DCN across slices, so placement decisions (STRICT_PACK
+= one ICI domain) and mesh construction both key off these descriptors.
+
+Nodes carry a `TpuSliceDescriptor` at registration (raylet --tpu-slice);
+the GCS placement-group scheduler consumes it (gcs/server.py
+_place_bundles), and parallel.mesh.MeshSpec.from_placement_group turns a
+reserved slice back into a jax device mesh.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+
+# Accelerator-type constants (reference: util/accelerators/accelerators.py)
+TPU_V2 = "TPU-V2"
+TPU_V3 = "TPU-V3"
+TPU_V4 = "TPU-V4"
+TPU_V5E = "TPU-V5E"
+TPU_V5P = "TPU-V5P"
+TPU_V6E = "TPU-V6E"
+
+# node resource advertised by a node with an accelerator; tasks request a
+# sliver of it via accelerator_type= (mirrors the reference's
+# utils.resource_constraint_name_for_accelerator scheme)
+def accelerator_resource(generation: str) -> str:
+    return f"accelerator_type:{generation}"
+
+
+@dataclasses.dataclass(frozen=True)
+class TpuSliceDescriptor:
+    """One node's membership in an ICI-connected TPU slice.
+
+    slice_id:       opaque id shared by every host of the slice — equal
+                    slice_id ⇔ ICI-reachable (the STRICT_PACK domain)
+    generation:     one of the TPU_* constants
+    topology:       physical chip mesh of the WHOLE slice, e.g. (4, 4)
+    host_index:     this host's position in the slice [0, num_hosts)
+    num_hosts:      hosts in the slice
+    chips_per_host: chips local to each host (tp-friendly ICI island)
+    """
+
+    slice_id: str
+    generation: str
+    topology: tuple[int, ...]
+    host_index: int
+    num_hosts: int
+    chips_per_host: int
+
+    @property
+    def total_chips(self) -> int:
+        return self.num_hosts * self.chips_per_host
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["topology"] = list(self.topology)
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "TpuSliceDescriptor":
+        return cls(slice_id=d["slice_id"], generation=d["generation"],
+                   topology=tuple(d["topology"]),
+                   host_index=int(d["host_index"]),
+                   num_hosts=int(d["num_hosts"]),
+                   chips_per_host=int(d["chips_per_host"]))
+
+
+@dataclasses.dataclass(frozen=True)
+class SliceShape:
+    """A whole-slice shape users can request by name (PG tpu_slice=...)."""
+
+    name: str
+    generation: str
+    num_hosts: int
+    chips_per_host: int
+    topology: tuple[int, ...]
+
+    @property
+    def total_chips(self) -> int:
+        return self.num_hosts * self.chips_per_host
+
+
+# Canonical catalog: the pod-slice shapes of each generation (public
+# Cloud-TPU topologies). chips_per_host: v2/v3/v5e/v6e boards host 4/4/8/8
+# chips per VM at small scale; v4/v5p use 4-chip hosts.
+SLICE_SHAPES: dict[str, SliceShape] = {}
+
+
+def _register(name, gen, hosts, cph, topo):
+    SLICE_SHAPES[name] = SliceShape(name, gen, hosts, cph, topo)
+
+
+_register("v4-8", TPU_V4, 1, 4, (2, 2, 1))
+_register("v4-16", TPU_V4, 2, 4, (2, 2, 2))
+_register("v4-32", TPU_V4, 4, 4, (2, 2, 4))
+_register("v5e-4", TPU_V5E, 1, 4, (2, 2))
+_register("v5e-8", TPU_V5E, 1, 8, (2, 4))
+_register("v5e-16", TPU_V5E, 2, 8, (4, 4))
+_register("v5e-32", TPU_V5E, 4, 8, (4, 8))
+_register("v5e-64", TPU_V5E, 8, 8, (8, 8))
+_register("v5e-256", TPU_V5E, 32, 8, (16, 16))
+_register("v5p-8", TPU_V5P, 1, 4, (2, 2, 1))
+_register("v5p-16", TPU_V5P, 2, 4, (2, 2, 2))
+_register("v6e-4", TPU_V6E, 1, 4, (2, 2))
+_register("v6e-8", TPU_V6E, 1, 8, (2, 4))
+_register("v6e-16", TPU_V6E, 2, 8, (4, 4))
+
+_GEN_BY_PREFIX = {"v2": TPU_V2, "v3": TPU_V3, "v4": TPU_V4,
+                  "v5e": TPU_V5E, "v5litepod": TPU_V5E, "v5p": TPU_V5P,
+                  "v6e": TPU_V6E}
+
+
+def slice_shape(name: str) -> SliceShape:
+    """Resolve a slice-shape name. Catalog names resolve directly;
+    unknown `<gen>-<chips>` names synthesize a shape (8 chips/host for
+    v5e/v6e, 4 otherwise) so custom sizes work without registry edits."""
+    if name in SLICE_SHAPES:
+        return SLICE_SHAPES[name]
+    m = re.fullmatch(r"(v\d+[a-z]*|v5litepod)-(\d+)", name)
+    if not m:
+        raise ValueError(
+            f"unknown TPU slice shape {name!r}; catalog: "
+            f"{sorted(SLICE_SHAPES)} or '<generation>-<chips>'")
+    gen_key, chips = m.group(1), int(m.group(2))
+    gen = _GEN_BY_PREFIX.get(gen_key)
+    if gen is None:
+        raise ValueError(f"unknown TPU generation {gen_key!r} in {name!r}")
+    cph = 8 if gen in (TPU_V5E, TPU_V6E) else 4
+    cph = min(cph, chips)
+    if chips % cph:
+        raise ValueError(
+            f"{name!r}: {chips} chips not divisible by {cph} chips/host")
+    return SliceShape(name, gen, chips // cph, cph, (chips,))
+
+
+def slice_descriptors(shape: SliceShape,
+                      slice_id: str) -> list[TpuSliceDescriptor]:
+    """Per-host descriptors for one slice of `shape` (what each host's
+    raylet registers with)."""
+    return [
+        TpuSliceDescriptor(
+            slice_id=slice_id, generation=shape.generation,
+            topology=shape.topology, host_index=i,
+            num_hosts=shape.num_hosts, chips_per_host=shape.chips_per_host)
+        for i in range(shape.num_hosts)
+    ]

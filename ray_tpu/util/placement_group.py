@@ -67,10 +67,35 @@ class PlacementGroup:
         return f"PlacementGroup({self.id.hex()[:12]})"
 
 
-def placement_group(bundles: list[dict], strategy: str = "PACK",
-                    name: str = "") -> PlacementGroup:
+def placement_group(bundles: list[dict] | None = None,
+                    strategy: str = "PACK", name: str = "",
+                    tpu_slice: str | None = None) -> PlacementGroup:
     """Reserve `bundles` (list of resource dicts, e.g. [{"CPU": 1}]) across
-    the cluster atomically (reference: util/placement_group.py:147)."""
+    the cluster atomically (reference: util/placement_group.py:147).
+
+    tpu_slice="v5e-16" requests a whole ICI-connected slice instead of
+    hand-written bundles: one bundle per slice host ({TPU: chips/host} +
+    the accelerator_type constraint), STRICT_PACK so the GCS reserves
+    hosts of a single slice (ICI domain) — never across slices. Feed the
+    result to parallel.mesh.MeshSpec.from_placement_group to derive the
+    training mesh from the actual reservation."""
+    if tpu_slice is not None:
+        if bundles is not None:
+            raise ValueError("pass bundles OR tpu_slice, not both")
+        if strategy not in ("PACK", "STRICT_PACK"):
+            raise ValueError(
+                f"tpu_slice implies STRICT_PACK (one ICI domain); "
+                f"strategy={strategy!r} would contradict it")
+        from ray_tpu.util.accelerators import (accelerator_resource,
+                                               slice_shape)
+
+        shape = slice_shape(tpu_slice)
+        bundles = [
+            {"TPU": float(shape.chips_per_host),
+             accelerator_resource(shape.generation): 0.001}
+            for _ in range(shape.num_hosts)
+        ]
+        strategy = "STRICT_PACK"
     if strategy not in VALID_STRATEGIES:
         raise ValueError(
             f"Invalid strategy {strategy!r}; must be one of "

@@ -1,0 +1,145 @@
+"""TPU slice/ICI topology in the resource model (SURVEY §7 step 1).
+
+Nodes register TpuSliceDescriptors; the GCS placement-group scheduler
+treats equal slice_id as the ICI domain: STRICT_PACK never spans two
+slices, STRICT_SPREAD lands a dp group one-worker-per-host inside one
+slice, tpu_slice="..." placement groups expand to per-host bundles, and
+MeshSpec derives from the actual reservation (reference analogs:
+gcs_placement_group_scheduler.h:133-160 strategies,
+python/ray/util/accelerators/accelerators.py accelerator types)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private.node import start_gcs
+from ray_tpu.util.accelerators import (TPU_V5P, TpuSliceDescriptor,
+                                       slice_descriptors, slice_shape)
+from ray_tpu.util.placement_group import (placement_group,
+                                          placement_group_table,
+                                          remove_placement_group)
+
+
+def _two_slice_cluster(cluster):
+    """Head (CPU only) + two fake v5p-16 slices of 2 hosts x 4 chips."""
+    cluster.gcs_svc, cluster.gcs_address = start_gcs(
+        cluster.session_dir, cluster.config)
+    cluster.add_node(num_cpus=2, is_head=True)
+    shape = slice_shape("v5p-16")
+    by_slice = {}
+    for sid in ("sliceA", "sliceB"):
+        for desc in slice_descriptors(shape, sid):
+            node = cluster.add_node(num_cpus=1,
+                                    tpu_slice=desc.to_dict())
+            by_slice.setdefault(sid, []).append(node.node_id.hex())
+    cluster.connect_driver()
+    return by_slice
+
+
+def _bundle_nodes(pg):
+    rec = placement_group_table()[pg.id.hex()]
+    assert rec["state"] == "CREATED", rec
+    return [b["node_id"].hex() for b in rec["bundles"]]
+
+
+def test_strict_pack_stays_within_one_slice(ray_start_cluster):
+    by_slice = _two_slice_cluster(ray_start_cluster)
+
+    # 2 bundles x 4 chips: no single node fits both, but one slice does.
+    pg = placement_group([{"TPU": 4}, {"TPU": 4}], strategy="STRICT_PACK")
+    assert pg.ready(timeout=30)
+    nodes = _bundle_nodes(pg)
+    assert (set(nodes) <= set(by_slice["sliceA"])
+            or set(nodes) <= set(by_slice["sliceB"])), (
+        f"STRICT_PACK spanned slices: {nodes} vs {by_slice}")
+
+    # 3 bundles need 3 hosts in ONE ICI domain; every slice has 2 ->
+    # must stay PENDING (never satisfied by mixing slices).
+    pg3 = placement_group([{"TPU": 4}] * 3, strategy="STRICT_PACK")
+    assert not pg3.wait(timeout_seconds=2.0)
+    rec = placement_group_table()[pg3.id.hex()]
+    assert rec["state"] == "PENDING"
+    remove_placement_group(pg3)
+    remove_placement_group(pg)
+
+
+def test_strict_spread_lands_one_worker_per_host_same_slice(
+        ray_start_cluster):
+    by_slice = _two_slice_cluster(ray_start_cluster)
+    pg = placement_group([{"TPU": 1}, {"TPU": 1}],
+                         strategy="STRICT_SPREAD")
+    assert pg.ready(timeout=30)
+    nodes = _bundle_nodes(pg)
+    assert len(set(nodes)) == 2, f"dp group shared a host: {nodes}"
+    assert (set(nodes) <= set(by_slice["sliceA"])
+            or set(nodes) <= set(by_slice["sliceB"])), (
+        "dp group crossed slices (DCN) though one slice had room: "
+        f"{nodes} vs {by_slice}")
+    remove_placement_group(pg)
+
+
+def test_tpu_slice_pg_and_mesh_from_reservation(ray_start_cluster):
+    from ray_tpu.parallel.mesh import MeshSpec
+
+    _two_slice_cluster(ray_start_cluster)
+    pg = placement_group(tpu_slice="v5p-16")
+    assert pg.ready(timeout=30)
+    specs = pg.bundle_specs
+    assert len(specs) == 2 and all(b["TPU"] == 4 for b in specs), specs
+    nodes = _bundle_nodes(pg)
+    assert len(set(nodes)) == 2
+
+    # mesh derives from the reservation: tp = chips/host (within-host
+    # ICI), dp fills the cross-host factor
+    spec = MeshSpec.from_placement_group(pg)
+    assert (spec.dp, spec.tp) == (2, 4) and spec.size == 8
+    spec2 = MeshSpec.from_placement_group(pg, tp=2)
+    assert (spec2.dp, spec2.tp) == (4, 2)
+    remove_placement_group(pg)
+
+
+def test_accelerator_type_constrains_scheduling(ray_start_cluster):
+    _two_slice_cluster(ray_start_cluster)
+
+    @ray_tpu.remote(num_cpus=0, accelerator_type=TPU_V5P)
+    def on_tpu():
+        return True
+
+    assert ray_tpu.get(on_tpu.remote(), timeout=60) is True
+
+    @ray_tpu.remote(num_cpus=0, accelerator_type="TPU-V6E")
+    def wrong_gen():
+        return True
+
+    ready, _ = ray_tpu.wait([wrong_gen.remote()], num_returns=1,
+                            timeout=2.0)
+    assert not ready, "task for an absent accelerator type was scheduled"
+
+
+def test_slice_shape_catalog():
+    s = slice_shape("v5e-16")
+    assert (s.num_hosts, s.chips_per_host, s.total_chips) == (2, 8, 16)
+    custom = slice_shape("v5e-128")  # synthesized, not in catalog
+    assert custom.total_chips == 128 and custom.num_hosts == 16
+    with pytest.raises(ValueError):
+        slice_shape("gpu-8")
+    d = TpuSliceDescriptor.from_dict(
+        slice_descriptors(s, "s0")[1].to_dict())
+    assert d.host_index == 1 and d.total_chips == 16
+
+
+def test_tpu_nodes_advertise_descriptor_and_resources(ray_start_cluster):
+    by_slice = _two_slice_cluster(ray_start_cluster)
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        infos = {n["NodeID"]: n for n in ray_tpu.nodes()}
+        if len(infos) == 5:
+            break
+        time.sleep(0.2)
+    tpu_nodes = [n for n in infos.values() if n["TpuSlice"]]
+    assert len(tpu_nodes) == 4
+    for n in tpu_nodes:
+        assert n["Resources"].get("TPU") == 4.0
+        assert n["Resources"].get("accelerator_type:TPU-V5P") == 1.0
+        assert n["TpuSlice"]["slice_id"] in ("sliceA", "sliceB")
