@@ -1,0 +1,28 @@
+"""Shared run-provenance stamp for benchmark artifacts (MICROBENCH /
+RLBENCH): this box is load-sensitive ±30%, so cross-run comparisons need
+commit/time context attached to every artifact."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import time
+
+
+def run_metadata() -> dict:
+    def _git(*args):
+        try:
+            out = subprocess.run(
+                ["git", *args],
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+                capture_output=True, text=True, timeout=10)
+            return out.stdout.strip()
+        except Exception:
+            return ""
+
+    return {
+        "commit": _git("rev-parse", "--short", "HEAD"),
+        "timestamp": time.strftime("%Y-%m-%d %H:%M:%S"),
+        "hostname": os.uname().nodename,
+        "cpus": os.cpu_count(),
+    }

@@ -188,8 +188,8 @@ class CoreWorker:
 
     def _connect(self, raylet_address: str, gcs_address: str):
         async def setup():
-            port = await self.server.start_tcp()
-            self.address = f"127.0.0.1:{port}"
+            port = await self.server.start_tcp(host=self.config.bind_host)
+            self.address = f"{self.config.node_ip_address}:{port}"
             # GCS connection survives GCS restarts: on redial, re-subscribe
             # every actor channel and resync state missed while down
             # (reference: service_based_gcs_client.h reconnection).
@@ -393,7 +393,15 @@ class CoreWorker:
             self.memstore.put(object_id, payload)
         else:
             rec.plasma = True
-            self.store.put_serialized(object_id, header, buffers)
+            try:
+                self.store.put_serialized(object_id, header, buffers)
+            except MemoryError:
+                # store full: the raylet spills asynchronously after
+                # seals — force a synchronous spill pass and retry once
+                # (reference: plasma create retries after SpillObjects)
+                self._io.run(self.raylet.call(
+                    "spill_now", {"need_bytes": size}))
+                self.store.put_serialized(object_id, header, buffers)
             self._io.run(self.raylet.call("notify_object_sealed", {
                 "object_id": object_id.binary(), "size": size}))
             self.memstore.put(object_id, IN_PLASMA)
@@ -1521,15 +1529,56 @@ class CoreWorker:
         return conn
 
     def as_future(self, ref: ObjectRef) -> concurrent.futures.Future:
+        """Future resolving to the object, WITHOUT a parked thread per
+        call: a memstore ready-callback resolves small results inline
+        (reference analog: memory_store GetAsync), and only IN_PLASMA
+        values — which may pull or reconstruct — hop to a small shared
+        pool. A thread-per-call here capped serve HTTP at ~1k qps."""
         fut: concurrent.futures.Future = concurrent.futures.Future()
+        object_id = ref.id()
 
-        def waiter():
+        def deliver(result=None, exception=None):
+            # the caller may have cancelled (e.g. aiohttp killing a
+            # handler task on client disconnect) — never raise back into
+            # the putter's callback loop
+            if fut.cancelled():
+                return
             try:
-                fut.set_result(self._get_one(ref, None))
-            except BaseException as e:
-                fut.set_exception(e)
+                if exception is not None:
+                    fut.set_exception(exception)
+                else:
+                    fut.set_result(result)
+            except concurrent.futures.InvalidStateError:
+                pass
 
-        threading.Thread(target=waiter, daemon=True).start()
+        def resolve_blocking():
+            try:
+                deliver(self._get_one(ref, None))
+            except BaseException as e:
+                deliver(exception=e)
+
+        def on_ready():
+            found, value, is_exc = self.memstore.get_if_ready(object_id)
+            if not found or value is IN_PLASMA:
+                # raced a reset(), or plasma-resident: the pull/restore
+                # can block for seconds — a dedicated thread (the old
+                # per-call design) avoids head-of-line blocking behind
+                # other slow resolutions
+                threading.Thread(target=resolve_blocking,
+                                 daemon=True).start()
+                return
+            try:
+                result = serialization.deserialize(value)
+            except BaseException as e:
+                deliver(exception=e)
+                return
+            if is_exc:
+                deliver(exception=result)
+            else:
+                deliver(result)
+
+        self._ensure_fetch(ref)
+        self.memstore.add_ready_callback(object_id, on_ready)
         return fut
 
     def cluster_info(self) -> dict:

@@ -19,12 +19,13 @@ IN_PLASMA = object()  # sentinel value
 
 
 class _Entry:
-    __slots__ = ("value", "is_exception", "ready")
+    __slots__ = ("value", "is_exception", "ready", "callbacks")
 
     def __init__(self):
         self.value = None
         self.is_exception = False
         self.ready = False
+        self.callbacks = None  # list[callable] | None, fired on ready
 
 
 class MemoryStore:
@@ -46,7 +47,33 @@ class MemoryStore:
             entry.value = value
             entry.is_exception = is_exception
             entry.ready = True
+            cbs = entry.callbacks
+            entry.callbacks = None
             self._cv.notify_all()
+        for cb in cbs or ():  # outside the lock: callbacks may re-enter
+            try:
+                cb()
+            except Exception:
+                # a broken waiter (cancelled future, dead loop) must not
+                # starve sibling callbacks or abort the putter's loop
+                # over a task's remaining returns
+                import logging
+
+                logging.getLogger("ray_tpu").exception(
+                    "memstore ready-callback failed")
+
+    def add_ready_callback(self, object_id: ObjectID, cb) -> None:
+        """Invoke cb() once the entry becomes ready — immediately if it
+        already is. The async-get primitive: no thread parks per waiter
+        (reference analog: memory_store.h GetAsync)."""
+        with self._lock:
+            entry = self._entries.setdefault(object_id, _Entry())
+            if not entry.ready:
+                if entry.callbacks is None:
+                    entry.callbacks = []
+                entry.callbacks.append(cb)
+                return
+        cb()
 
     def put_in_plasma(self, object_id: ObjectID) -> None:
         self.put(object_id, IN_PLASMA)
@@ -96,7 +123,11 @@ class MemoryStore:
         """Return an entry to PENDING (object reconstruction: the lost
         value is being recomputed, so `put` must win again)."""
         with self._lock:
-            self._entries[object_id] = _Entry()
+            old = self._entries.get(object_id)
+            fresh = _Entry()
+            if old is not None and not old.ready:
+                fresh.callbacks = old.callbacks  # waiters follow the redo
+            self._entries[object_id] = fresh
 
     def delete(self, object_id: ObjectID) -> None:
         with self._lock:

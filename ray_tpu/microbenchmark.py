@@ -175,6 +175,7 @@ def _serve_qps(results: list[dict]):
 
 
 if __name__ == "__main__":
+    from ray_tpu._private.bench_meta import run_metadata as _metadata
     import argparse
 
     parser = argparse.ArgumentParser()
@@ -183,9 +184,9 @@ if __name__ == "__main__":
     parser.add_argument("--out", default=None,
                         help="write results JSON to this path")
     args = parser.parse_args()
-    out = main()
+    doc = {"metadata": _metadata(), "results": main()}
     if args.json:
-        print(json.dumps(out))
+        print(json.dumps(doc))
     if args.out:
         with open(args.out, "w") as f:
-            json.dump(out, f, indent=1)
+            json.dump(doc, f, indent=1)

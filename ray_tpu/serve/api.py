@@ -15,11 +15,16 @@ _client = None
 
 
 class Client:
-    def __init__(self, controller, proxy=None, http_port: int | None = None):
+    def __init__(self, controller, proxies=None,
+                 http_port: int | None = None):
         self._controller = controller
-        self._proxy = proxy
+        self._proxies = list(proxies or [])
         self._http_port = http_port
         self._handles: dict[str, ServeHandle] = {}
+
+    @property
+    def _proxy(self):  # back-compat single-proxy view
+        return self._proxies[0] if self._proxies else None
 
     # -- backends --------------------------------------------------------
 
@@ -90,13 +95,12 @@ class Client:
 
     # -- http ------------------------------------------------------------
 
-    def enable_http(self, host: str = "127.0.0.1", port: int = 0) -> int:
-        """Start the HTTP proxy actor after the fact; returns the port."""
-        if self._proxy is None:
-            proxy_cls = ray_tpu.remote(HTTPProxy)
-            self._proxy = proxy_cls.remote(self._controller, host, port)
-            self._http_port = ray_tpu.get(self._proxy.port.remote(),
-                                          timeout=60)
+    def enable_http(self, host: str = "127.0.0.1", port: int = 0,
+                    http_workers: int | None = None) -> int:
+        """Start the HTTP proxy actors after the fact; returns the port."""
+        if not self._proxies:
+            self._proxies, self._http_port = _start_proxies(
+                self._controller, host, port, http_workers)
         return self._http_port
 
     @property
@@ -108,8 +112,7 @@ class Client:
         for handle in self._handles.values():
             handle._router.close()
         self._handles.clear()
-        for actor in ([self._proxy] if self._proxy else []) + [
-                self._controller]:
+        for actor in self._proxies + [self._controller]:
             try:
                 ray_tpu.kill(actor)
             except Exception:
@@ -118,23 +121,61 @@ class Client:
             _client = None
 
 
+def _start_proxies(controller, host: str, port: int,
+                   http_workers: int | None) -> tuple[list, int]:
+    """N HTTP proxy processes sharing one port via SO_REUSEPORT — the
+    kernel load-balances accepts, so qps scales past a single event
+    loop's per-request ceiling (one pure-python loop tops out around
+    1k qps; the reference leans on uvicorn's C hot path + one proxy
+    per node instead).
+
+    Default is ONE proxy: each proxy runs its own Router with its own
+    in-flight accounting, so N proxies overcommit a backend's
+    max_concurrent_queries cap up to N-fold — scaling out is an explicit
+    choice (http_workers=N), not a surprise."""
+    import socket
+
+    n = http_workers or 1
+    if n > 1 and port == 0:
+        # reserve a concrete port all workers can share: a bound (not
+        # listening) SO_REUSEPORT socket holds the number while the
+        # proxies bind, and never receives connections
+        holder = socket.socket()
+        holder.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+        holder.bind((host, 0))
+        port = holder.getsockname()[1]
+    else:
+        holder = None
+    try:
+        proxy_cls = ray_tpu.remote(HTTPProxy)
+        proxies = [proxy_cls.remote(controller, host, port,
+                                    reuse_port=(n > 1))
+                   for _ in range(n)]
+        actual = ray_tpu.get([p.port.remote() for p in proxies],
+                             timeout=60)
+    finally:
+        if holder is not None:
+            holder.close()
+    return proxies, actual[0]
+
+
 def start(*, http: bool = False, http_host: str = "127.0.0.1",
-          http_port: int = 0, detached: bool = False) -> Client:
+          http_port: int = 0, http_workers: int | None = None,
+          detached: bool = False) -> Client:
     """Start (or connect to) a serve instance (reference: api.py:533)."""
     global _client
     if _client is not None:
-        if http and _client._proxy is None:
-            _client.enable_http(http_host, http_port)
+        if http and not _client._proxies:
+            _client.enable_http(http_host, http_port, http_workers)
         return _client
     controller_cls = ray_tpu.remote(ServeController)
     controller = controller_cls.remote()
-    proxy = None
+    proxies = []
     port = None
     if http:
-        proxy_cls = ray_tpu.remote(HTTPProxy)
-        proxy = proxy_cls.remote(controller, http_host, http_port)
-        port = ray_tpu.get(proxy.port.remote(), timeout=60)
-    _client = Client(controller, proxy, port)
+        proxies, port = _start_proxies(controller, http_host, http_port,
+                                       http_workers)
+    _client = Client(controller, proxies, port)
     return _client
 
 

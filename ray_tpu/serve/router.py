@@ -15,7 +15,8 @@ import time
 
 
 class _PendingQuery:
-    __slots__ = ("data", "event", "ref", "error", "abandoned")
+    __slots__ = ("data", "event", "ref", "error", "abandoned", "loop",
+                 "future")
 
     def __init__(self, data):
         self.data = data
@@ -23,6 +24,27 @@ class _PendingQuery:
         self.ref = None
         self.error = None
         self.abandoned = False
+        self.loop = None    # set by assign_async: asyncio bridge
+        self.future = None
+
+    def _notify(self):
+        """Dispatch outcome is ready: wake the sync waiter and, for async
+        callers, resolve their future on its own event loop (the flusher
+        thread can't touch asyncio state directly)."""
+        self.event.set()
+        if self.future is not None:
+            def _done(q=self):
+                if not q.future.done():
+                    if q.error is not None:
+                        q.future.set_exception(q.error)
+                    else:
+                        q.future.set_result(q.ref)
+            try:
+                self.loop.call_soon_threadsafe(_done)
+            except RuntimeError:
+                # caller's event loop already closed (proxy shutdown
+                # race): nobody is waiting; the sync event is set
+                pass
 
 
 class Router:
@@ -107,6 +129,30 @@ class Router:
             raise q.error
         return q.ref
 
+    async def assign_async(self, data, timeout: float = 30.0):
+        """assign() for asyncio callers (the HTTP proxy): enqueue and
+        await dispatch WITHOUT parking a thread per request — the proxy's
+        request concurrency is then bounded by the event loop, not an
+        executor pool."""
+        import asyncio
+
+        q = _PendingQuery(data)
+        q.loop = asyncio.get_running_loop()
+        q.future = q.loop.create_future()
+        with self._lock:
+            self._queue.append(q)
+        self._wake.set()
+        try:
+            return await asyncio.wait_for(asyncio.shield(q.future),
+                                          timeout)
+        except asyncio.TimeoutError:
+            with self._lock:
+                q.abandoned = True
+                if q in self._queue:
+                    self._queue.remove(q)
+            raise TimeoutError(
+                f"no replica accepted the query within {timeout}s")
+
     def close(self):
         self._closed = True
         self._wake.set()
@@ -141,61 +187,74 @@ class Router:
         return best
 
     def _flush_loop(self):
-        import random
+        import logging
 
         while not self._closed:
             self._wake.wait(timeout=0.05)
             self._wake.clear()
-            while not self._closed:
-                # one consistent snapshot per iteration: the poller
-                # thread swaps self._state on traffic cutover, and mixing
-                # two snapshots' backend maps would KeyError the flusher
-                state = self._state
-                with self._lock:
-                    if not self._queue:
-                        break
-                backend = self._pick_backend(state)
-                if backend is None or backend not in state["backends"]:
-                    time.sleep(0.01)
-                    continue
-                cfg = state["backends"][backend]["config"]
-                # fill a batch (or give stragglers batch_wait_timeout)
-                if cfg["max_batch_size"]:
-                    deadline = time.monotonic() + cfg["batch_wait_timeout"]
-                    while (not self._closed
-                           and len(self._queue) < cfg["max_batch_size"]
-                           and time.monotonic() < deadline):
-                        time.sleep(0.001)
-                replica = self._pick_replica(state, backend)
-                if replica is None:
-                    # chosen backend saturated — try any other traffic
-                    # backend with capacity before waiting
-                    for other in state.get("traffic", {}):
-                        if other != backend:
-                            replica = self._pick_replica(state, other)
-                            if replica is not None:
-                                backend = other
-                                cfg = state["backends"][other]["config"]
-                                break
-                if replica is None:
-                    time.sleep(0.002)
-                    continue
-                # batch sized by the backend that will actually serve it
-                max_bs = cfg["max_batch_size"] or 1
-                with self._lock:
-                    batch = [q for q in self._queue[:max_bs]
-                             if not q.abandoned]
-                    del self._queue[:max_bs]
-                if not batch:
-                    continue
-                self._dispatch(replica, batch)
-                # shadow traffic: mirror the batch, results dropped
-                # (reference: serve/api.py shadow_traffic)
-                for sb, prop in (state.get("shadow") or {}).items():
-                    if random.random() < prop:
-                        sreplica = self._pick_replica(state, sb)
-                        if sreplica is not None:
-                            self._dispatch(sreplica, batch, shadow=True)
+            try:
+                self._flush_once()
+            except Exception:
+                # the flusher must outlive any single bad dispatch —
+                # a dead flusher turns every future assign() into a
+                # timeout
+                logging.getLogger("ray_tpu.serve").exception(
+                    "router flush iteration failed")
+                time.sleep(0.05)
+
+    def _flush_once(self):
+        import random
+
+        while not self._closed:
+            # one consistent snapshot per iteration: the poller
+            # thread swaps self._state on traffic cutover, and mixing
+            # two snapshots' backend maps would KeyError the flusher
+            state = self._state
+            with self._lock:
+                if not self._queue:
+                    break
+            backend = self._pick_backend(state)
+            if backend is None or backend not in state["backends"]:
+                time.sleep(0.01)
+                continue
+            cfg = state["backends"][backend]["config"]
+            # fill a batch (or give stragglers batch_wait_timeout)
+            if cfg["max_batch_size"]:
+                deadline = time.monotonic() + cfg["batch_wait_timeout"]
+                while (not self._closed
+                       and len(self._queue) < cfg["max_batch_size"]
+                       and time.monotonic() < deadline):
+                    time.sleep(0.001)
+            replica = self._pick_replica(state, backend)
+            if replica is None:
+                # chosen backend saturated — try any other traffic
+                # backend with capacity before waiting
+                for other in state.get("traffic", {}):
+                    if other != backend:
+                        replica = self._pick_replica(state, other)
+                        if replica is not None:
+                            backend = other
+                            cfg = state["backends"][other]["config"]
+                            break
+            if replica is None:
+                time.sleep(0.002)
+                continue
+            # batch sized by the backend that will actually serve it
+            max_bs = cfg["max_batch_size"] or 1
+            with self._lock:
+                batch = [q for q in self._queue[:max_bs]
+                         if not q.abandoned]
+                del self._queue[:max_bs]
+            if not batch:
+                continue
+            self._dispatch(replica, batch)
+            # shadow traffic: mirror the batch, results dropped
+            # (reference: serve/api.py shadow_traffic)
+            for sb, prop in (state.get("shadow") or {}).items():
+                if random.random() < prop:
+                    sreplica = self._pick_replica(state, sb)
+                    if sreplica is not None:
+                        self._dispatch(sreplica, batch, shadow=True)
 
     def _dispatch(self, replica, batch: list[_PendingQuery],
                   shadow: bool = False):
@@ -210,12 +269,12 @@ class Router:
             if not shadow:
                 for q, ref in zip(batch, refs):
                     q.ref = ref
-                    q.event.set()
+                    q._notify()
         except Exception as e:
             if not shadow:
                 for q in batch:
                     q.error = e
-                    q.event.set()
+                    q._notify()
         with self._lock:
             if refs:
                 # shadow batches still occupy a replica slot until done

@@ -148,6 +148,24 @@ def test_http_proxy_roundtrip(serve_client):
         assert e.code == 404
 
 
+def test_http_multi_proxy_reuseport(serve_client):
+    """N proxy processes share one port via SO_REUSEPORT (the qps-scaling
+    mechanism for multi-core hosts); every connection gets served no
+    matter which proxy the kernel picks."""
+    import json
+    import urllib.request
+
+    serve_client.create_backend("mp_noop", lambda d=None: "ok")
+    serve_client.create_endpoint("mp_ep", backend="mp_noop",
+                                 route="/mp", methods=["GET"])
+    port = serve_client.enable_http(http_workers=2)
+    assert len(serve_client._proxies) == 2
+    for _ in range(8):  # fresh connection each time -> both proxies hit
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/mp", timeout=30) as resp:
+            assert json.loads(resp.read())["result"] == "ok"
+
+
 def test_traffic_split_and_shadow(serve_client):
     """set_traffic splits requests by weight across backends; shadow
     traffic mirrors without affecting results (reference: serve v1
