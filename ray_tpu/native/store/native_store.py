@@ -16,8 +16,9 @@ from lookups, block freed by the last release — so zero-copy views can
 never read reused memory (the per-client Get/Release bookkeeping plasma
 does in the reference, plasma/client.h). A crashed process leaks its
 pins (bounded by what it had mapped); the arena is per-session, so the
-leak dies with the session. Spill-eviction stays disabled for this
-backend (capacity is the configured arena size)."""
+leak dies with the session. The same pin/zombie mechanism is what makes
+raylet spill-to-disk safe here (raylet.py _maybe_spill): spill deletes
+after copying, and a delete under outstanding pins only zombifies."""
 
 from __future__ import annotations
 
@@ -128,10 +129,6 @@ class _PinnedBlock:
 class NativeObjectStore:
     """LocalObjectStore-compatible backend over the C++ arena."""
 
-    # Freed blocks are reused: the raylet must not evict/delete behind
-    # live readers' backs (see module docstring) — spill is skipped.
-    ARENA_BACKED = True
-
     def __init__(self, root: str, capacity: int = 1 << 30,
                  max_objects: int = 1 << 16):
         lib = _load()
@@ -170,9 +167,10 @@ class NativeObjectStore:
         if not off:
             raise MemoryError(
                 f"native store: cannot allocate {size} bytes for "
-                f"{object_id.hex()[:12]} — the arena is full (the native "
-                f"backend does not spill; raise object_store_memory or "
-                f"set object_store_backend='files' for spill-to-disk)")
+                f"{object_id.hex()[:12]} — the arena is full (the raylet "
+                f"spills above object_spilling_threshold, but zombie "
+                f"blocks pinned by live readers hold bytes until "
+                f"released; raise object_store_memory for headroom)")
         return _ArenaBuffer(self._mv[off:off + size], size)
 
     def seal(self, object_id: ObjectID) -> None:

@@ -147,6 +147,7 @@ class Raylet:
             "wait_object_local": self.h_wait_object_local,
             "free_objects": self.h_free_objects,
             "pin_object": self.h_pin_object,
+            "spill_now": self.h_spill_now,
             "cluster_info": self.h_cluster_info,
             "get_metrics": self.h_get_metrics,
             "set_resource": self.h_set_resource,
@@ -876,6 +877,10 @@ class Raylet:
 
     async def h_fetch_chunk(self, conn, d):
         object_id = ObjectID(d["object_id"])
+        rec = self.local_objects.get(d["object_id"])
+        if rec is not None and rec["spilled"]:
+            # spilled between the puller's object_info and this chunk
+            await self._restore_spilled(d["object_id"])
         buf = self.store.get(object_id)
         if buf is None:
             raise KeyError(f"object {object_id.hex()[:12]} not local")
@@ -883,6 +888,20 @@ class Raylet:
             return bytes(buf.view[d["offset"] : d["offset"] + d["size"]])
         finally:
             buf.close()
+
+    async def h_spill_now(self, conn, d):
+        """Synchronous spill on behalf of a worker whose store create
+        failed: move residents to disk until `need_bytes` fits (plus the
+        normal threshold), oldest first."""
+        need = int(d.get("need_bytes", 0))
+        limit = max(0, int(self.config.object_store_memory
+                           * self.config.object_spilling_threshold) - need)
+        for oid, rec in list(self.local_objects.items()):
+            if self.store_used <= limit:
+                break
+            if not rec["spilled"]:
+                await self._spill_one(oid, rec)
+        return True
 
     async def h_pin_object(self, conn, d):
         rec = self.local_objects.get(d["object_id"])
@@ -914,12 +933,13 @@ class Raylet:
 
     async def _maybe_spill(self):
         """Spill cold unpinned objects to disk above the usage threshold
-        (reference: local_object_manager.h SpillObjects)."""
-        if getattr(self.store, "ARENA_BACKED", False):
-            # Arena blocks are reused after delete; evicting behind a
-            # zero-copy reader would corrupt it. Owner-driven frees are
-            # the only deleter for the native backend.
-            return
+        (reference: local_object_manager.h SpillObjects). Safe on BOTH
+        backends: the files store copies before unlink, and the native
+        arena's delete zombifies under outstanding reader pins (store.cc
+        rts_delete) — the block is only reused after the last zero-copy
+        view releases, so spilling can never corrupt a live reader.
+        Zombie blocks do keep arena bytes busy until released, which is
+        why the threshold leaves headroom below physical capacity."""
         limit = int(self.config.object_store_memory
                     * self.config.object_spilling_threshold)
         if self.store_used <= limit:
@@ -928,21 +948,30 @@ class Raylet:
         for oid, rec in list(self.local_objects.items()):
             if self.store_used <= limit:
                 break
-            if rec["pinned"] or rec["spilled"]:
+            # reference semantics: the pin blocks EVICTION (losing the
+            # only copy), not spilling — the spill file preserves the
+            # bytes, so even owner-pinned primaries may move to disk
+            # under pressure (local_object_manager.h SpillObjects spills
+            # pinned primaries exactly the same way)
+            if rec["spilled"]:
                 continue
-            object_id = ObjectID(oid)
-            buf = self.store.get(object_id)
-            if buf is None:
-                continue
-            path = os.path.join(self.spill_dir, object_id.hex())
-            with open(path, "wb") as f:
-                f.write(buf.view)
-            buf.close()
-            self.store.delete(object_id)
-            rec["spilled"] = path
-            self.store_used -= rec["size"]
-            logger.info("spilled %s (%d bytes)", object_id.hex()[:12],
-                        rec["size"])
+            await self._spill_one(oid, rec)
+
+    async def _spill_one(self, oid: bytes, rec: dict):
+        object_id = ObjectID(oid)
+        buf = self.store.get(object_id)
+        if buf is None:
+            return
+        os.makedirs(self.spill_dir, exist_ok=True)
+        path = os.path.join(self.spill_dir, object_id.hex())
+        with open(path, "wb") as f:
+            f.write(buf.view)
+        buf.close()
+        self.store.delete(object_id)
+        rec["spilled"] = path
+        self.store_used -= rec["size"]
+        logger.info("spilled %s (%d bytes)", object_id.hex()[:12],
+                    rec["size"])
 
     async def _restore_spilled(self, oid: bytes):
         rec = self.local_objects.get(oid)
@@ -951,7 +980,23 @@ class Raylet:
         object_id = ObjectID(oid)
         with open(rec["spilled"], "rb") as f:
             data = f.read()
-        self.store.put_bytes(object_id, data)
+        try:
+            self.store.put_bytes(object_id, data)
+        except MemoryError:
+            # the store is the reason this object was spilled — push
+            # other residents out until this one fits, then retry once
+            # (bounded: spilling everything would thrash alternating
+            # restores into O(n²) disk churn)
+            target = max(
+                0, int(self.config.object_store_memory
+                       * self.config.object_spilling_threshold)
+                - rec["size"])
+            for other, orec in list(self.local_objects.items()):
+                if self.store_used <= target:
+                    break
+                if other != oid and not orec["spilled"]:
+                    await self._spill_one(other, orec)
+            self.store.put_bytes(object_id, data)
         os.unlink(rec["spilled"])
         rec["spilled"] = None
         self.store_used += rec["size"]
@@ -1137,8 +1182,9 @@ class Raylet:
                 logger.warning("heartbeat to GCS failed")
 
     async def run(self, port: int = 0, ready_file: str | None = None):
-        actual = await self.server.start_tcp(port=port)
-        self.address = f"127.0.0.1:{actual}"
+        actual = await self.server.start_tcp(
+            host=self.config.bind_host, port=port)
+        self.address = f"{self.config.node_ip_address}:{actual}"
 
         async def _gcs_session(conn):
             """(Re-)establish GCS session state: subscribe, refresh the
