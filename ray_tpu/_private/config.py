@@ -81,6 +81,16 @@ class Config:
     # --- rpc ---
     rpc_connect_timeout_s: float = 10.0
     rpc_call_timeout_s: float = 0.0  # 0 = no timeout
+    # Address this node advertises to peers (GCS/raylet/worker servers).
+    # The default keeps everything loopback-only (single machine); the
+    # cluster launcher sets each host's reachable IP, which also flips
+    # the listeners to 0.0.0.0 (reference: ray start --node-ip-address).
+    node_ip_address: str = "127.0.0.1"
+
+    @property
+    def bind_host(self) -> str:
+        return ("127.0.0.1" if self.node_ip_address == "127.0.0.1"
+                else "0.0.0.0")
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self))

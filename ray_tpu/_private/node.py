@@ -138,7 +138,7 @@ def start_gcs(session_dir: str, config: Config, port: int = 0) -> tuple[ServiceP
         cmd += ["--store-dir", os.path.join(session_dir, "gcs_store")]
     svc = _spawn(cmd, config, "gcs_server")
     actual_port = _wait_ready(ready, svc.proc, "gcs_server")
-    return svc, f"127.0.0.1:{actual_port}"
+    return svc, f"{config.node_ip_address}:{actual_port}"
 
 
 def restart_gcs(session_dir: str, config: Config,

@@ -1,0 +1,247 @@
+"""Cluster launcher: bring a multi-host cluster up from a YAML spec.
+
+The minimal `ray up` analog (reference:
+python/ray/autoscaler/_private/commands.py create_or_update_cluster,
+updater.py NodeUpdater, ray-schema.json): hosts are reached through a
+configurable command template (ssh in production, `bash -c` in tests),
+the head runs `ray-tpu start --head`, workers join it, and state lands in
+~/.ray_tpu/clusters/<name>.json for `down`/`attach`/`exec`.
+
+YAML schema (all commands run through provider.run_command):
+
+    cluster_name: my-tpu-cluster
+    provider:
+      type: hosts                  # remote machines via a command template
+      hosts: ["10.0.0.1", "10.0.0.2"]   # first entry hosts the head
+      run_command: "ssh -o StrictHostKeyChecking=no {host} -- {cmd}"
+    port: 6379                     # GCS port on the head
+    setup_commands: ["pip install -e /opt/ray_tpu"]   # every host
+    head_setup_commands: []        # head only, after setup_commands
+    head_start_command: null       # default: ray-tpu start --head ...
+    worker_start_command: null     # default: ray-tpu start --address ...
+    stop_command: "ray-tpu stop"
+    env: {}                        # prefixed as VAR=val to start commands
+
+TPU-native notes: per-host TPU slice descriptors ride `tpu_slice:` under
+a host entry (dicts instead of strings), so a pod slice's hosts register
+their ICI domain at `up` time and the slice-aware scheduler (gcs/server
+_place_bundles) sees real topology.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shlex
+import subprocess
+import time
+
+STATE_DIR = os.path.expanduser("~/.ray_tpu/clusters")
+
+_DEFAULTS = {
+    "port": 6379,
+    "setup_commands": [],
+    "head_setup_commands": [],
+    "head_start_command": None,
+    "worker_start_command": None,
+    "stop_command": "ray-tpu stop",
+    "env": {},
+}
+
+
+class LauncherError(RuntimeError):
+    pass
+
+
+def load_cluster_config(path: str) -> dict:
+    import yaml
+
+    with open(path) as f:
+        cfg = yaml.safe_load(f)
+    if not isinstance(cfg, dict):
+        raise LauncherError(f"{path}: expected a YAML mapping")
+    for key in ("cluster_name", "provider"):
+        if key not in cfg:
+            raise LauncherError(f"{path}: missing required key {key!r}")
+    provider = cfg["provider"]
+    if provider.get("type") != "hosts":
+        raise LauncherError(
+            f"unsupported provider type {provider.get('type')!r}; this "
+            "launcher drives explicit host lists (type: hosts)")
+    hosts = provider.get("hosts")
+    if not hosts:
+        raise LauncherError("provider.hosts must list at least one host "
+                            "(the first hosts the head)")
+    if "run_command" not in provider:
+        provider["run_command"] = (
+            "ssh -o StrictHostKeyChecking=no {host} -- {cmd}")
+    for key, default in _DEFAULTS.items():
+        cfg.setdefault(key, default)
+    unknown = set(cfg) - {"cluster_name", "provider", *_DEFAULTS}
+    if unknown:
+        raise LauncherError(f"unknown config keys: {sorted(unknown)}")
+    return cfg
+
+
+def _host_name(host) -> str:
+    return host["address"] if isinstance(host, dict) else host
+
+
+def _run_on(cfg: dict, host, cmd: str, timeout: float = 300.0) -> str:
+    """One command on one host through the provider template."""
+    template = cfg["provider"]["run_command"]
+    full = template.format(host=_host_name(host), cmd=shlex.quote(cmd))
+    try:
+        proc = subprocess.run(full, shell=True, capture_output=True,
+                              text=True, timeout=timeout)
+    except subprocess.TimeoutExpired as e:
+        # normalize: down() must keep tearing the REST of the cluster
+        # down when one host hangs
+        raise LauncherError(
+            f"command timed out after {timeout}s on "
+            f"{_host_name(host)}: {cmd}") from e
+    if proc.returncode != 0:
+        raise LauncherError(
+            f"command failed on {_host_name(host)} "
+            f"(exit {proc.returncode}): {cmd}\n{proc.stderr[-2000:]}")
+    return proc.stdout
+
+
+def _start_env(cfg: dict, host) -> str:
+    env = dict(cfg.get("env") or {})
+    ip = _host_name(host)
+    sysconf = {"node_ip_address": ip}
+    env["RAY_TPU_SYSTEM_CONFIG"] = json.dumps(sysconf)
+    return " ".join(f"{k}={shlex.quote(str(v))}" for k, v in env.items())
+
+
+def _state_path(name: str) -> str:
+    return os.path.join(STATE_DIR, f"{name}.json")
+
+
+def _save_state(cfg: dict, state: dict):
+    os.makedirs(STATE_DIR, exist_ok=True)
+    with open(_state_path(cfg["cluster_name"]), "w") as f:
+        json.dump(state, f, indent=1)
+
+
+def load_state(name: str) -> dict | None:
+    try:
+        with open(_state_path(name)) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def up(config_path: str) -> dict:
+    """Bring the cluster up: setup + head, then workers join in order
+    (reference: commands.py get_or_create_head_node + NodeUpdater.run)."""
+    cfg = load_cluster_config(config_path)
+    hosts = cfg["provider"]["hosts"]
+    head, workers = hosts[0], hosts[1:]
+    port = cfg["port"]
+
+    for cmd in cfg["setup_commands"] + cfg["head_setup_commands"]:
+        _run_on(cfg, head, cmd)
+
+    head_cmd = (cfg["head_start_command"]
+                or "ray-tpu start --head --port {port}").format(port=port)
+    extra = _host_extra_args(head)
+    out = _run_on(cfg, head, f"{_start_env(cfg, head)} {head_cmd}{extra}")
+    gcs_address = _parse_gcs_address(out, _host_name(head), port)
+
+    started = [{"host": _host_name(head), "role": "head"}]
+    for w in workers:
+        for cmd in cfg["setup_commands"]:
+            _run_on(cfg, w, cmd)
+        worker_cmd = (cfg["worker_start_command"]
+                      or "ray-tpu start --address {gcs_address}").format(
+            gcs_address=gcs_address, port=port)
+        _run_on(cfg, w,
+                f"{_start_env(cfg, w)} {worker_cmd}{_host_extra_args(w)}")
+        started.append({"host": _host_name(w), "role": "worker"})
+
+    state = {"cluster_name": cfg["cluster_name"], "config": cfg,
+             "gcs_address": gcs_address, "nodes": started,
+             "up_time": time.strftime("%Y-%m-%d %H:%M:%S")}
+    _save_state(cfg, state)
+    return state
+
+
+def _host_extra_args(host) -> str:
+    """Per-host overrides from dict-form host entries: resources,
+    num_cpus, and the TPU slice descriptor."""
+    if not isinstance(host, dict):
+        return ""
+    parts = []
+    if host.get("num_cpus") is not None:
+        parts.append(f"--num-cpus {host['num_cpus']}")
+    if host.get("resources"):
+        parts.append(
+            f"--resources {shlex.quote(json.dumps(host['resources']))}")
+    if host.get("tpu_slice"):
+        parts.append(
+            f"--tpu-slice {shlex.quote(json.dumps(host['tpu_slice']))}")
+    return (" " + " ".join(parts)) if parts else ""
+
+
+def _parse_gcs_address(output: str, head_host: str, port: int) -> str:
+    for line in output.splitlines():
+        if line.startswith("GCS address:"):
+            addr = line.split(":", 1)[1].strip()
+            # the head prints its advertised address; substitute the
+            # provider's route to it if the head only knows loopback
+            if addr.startswith("127.0.0.1") and head_host != "127.0.0.1":
+                return f"{head_host}:{addr.rsplit(':', 1)[1]}"
+            return addr
+    return f"{head_host}:{port}"
+
+
+def down(name_or_path: str) -> int:
+    """Stop every node (workers first, head last)."""
+    state = _resolve_state(name_or_path)
+    cfg = state["config"]
+    stop = cfg["stop_command"]
+    errors = 0
+    for node in reversed(state["nodes"]):
+        try:
+            _run_on(cfg, node["host"], stop)
+        except LauncherError:
+            errors += 1
+    try:
+        os.unlink(_state_path(state["cluster_name"]))
+    except OSError:
+        pass
+    return errors
+
+
+def attach_command(name_or_path: str) -> str:
+    """The shell command that opens an interactive session on the head
+    (printed, not exec'd, so the CLI stays testable)."""
+    state = _resolve_state(name_or_path)
+    cfg = state["config"]
+    head = state["nodes"][0]["host"]
+    template = cfg["provider"]["run_command"]
+    return template.format(host=head, cmd=shlex.quote(
+        f"RAY_TPU_ADDRESS={state['gcs_address']} exec $SHELL -i"))
+
+
+def exec_on_head(name_or_path: str, cmd: str) -> str:
+    state = _resolve_state(name_or_path)
+    cfg = state["config"]
+    env = f"export RAY_TPU_ADDRESS={shlex.quote(state['gcs_address'])};"
+    return _run_on(cfg, state["nodes"][0]["host"], f"{env} {cmd}")
+
+
+def _resolve_state(name_or_path: str) -> dict:
+    if os.path.exists(name_or_path) and name_or_path.endswith(
+            (".yaml", ".yml")):
+        name = load_cluster_config(name_or_path)["cluster_name"]
+    else:
+        name = name_or_path
+    state = load_state(name)
+    if state is None:
+        raise LauncherError(
+            f"no launcher state for cluster {name!r} (was it `up`ed from "
+            "this machine?)")
+    return state

@@ -961,9 +961,11 @@ class GcsServer:
             await self._remove_node(node_id, reason="raylet disconnected")
 
     async def run(self, port: int, ready_file: str | None = None):
-        actual = await self.server.start_tcp(port=port)
+        cfg = get_config()
+        actual = await self.server.start_tcp(host=cfg.bind_host, port=port)
         asyncio.create_task(self.heartbeat_checker())
-        logger.info("GCS listening on 127.0.0.1:%d", actual)
+        logger.info("GCS listening on %s:%d (advertised %s)",
+                    cfg.bind_host, actual, cfg.node_ip_address)
         if ready_file:
             tmp = ready_file + ".tmp"
             with open(tmp, "w") as f:

@@ -91,6 +91,8 @@ def cmd_start(args) -> int:
         session_dir, gcs_address, config,
         num_cpus=args.num_cpus, num_tpus=args.num_tpus or 0,
         resources=json.loads(args.resources) if args.resources else None,
+        tpu_slice=(json.loads(args.tpu_slice)
+                   if getattr(args, "tpu_slice", None) else None),
         is_head=args.head)
     pids.append(raylet_svc.proc.pid)
 
@@ -335,6 +337,48 @@ def cmd_dashboard(args) -> int:
     return 0
 
 
+def cmd_up(args) -> int:
+    from ray_tpu.autoscaler import launcher
+
+    state = launcher.up(args.config)
+    print(f"cluster {state['cluster_name']!r} up: "
+          f"{len(state['nodes'])} nodes")
+    print(f"GCS address: {state['gcs_address']}")
+    print(f"attach with: ray-tpu attach {state['cluster_name']}")
+    return 0
+
+
+def cmd_down(args) -> int:
+    from ray_tpu.autoscaler import launcher
+
+    errors = launcher.down(args.cluster)
+    if errors:
+        print(f"warning: {errors} node(s) failed to stop cleanly",
+              file=sys.stderr)
+    print("cluster down")
+    return 1 if errors else 0
+
+
+def cmd_attach(args) -> int:
+    from ray_tpu.autoscaler import launcher
+
+    cmdline = launcher.attach_command(args.cluster)
+    if args.print_only:
+        print(cmdline)
+        return 0
+    import subprocess
+
+    return subprocess.call(cmdline, shell=True)
+
+
+def cmd_exec(args) -> int:
+    from ray_tpu.autoscaler import launcher
+
+    out = launcher.exec_on_head(args.cluster, args.command)
+    print(out, end="")
+    return 0
+
+
 def cmd_microbenchmark(args) -> int:
     from ray_tpu import microbenchmark
 
@@ -359,6 +403,9 @@ def main(argv=None) -> int:
     p.add_argument("--num-cpus", type=float, default=None)
     p.add_argument("--num-tpus", type=float, default=None)
     p.add_argument("--resources", help="JSON dict of custom resources")
+    p.add_argument("--tpu-slice",
+                   help="JSON TpuSliceDescriptor for this host's ICI "
+                        "domain (util/accelerators.py)")
     p.add_argument("--system-config", help="JSON dict of config overrides")
     p.add_argument("--client-server-port", type=int, default=None,
                    help="also serve ray-client connections on this port")
@@ -404,6 +451,25 @@ def main(argv=None) -> int:
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=8265)
     p.set_defaults(fn=cmd_dashboard)
+
+    p = sub.add_parser("up", help="launch a cluster from a YAML spec")
+    p.add_argument("config", help="cluster YAML (see autoscaler/launcher.py)")
+    p.set_defaults(fn=cmd_up)
+
+    p = sub.add_parser("down", help="stop a launched cluster")
+    p.add_argument("cluster", help="cluster name or YAML path")
+    p.set_defaults(fn=cmd_down)
+
+    p = sub.add_parser("attach", help="open a shell on the head node")
+    p.add_argument("cluster", help="cluster name or YAML path")
+    p.add_argument("--print-only", action="store_true",
+                   help="print the attach command instead of exec'ing it")
+    p.set_defaults(fn=cmd_attach)
+
+    p = sub.add_parser("exec", help="run a command on the head node")
+    p.add_argument("cluster", help="cluster name or YAML path")
+    p.add_argument("command")
+    p.set_defaults(fn=cmd_exec)
 
     p = sub.add_parser("microbenchmark", help="run the core benchmark suite")
     p.add_argument("--out", default=None)
