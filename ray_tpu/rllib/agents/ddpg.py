@@ -1,0 +1,278 @@
+"""DDPG and TD3 (reference: rllib/agents/ddpg/ddpg.py + td3.py +
+ddpg_torch_policy.py): off-policy continuous control with a deterministic
+tanh actor, Q critic(s), polyak target networks, and Gaussian action
+noise for exploration. TD3 is DDPG with its three fixes flipped on
+(exactly how the reference's td3.py subclasses ddpg.py):
+
+    twin_q               — min over two critics kills Q overestimation
+    policy_delay         — actor (and targets) update every d critic steps
+    smooth_target_policy — clipped noise on the target action
+
+One fused jitted update does critic + (conditionally, via lax.cond)
+actor + polyak steps with donated state, so the learner step is a single
+device dispatch, DQN-family style."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ray_tpu.rllib.agents.trainer import Trainer
+from ray_tpu.rllib.execution.replay_buffer import ReplayBuffer
+from ray_tpu.rllib.policy.jax_policy import _mlp_apply, _mlp_init
+from ray_tpu.rllib.policy.policy import Policy
+from ray_tpu.rllib.policy.sample_batch import SampleBatch
+
+DDPG_CONFIG: dict = {
+    "rollout_fragment_length": 64,
+    "learning_starts": 500,
+    "buffer_size": 100_000,
+    "train_batch_size": 128,
+    "sgd_iters_per_step": 32,
+    "gamma": 0.99,
+    "tau": 0.005,
+    "actor_lr": 1e-3,
+    "critic_lr": 1e-3,
+    "exploration_noise": 0.1,     # sigma of behavior noise (action scale)
+    "fcnet_hiddens": [64, 64],
+    # TD3 switches (reference: agents/ddpg/td3.py TD3_DEFAULT_CONFIG)
+    "twin_q": False,
+    "policy_delay": 1,
+    "smooth_target_policy": False,
+    "target_noise": 0.2,
+    "target_noise_clip": 0.5,
+}
+
+TD3_CONFIG: dict = {**DDPG_CONFIG, "twin_q": True, "policy_delay": 2,
+                    "smooth_target_policy": True}
+
+
+class DDPGPolicy(Policy):
+    """Deterministic actor μ(s)=tanh(mlp) in [-1,1] + Q critic(s)."""
+
+    def __init__(self, observation_space, action_space, config: dict):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        merged = {**DDPG_CONFIG, **config}
+        super().__init__(observation_space, action_space, merged)
+        if hasattr(action_space, "n"):
+            raise ValueError("DDPG/TD3 are continuous-control only; use "
+                             "DQN for discrete actions")
+        self.discrete = False
+        obs_dim = int(np.prod(observation_space.shape))
+        act_dim = int(np.prod(action_space.shape))
+        self._act_scale = (action_space.high - action_space.low) / 2.0
+        self._act_mid = (action_space.high + action_space.low) / 2.0
+        hiddens = list(merged.get("fcnet_hiddens", [64, 64]))
+        seed = merged.get("seed") or 0
+        keys = jax.random.split(jax.random.key(seed), 3)
+        q_sizes = [obs_dim + act_dim] + hiddens + [1]
+        params = {
+            "pi": _mlp_init(keys[0], [obs_dim] + hiddens + [act_dim]),
+            "q1": _mlp_init(keys[1], q_sizes),
+        }
+        if merged["twin_q"]:
+            params["q2"] = _mlp_init(keys[2], q_sizes)
+        self.params = params
+        self.target = jax.tree.map(lambda x: x, params)
+        self._optimizer = optax.multi_transform(
+            {"pi": optax.adam(merged["actor_lr"]),
+             "q": optax.adam(merged["critic_lr"])},
+            lambda p: {k: jax.tree.map(
+                lambda _: "pi" if k == "pi" else "q", v)
+                for k, v in p.items()})
+        self.opt_state = self._optimizer.init(self.params)
+        self._rng = jax.random.key(seed + 1)
+        self._step_count = 0
+        self._noise = merged["exploration_noise"]
+        self._build()
+
+    @staticmethod
+    def _mu(params, obs):
+        import jax.numpy as jnp
+
+        return jnp.tanh(_mlp_apply(params["pi"], obs))
+
+    @staticmethod
+    def _q(params_q, obs, act):
+        import jax.numpy as jnp
+
+        return _mlp_apply(params_q, jnp.concatenate([obs, act], -1))[:, 0]
+
+    def _build(self):
+        import jax
+        import jax.numpy as jnp
+
+        cfg = self.config
+        gamma, tau = cfg["gamma"], cfg["tau"]
+        twin = cfg["twin_q"]
+        delay = int(cfg["policy_delay"])
+        smooth = cfg["smooth_target_policy"]
+        t_noise, t_clip = cfg["target_noise"], cfg["target_noise_clip"]
+        optimizer = self._optimizer
+
+        def q_target(target, nxt, rewards, dones, key):
+            a2 = DDPGPolicy._mu(target, nxt)
+            if smooth:
+                eps = jnp.clip(
+                    t_noise * jax.random.normal(key, a2.shape),
+                    -t_clip, t_clip)
+                a2 = jnp.clip(a2 + eps, -1.0, 1.0)
+            qn = DDPGPolicy._q(target["q1"], nxt, a2)
+            if twin:
+                qn = jnp.minimum(qn, DDPGPolicy._q(target["q2"], nxt, a2))
+            return rewards + gamma * (1.0 - dones) * qn
+
+        def critic_loss(params, target, batch, key):
+            backup = jax.lax.stop_gradient(q_target(
+                target, batch["new_obs"], batch["rewards"],
+                batch["dones"], key))
+            q1 = DDPGPolicy._q(params["q1"], batch["obs"],
+                               batch["actions"])
+            loss = ((q1 - backup) ** 2).mean()
+            if twin:
+                q2 = DDPGPolicy._q(params["q2"], batch["obs"],
+                                   batch["actions"])
+                loss = loss + ((q2 - backup) ** 2).mean()
+            return loss
+
+        def actor_loss(params, batch):
+            a = DDPGPolicy._mu(params, batch["obs"])
+            frozen_q = jax.lax.stop_gradient(params["q1"])
+            return -DDPGPolicy._q(frozen_q, batch["obs"], a).mean()
+
+        def loss_fn(params, target, batch, key, do_actor):
+            c = critic_loss(params, target, batch, key)
+            # delayed actor: multiply by the 0/1 gate instead of cond so
+            # the grad structure is static (lax.cond over grads of a
+            # subtree changes pytree shape)
+            a = actor_loss(params, batch) * do_actor
+            return c + a, {"critic_loss": c, "actor_loss": a}
+
+        @jax.jit
+        def update(params, target, opt_state, batch, key, do_actor):
+            (loss, metrics), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, target, batch, key,
+                                       do_actor)
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            params = jax.tree.map(lambda p, u: p + u, params, updates)
+            # polyak only on actor-update steps (TD3 pairs them)
+            target = jax.tree.map(
+                lambda t, p: (1 - tau * do_actor) * t
+                + tau * do_actor * p, target, params)
+            return params, target, opt_state, loss, metrics
+
+        @jax.jit
+        def act(params, obs, key, sigma):
+            a = DDPGPolicy._mu(params, obs)
+            return jnp.clip(
+                a + sigma * jax.random.normal(key, a.shape), -1.0, 1.0)
+
+        @jax.jit
+        def act_greedy(params, obs):
+            return DDPGPolicy._mu(params, obs)
+
+        self._update = update
+        self._act = act
+        self._act_greedy = act_greedy
+        self._delay = delay
+
+    # -- Policy surface --------------------------------------------------
+
+    def compute_actions(self, obs_batch, explore: bool = True):
+        import jax
+        import jax.numpy as jnp
+
+        obs = jnp.asarray(obs_batch, jnp.float32).reshape(
+            len(obs_batch), -1)
+        if explore:
+            self._rng, sub = jax.random.split(self._rng)
+            act = self._act(self.params, obs, sub, self._noise)
+        else:
+            act = self._act_greedy(self.params, obs)
+        scaled = np.asarray(act) * self._act_scale + self._act_mid
+        return scaled, {SampleBatch.ACTION_LOGP: np.zeros(len(obs_batch)),
+                        SampleBatch.VF_PREDS: np.zeros(len(obs_batch))}
+
+    def postprocess_trajectory(self, batch, other_agent_batches=None,
+                               episode=None):
+        return batch
+
+    def learn_on_batch(self, batch: SampleBatch) -> dict:
+        import jax
+        import jax.numpy as jnp
+
+        norm_act = ((batch[SampleBatch.ACTIONS] - self._act_mid)
+                    / self._act_scale)
+        jb = {
+            "obs": jnp.asarray(batch[SampleBatch.OBS], jnp.float32),
+            "new_obs": jnp.asarray(batch[SampleBatch.NEXT_OBS],
+                                   jnp.float32),
+            "actions": jnp.asarray(np.clip(norm_act, -1.0, 1.0),
+                                   jnp.float32),
+            "rewards": jnp.asarray(batch[SampleBatch.REWARDS],
+                                   jnp.float32),
+            "dones": jnp.asarray(batch[SampleBatch.DONES], jnp.float32),
+        }
+        self._step_count += 1
+        do_actor = jnp.float32(self._step_count % self._delay == 0)
+        self._rng, sub = jax.random.split(self._rng)
+        (self.params, self.target, self.opt_state, loss,
+         metrics) = self._update(self.params, self.target, self.opt_state,
+                                 jb, sub, do_actor)
+        out = {"total_loss": float(loss)}
+        out.update({k: float(v) for k, v in metrics.items()})
+        return out
+
+    def get_weights(self):
+        import jax
+
+        return {"params": jax.tree.map(np.asarray, self.params),
+                "target": jax.tree.map(np.asarray, self.target)}
+
+    def set_weights(self, weights):
+        import jax
+        import jax.numpy as jnp
+
+        self.params = jax.tree.map(jnp.asarray, weights["params"])
+        self.target = jax.tree.map(jnp.asarray, weights["target"])
+
+
+class DDPGTrainer(Trainer):
+    """reference: rllib/agents/ddpg/ddpg.py execution plan — store →
+    replay → fused update, the DQN-family shape."""
+
+    _default_config = DDPG_CONFIG
+    _name = "DDPG"
+
+    @staticmethod
+    def policy_builder(obs_space, action_space, config):
+        return DDPGPolicy(obs_space, action_space, config)
+
+    def setup(self, config):
+        super().setup(config)
+        self._buffer = ReplayBuffer(config["buffer_size"],
+                                    seed=config.get("seed"))
+
+    def train_step(self) -> dict:
+        config = self.config
+        batch = self.workers.sample(config["rollout_fragment_length"])
+        self._buffer.add_batch(batch)
+        metrics: dict = {"buffer_size": len(self._buffer)}
+        if len(self._buffer) >= config["learning_starts"]:
+            policy = self.workers.local_worker.policy
+            for _ in range(config["sgd_iters_per_step"]):
+                replay = self._buffer.sample(config["train_batch_size"])
+                metrics.update(policy.learn_on_batch(replay))
+            self.workers.sync_weights()
+        metrics["num_env_steps_sampled"] = len(batch)
+        return metrics
+
+
+class TD3Trainer(DDPGTrainer):
+    """reference: rllib/agents/ddpg/td3.py — DDPG defaults with the three
+    TD3 fixes enabled."""
+
+    _default_config = TD3_CONFIG
+    _name = "TD3"

@@ -80,3 +80,17 @@ def pg_train_step(workers, config) -> dict:
 
 
 PGTrainer = build_trainer("PG", PG_CONFIG, PGPolicy, pg_train_step)
+
+
+def _recurrent_pg_policy(obs_space, action_space, config):
+    from ray_tpu.rllib.policy.recurrent_policy import RecurrentPGPolicy
+
+    return RecurrentPGPolicy(obs_space, action_space,
+                             {**PG_CONFIG, **config})
+
+
+# LSTM actor-critic for partially-observable envs (reference:
+# models/tf/recurrent_net.py + any use_lstm=True agent); same execution
+# plan as PG — whole-batch updates keep sequences intact.
+RecurrentPGTrainer = build_trainer("RecurrentPG", PG_CONFIG,
+                                   _recurrent_pg_policy, pg_train_step)

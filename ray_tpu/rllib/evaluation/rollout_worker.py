@@ -39,6 +39,14 @@ class RolloutWorker:
         builder = cloudpickle.loads(policy_builder)
         self.policy = builder(self.envs[0].observation_space,
                               self.envs[0].action_space, self.config)
+        # recurrent policies thread (h, c) per env across steps and
+        # fragments (reference: rollout_worker's state_in/state_out cols)
+        self._is_recurrent = getattr(self.policy, "is_recurrent", False)
+        if self._is_recurrent:
+            self._states = [
+                [s.copy() for s in self.policy.get_initial_state()]
+                for _ in range(num_envs)]
+        self._unroll_counter = worker_index * 10_000_000
         # offline IO (reference: rollout_worker.py input_creator/
         # output_creator wiring of rllib/offline/)
         self._output_writer = None
@@ -64,17 +72,34 @@ class RolloutWorker:
         horizon = num_steps or self.config.get("rollout_fragment_length",
                                                200)
         n = len(self.envs)
+        cols_keys = [
+            SampleBatch.OBS, SampleBatch.ACTIONS, SampleBatch.REWARDS,
+            SampleBatch.DONES, SampleBatch.NEXT_OBS, SampleBatch.EPS_ID,
+            SampleBatch.ACTION_LOGP, SampleBatch.VF_PREDS]
+        if self._is_recurrent:
+            from ray_tpu.rllib.policy.recurrent_policy import (STATE_C,
+                                                               STATE_H,
+                                                               UNROLL_ID)
+
+            cols_keys += [STATE_H, STATE_C, UNROLL_ID]
+            unroll_ids = []
+            for _ in range(n):
+                unroll_ids.append(self._unroll_counter)
+                self._unroll_counter += 1
         per_env: list[dict[str, list]] = [
-            {k: [] for k in (
-                SampleBatch.OBS, SampleBatch.ACTIONS, SampleBatch.REWARDS,
-                SampleBatch.DONES, SampleBatch.NEXT_OBS, SampleBatch.EPS_ID,
-                SampleBatch.ACTION_LOGP, SampleBatch.VF_PREDS)}
-            for _ in range(n)]
+            {k: [] for k in cols_keys} for _ in range(n)]
         steps = 0
         while steps < horizon:
             obs_batch = np.stack([np.asarray(o, np.float32).ravel()
                                   for o in self._obs])
-            actions, extra = self.policy.compute_actions(obs_batch)
+            if self._is_recurrent:
+                state_in = [np.stack([s[j] for s in self._states])
+                            for j in range(2)]
+                actions, extra, state_out = (
+                    self.policy.compute_actions_with_state(
+                        obs_batch, state_in))
+            else:
+                actions, extra = self.policy.compute_actions(obs_batch)
             for i, env in enumerate(self.envs):
                 act = actions[i]
                 if not self.policy.discrete:
@@ -95,6 +120,11 @@ class RolloutWorker:
                     extra[SampleBatch.ACTION_LOGP][i])
                 cols[SampleBatch.VF_PREDS].append(
                     extra[SampleBatch.VF_PREDS][i])
+                if self._is_recurrent:
+                    cols[STATE_H].append(state_in[0][i])
+                    cols[STATE_C].append(state_in[1][i])
+                    cols[UNROLL_ID].append(unroll_ids[i])
+                    self._states[i] = [state_out[0][i], state_out[1][i]]
                 self._episode_rewards[i] += float(reward)
                 self._episode_lengths[i] += 1
                 if terminated or truncated:
@@ -104,6 +134,10 @@ class RolloutWorker:
                     self._episode_lengths[i] = 0
                     self._eps_ids[i] = self._next_eps
                     self._next_eps += 1
+                    if self._is_recurrent:
+                        self._states[i] = [
+                            s.copy()
+                            for s in self.policy.get_initial_state()]
                     next_obs, _ = env.reset()
                 self._obs[i] = next_obs
                 steps += 1

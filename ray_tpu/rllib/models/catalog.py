@@ -18,6 +18,10 @@ MODEL_DEFAULTS: dict = {
     "fcnet_activation": "tanh",
     "conv_filters": [(16, 4, 2), (32, 4, 2), (64, 3, 1)],  # (out, k, stride)
     "conv_activation": "relu",
+    # recurrent wrapper (reference: models/tf/recurrent_net.py LSTMWrapper)
+    "use_lstm": False,
+    "lstm_cell_size": 64,
+    "max_seq_len": 20,
 }
 
 _ACTS = {"tanh": jnp.tanh, "relu": jax.nn.relu,
@@ -40,6 +44,26 @@ def _fc_apply(params, x, act, final_linear=True):
         if i < len(params) - 1 or not final_linear:
             x = act(x)
     return x
+
+
+def _lstm_init(key, in_dim, cell):
+    k1, k2 = jax.random.split(key)
+    b = jnp.zeros(4 * cell)
+    # forget-gate bias 1.0: the standard keep-memory-early init
+    b = b.at[cell:2 * cell].set(1.0)
+    return {"wx": jax.random.normal(k1, (in_dim, 4 * cell))
+            / math.sqrt(in_dim),
+            "wh": jax.random.normal(k2, (cell, 4 * cell))
+            / math.sqrt(cell),
+            "b": b}
+
+
+def _lstm_step(p, x, h, c):
+    z = x @ p["wx"] + h @ p["wh"] + p["b"]
+    i, f, g, o = jnp.split(z, 4, axis=-1)
+    c = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+    h = jax.nn.sigmoid(o) * jnp.tanh(c)
+    return h, c
 
 
 class ModelCatalog:
@@ -71,6 +95,61 @@ class ModelCatalog:
             return _fc_apply(params["fc"], x, act)
 
         return init, apply
+
+    # -- recurrent (reference: models/tf/recurrent_net.py LSTMWrapper) ---
+
+    @staticmethod
+    def get_recurrent_model(obs_space, num_outputs: int,
+                            config: dict | None = None):
+        """fc encoder → LSTM → linear head, for partially-observable
+        envs. Returns (init, step, seq, cell_size):
+
+            init(key) -> params
+            step(params, obs[B, D], (h, c))   -> (out[B, O], (h, c))
+            seq(params, obs[B, T, D], (h0, c0), resets[B, T])
+                -> (out[B, T, O], (h, c))     # lax.scan over time;
+                                              # resets=1 zeroes the state
+                                              # BEFORE consuming that step
+                                              # (episode boundary)
+        """
+        cfg = ModelCatalog.get_model_config(config)
+        obs_dim = int(np.prod(obs_space.shape))
+        cell = int(cfg["lstm_cell_size"])
+        enc_sizes = [obs_dim] + list(cfg["fcnet_hiddens"])
+        act = _ACTS[cfg["fcnet_activation"]]
+
+        def init(key):
+            k1, k2, k3 = jax.random.split(key, 3)
+            return {"enc": _fc_init(k1, enc_sizes),
+                    "lstm": _lstm_init(k2, enc_sizes[-1], cell),
+                    "head": _fc_init(k3, [cell, num_outputs])}
+
+        def _encode(params, obs):
+            return _fc_apply(params["enc"], obs, act, final_linear=False)
+
+        def step(params, obs, state):
+            h, c = state
+            x = _encode(params, obs.reshape(obs.shape[0], -1))
+            h, c = _lstm_step(params["lstm"], x, h, c)
+            return _fc_apply(params["head"], h, act), (h, c)
+
+        def seq(params, obs, state, resets):
+            x = _encode(params, obs)          # [B, T, enc]
+            xt = jnp.swapaxes(x, 0, 1)        # [T, B, enc]
+            rt = jnp.swapaxes(resets, 0, 1)   # [T, B]
+
+            def body(carry, inp):
+                h, c = carry
+                xi, ri = inp
+                keep = (1.0 - ri)[:, None]
+                h, c = _lstm_step(params["lstm"], xi, h * keep, c * keep)
+                return (h, c), h
+
+            state, hs = jax.lax.scan(body, state, (xt, rt))
+            out = _fc_apply(params["head"], jnp.swapaxes(hs, 0, 1), act)
+            return out, state
+
+        return init, step, seq, cell
 
     # -- visionnet (reference: models/catalog.py vision path) ------------
 

@@ -14,10 +14,19 @@ import numpy as np
 
 
 class Policy:
+    # Recurrent policies set True, implement get_initial_state() and
+    # compute_actions_with_state(); the RolloutWorker threads (h, c)
+    # per env (reference: policy/policy.py is_recurrent /
+    # get_initial_state)
+    is_recurrent = False
+
     def __init__(self, observation_space, action_space, config: dict):
         self.observation_space = observation_space
         self.action_space = action_space
         self.config = config
+
+    def get_initial_state(self) -> list:
+        return []
 
     def compute_actions(self, obs_batch: np.ndarray, explore: bool = True,
                         ) -> tuple[np.ndarray, dict]:

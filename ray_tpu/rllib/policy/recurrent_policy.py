@@ -1,0 +1,285 @@
+"""Recurrent (LSTM) actor-critic policy — unlocks partially-observable
+envs (reference: rllib/models/tf/recurrent_net.py LSTMWrapper +
+policy/rnn_sequencing.py chop_into_sequences).
+
+Rollout side: the policy is stateful per env — compute_actions_with_state
+threads (h, c) and the RolloutWorker records each step's INPUT state plus
+an unroll id. Learn side: rows are regrouped into their original unrolls
+(rnn_sequencing's job), chopped to max_seq_len, padded + masked, and the
+whole update runs as one jitted lax.scan over time with episode-boundary
+resets — truncated BPTT initialized from the sampled states."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from ray_tpu.rllib.models.catalog import ModelCatalog
+from ray_tpu.rllib.policy.jax_policy import (categorical_entropy,
+                                             categorical_logp,
+                                             gaussian_entropy,
+                                             gaussian_logp)
+from ray_tpu.rllib.policy.policy import Policy
+from ray_tpu.rllib.policy.sample_batch import SampleBatch
+
+RECURRENT_DEFAULTS = {
+    "lr": 1e-3,
+    "gamma": 0.99,
+    "vf_loss_coeff": 0.5,
+    "entropy_coeff": 0.01,
+    "lstm_cell_size": 64,
+    "max_seq_len": 20,
+    "fcnet_hiddens": [64],
+}
+
+# extra sample columns (reference: rnn_sequencing "state_in_0"... cols)
+STATE_H = "state_in_h"
+STATE_C = "state_in_c"
+UNROLL_ID = "unroll_id"
+
+
+class RecurrentPGPolicy(Policy):
+    """LSTM actor-critic trained with an advantage policy gradient
+    (A2C-style: whole-batch update, no sequence-breaking minibatches)."""
+
+    is_recurrent = True
+
+    def __init__(self, observation_space, action_space, config: dict):
+        import jax
+        import optax
+
+        merged = {**RECURRENT_DEFAULTS, **config}
+        super().__init__(observation_space, action_space, merged)
+        self.discrete = hasattr(action_space, "n")
+        if self.discrete:
+            act_out = int(action_space.n)
+        else:
+            act_out = 2 * int(np.prod(action_space.shape))
+        # one trunk, two outputs: [pi_out | value]
+        self._act_out = act_out
+        init, step, seq, cell = ModelCatalog.get_recurrent_model(
+            observation_space, act_out + 1, merged)
+        self._step_fn = jax.jit(step)
+        self._seq_fn = seq
+        self.cell_size = cell
+        seed = merged.get("seed") or 0
+        self.params = init(jax.random.key(seed))
+        self._optimizer = optax.adam(merged["lr"])
+        self.opt_state = self._optimizer.init(self.params)
+        self._rng = jax.random.key(seed + 1)
+        self._build()
+
+    def get_initial_state(self) -> list[np.ndarray]:
+        return [np.zeros(self.cell_size, np.float32),
+                np.zeros(self.cell_size, np.float32)]
+
+    # -- acting ----------------------------------------------------------
+
+    def _split_out(self, out):
+        import jax.numpy as jnp
+
+        return out[..., :self._act_out], out[..., -1]
+
+    def _build(self):
+        import jax
+        import jax.numpy as jnp
+
+        discrete = self.discrete
+        step = self._step_fn
+        seq = self._seq_fn
+        vf_coeff = self.config["vf_loss_coeff"]
+        ent_coeff = self.config["entropy_coeff"]
+        optimizer = self._optimizer
+        act_out_n = self._act_out
+
+        @jax.jit
+        def act(params, obs, h, c, rng):
+            out, (h2, c2) = step(params, obs, (h, c))
+            pi_out, vf = out[..., :act_out_n], out[..., -1]
+            rng, sub = jax.random.split(rng)
+            if discrete:
+                actions = jax.random.categorical(sub, pi_out, axis=-1)
+                logp = categorical_logp(pi_out, actions)
+            else:
+                mean, log_std = jnp.split(pi_out, 2, axis=-1)
+                actions = mean + jnp.exp(log_std) * jax.random.normal(
+                    sub, mean.shape)
+                logp = gaussian_logp(pi_out, actions)
+            return actions, logp, vf, h2, c2, rng
+
+        @jax.jit
+        def act_greedy(params, obs, h, c):
+            out, (h2, c2) = step(params, obs, (h, c))
+            pi_out, vf = out[..., :act_out_n], out[..., -1]
+            if discrete:
+                actions = jnp.argmax(pi_out, axis=-1)
+            else:
+                actions, _ = jnp.split(pi_out, 2, axis=-1)
+            return actions, vf, h2, c2
+
+        def loss_fn(params, batch):
+            # batch: obs [S,T,D], resets [S,T], mask [S,T], h0/c0 [S,cell]
+            out, _ = seq(params, batch["obs"], (batch["h0"], batch["c0"]),
+                         batch["resets"])
+            pi_out, values = out[..., :act_out_n], out[..., -1]
+            flat_pi = pi_out.reshape(-1, pi_out.shape[-1])
+            flat_act = batch["actions"].reshape(
+                -1, *batch["actions"].shape[2:])
+            if discrete:
+                logp = categorical_logp(flat_pi, flat_act.reshape(-1))
+                entropy = categorical_entropy(flat_pi)
+            else:
+                logp = gaussian_logp(flat_pi, flat_act)
+                entropy = gaussian_entropy(flat_pi)
+            mask = batch["mask"].reshape(-1)
+            n = jnp.maximum(mask.sum(), 1.0)
+            returns = batch["returns"].reshape(-1)
+            adv = returns - jax.lax.stop_gradient(values.reshape(-1))
+            adv = (adv - (adv * mask).sum() / n) * mask
+            pi_loss = -(logp * jax.lax.stop_gradient(adv) * mask).sum() / n
+            vf_loss = (((values.reshape(-1) - returns) ** 2) * mask
+                       ).sum() / n
+            ent = (entropy * mask).sum() / n
+            total = pi_loss + vf_coeff * vf_loss - ent_coeff * ent
+            return total, {"policy_loss": pi_loss, "vf_loss": vf_loss,
+                           "entropy": ent}
+
+        @jax.jit
+        def sgd_step(params, opt_state, batch):
+            (loss, metrics), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, batch)
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            params = jax.tree.map(lambda p, u: p + u, params, updates)
+            return params, opt_state, loss, metrics
+
+        self._act = act
+        self._act_greedy = act_greedy
+        self._sgd_step = sgd_step
+
+    def compute_actions_with_state(self, obs_batch, states,
+                                   explore: bool = True):
+        import jax.numpy as jnp
+
+        obs = jnp.asarray(obs_batch, jnp.float32).reshape(
+            len(obs_batch), -1)
+        h = jnp.asarray(states[0], jnp.float32)
+        c = jnp.asarray(states[1], jnp.float32)
+        if explore:
+            actions, logp, vf, h2, c2, self._rng = self._act(
+                self.params, obs, h, c, self._rng)
+        else:
+            actions, vf, h2, c2 = self._act_greedy(self.params, obs, h, c)
+            logp = np.zeros(len(obs_batch))
+        extra = {SampleBatch.ACTION_LOGP: np.asarray(logp),
+                 SampleBatch.VF_PREDS: np.asarray(vf)}
+        return (np.asarray(actions), extra,
+                [np.asarray(h2), np.asarray(c2)])
+
+    def compute_actions(self, obs_batch, explore: bool = True):
+        # stateless call (evaluate() greedy loops): zero state per call
+        h = np.zeros((len(obs_batch), self.cell_size), np.float32)
+        acts, extra, _ = self.compute_actions_with_state(
+            obs_batch, [h, h.copy()], explore)
+        return acts, extra
+
+    def _value_after(self, obs_last, next_obs, h, c):
+        """V(next_obs) with the state that follows the fragment's last
+        step — the exact bootstrap for truncated episodes."""
+        import jax.numpy as jnp
+
+        obs_last = jnp.asarray(obs_last, jnp.float32)[None]
+        next_obs = jnp.asarray(next_obs, jnp.float32)[None]
+        state = (jnp.asarray(h, jnp.float32)[None],
+                 jnp.asarray(c, jnp.float32)[None])
+        _, state = self._step_fn(self.params, obs_last, state)
+        out, _ = self._step_fn(self.params, next_obs, state)
+        return float(out[0, -1])
+
+    # -- learning --------------------------------------------------------
+
+    def postprocess_trajectory(self, batch, other_agent_batches=None,
+                               episode=None):
+        from ray_tpu.rllib.agents.pg import discounted_returns
+
+        out = []
+        for eb in batch.split_by_episode():
+            if eb[SampleBatch.DONES][-1]:
+                last_value = 0.0
+            else:
+                last_value = self._value_after(
+                    eb[SampleBatch.OBS][-1], eb[SampleBatch.NEXT_OBS][-1],
+                    eb[STATE_H][-1], eb[STATE_C][-1])
+            eb[SampleBatch.ADVANTAGES] = discounted_returns(
+                eb[SampleBatch.REWARDS].astype(np.float64),
+                eb[SampleBatch.DONES].astype(np.float64),
+                self.config["gamma"], last_value)
+            out.append(eb)
+        return SampleBatch.concat_samples(out)
+
+    def _sequence(self, batch: SampleBatch) -> dict:
+        """Chop rows into [S, T] padded sequences along stored unrolls
+        (reference: policy/rnn_sequencing.py chop_into_sequences)."""
+        import jax.numpy as jnp
+
+        max_t = int(self.config["max_seq_len"])
+        obs = batch[SampleBatch.OBS].astype(np.float32)
+        obs = obs.reshape(len(obs), -1)
+        actions = batch[SampleBatch.ACTIONS]
+        returns = batch[SampleBatch.ADVANTAGES].astype(np.float32)
+        eps = batch[SampleBatch.EPS_ID]
+        unroll = batch[UNROLL_ID]
+        sh = batch[STATE_H].astype(np.float32)
+        sc = batch[STATE_C].astype(np.float32)
+
+        seqs = []  # (start, length) within one unroll
+        start = 0
+        for t in range(1, len(obs) + 1):
+            boundary = (t == len(obs) or unroll[t] != unroll[start]
+                        or t - start == max_t)
+            if boundary:
+                seqs.append((start, t - start))
+                start = t
+        s_n = len(seqs)
+        act_shape = actions.shape[1:]
+        cols = {
+            "obs": np.zeros((s_n, max_t, obs.shape[1]), np.float32),
+            "actions": np.zeros((s_n, max_t) + act_shape,
+                                actions.dtype),
+            "returns": np.zeros((s_n, max_t), np.float32),
+            "resets": np.zeros((s_n, max_t), np.float32),
+            "mask": np.zeros((s_n, max_t), np.float32),
+            "h0": np.zeros((s_n, self.cell_size), np.float32),
+            "c0": np.zeros((s_n, self.cell_size), np.float32),
+        }
+        for si, (s0, ln) in enumerate(seqs):
+            sl = slice(s0, s0 + ln)
+            cols["obs"][si, :ln] = obs[sl]
+            cols["actions"][si, :ln] = actions[sl]
+            cols["returns"][si, :ln] = returns[sl]
+            cols["mask"][si, :ln] = 1.0
+            cols["h0"][si] = sh[s0]
+            cols["c0"][si] = sc[s0]
+            e = eps[sl]
+            cols["resets"][si, 1:ln] = (e[1:] != e[:-1]).astype(
+                np.float32)
+        return {k: jnp.asarray(v) for k, v in cols.items()}
+
+    def learn_on_batch(self, batch: SampleBatch) -> dict:
+        jb = self._sequence(batch)
+        self.params, self.opt_state, loss, metrics = self._sgd_step(
+            self.params, self.opt_state, jb)
+        out = {"total_loss": float(loss)}
+        out.update({k: float(v) for k, v in metrics.items()})
+        return out
+
+    def get_weights(self):
+        import jax
+
+        return jax.tree.map(np.asarray, self.params)
+
+    def set_weights(self, weights):
+        import jax.numpy as jnp
+        import jax
+
+        self.params = jax.tree.map(jnp.asarray, weights)
