@@ -109,8 +109,15 @@ def _run_on(cfg: dict, host, cmd: str, timeout: float = 300.0) -> str:
 
 def _start_env(cfg: dict, host) -> str:
     env = dict(cfg.get("env") or {})
-    ip = _host_name(host)
-    sysconf = {"node_ip_address": ip}
+    # merge (not overwrite) a user-provided system config from the YAML
+    # env block with the per-host advertise address
+    try:
+        sysconf = json.loads(env.get("RAY_TPU_SYSTEM_CONFIG") or "{}")
+    except ValueError:
+        raise LauncherError(
+            "env.RAY_TPU_SYSTEM_CONFIG in the cluster YAML is not valid "
+            "JSON")
+    sysconf["node_ip_address"] = _host_name(host)
     env["RAY_TPU_SYSTEM_CONFIG"] = json.dumps(sysconf)
     return " ".join(f"{k}={shlex.quote(str(v))}" for k, v in env.items())
 
@@ -150,21 +157,31 @@ def up(config_path: str) -> dict:
     out = _run_on(cfg, head, f"{_start_env(cfg, head)} {head_cmd}{extra}")
     gcs_address = _parse_gcs_address(out, _host_name(head), port)
 
-    started = [{"host": _host_name(head), "role": "head"}]
-    for w in workers:
-        for cmd in cfg["setup_commands"]:
-            _run_on(cfg, w, cmd)
-        worker_cmd = (cfg["worker_start_command"]
-                      or "ray-tpu start --address {gcs_address}").format(
-            gcs_address=gcs_address, port=port)
-        _run_on(cfg, w,
-                f"{_start_env(cfg, w)} {worker_cmd}{_host_extra_args(w)}")
-        started.append({"host": _host_name(w), "role": "worker"})
-
+    # state is saved after EVERY started node so a partial bring-up
+    # (worker N fails) remains `down`-able instead of leaking the head
+    # and earlier workers
     state = {"cluster_name": cfg["cluster_name"], "config": cfg,
-             "gcs_address": gcs_address, "nodes": started,
+             "gcs_address": gcs_address,
+             "nodes": [{"host": _host_name(head), "role": "head"}],
              "up_time": time.strftime("%Y-%m-%d %H:%M:%S")}
     _save_state(cfg, state)
+    for w in workers:
+        try:
+            for cmd in cfg["setup_commands"]:
+                _run_on(cfg, w, cmd)
+            worker_cmd = (cfg["worker_start_command"]
+                          or "ray-tpu start --address {gcs_address}"
+                          ).format(gcs_address=gcs_address, port=port)
+            _run_on(cfg, w,
+                    f"{_start_env(cfg, w)} {worker_cmd}"
+                    f"{_host_extra_args(w)}")
+        except LauncherError as e:
+            raise LauncherError(
+                f"{e}\n(cluster partially up: `ray-tpu down "
+                f"{cfg['cluster_name']}` stops the "
+                f"{len(state['nodes'])} started node(s))") from e
+        state["nodes"].append({"host": _host_name(w), "role": "worker"})
+        _save_state(cfg, state)
     return state
 
 
@@ -198,21 +215,26 @@ def _parse_gcs_address(output: str, head_host: str, port: int) -> str:
 
 
 def down(name_or_path: str) -> int:
-    """Stop every node (workers first, head last)."""
+    """Stop every node (workers first, head last). State survives
+    partial failures so `down` can be retried for the stragglers."""
     state = _resolve_state(name_or_path)
     cfg = state["config"]
     stop = cfg["stop_command"]
-    errors = 0
+    failed = []
     for node in reversed(state["nodes"]):
         try:
             _run_on(cfg, node["host"], stop)
         except LauncherError:
-            errors += 1
+            failed.append(node)
+    if failed:
+        state["nodes"] = list(reversed(failed))
+        _save_state(cfg, state)
+        return len(failed)
     try:
         os.unlink(_state_path(state["cluster_name"]))
     except OSError:
         pass
-    return errors
+    return 0
 
 
 def attach_command(name_or_path: str) -> str:

@@ -1,0 +1,142 @@
+"""Global-mesh collectives: group ops across actor PROCESSES on the
+accelerator plane.
+
+When N actors have joined one jax.distributed runtime
+(parallel/multihost.py), `collective.allreduce` from each of them should
+ride XLA collectives over the global device mesh (ICI/DCN) — the
+reference's NCCL-across-actors capability (reference:
+python/ray/util/collective/collective.py:226 allreduce over
+nccl_collective_group.py:115) — not the HOST TCP hub. Each process is
+one collective RANK; its tensor becomes one row of a [world, ...] global
+array sharded process-major, and every op is a tiny jitted reduction
+whose cross-host traffic XLA lowers to the right collective.
+
+Selected automatically: GroupManager routes backend="xla" here whenever
+the multihost runtime is active and the group spans all its processes.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ray_tpu.collective.types import ReduceOp
+
+_JNP_REDUCE = {
+    ReduceOp.SUM: "sum",
+    ReduceOp.PRODUCT: "prod",
+    ReduceOp.MIN: "min",
+    ReduceOp.MAX: "max",
+    ReduceOp.MEAN: "mean",
+}
+
+
+class GlobalMeshGroup:
+    """One rank per PROCESS of the active jax.distributed runtime."""
+
+    def __init__(self, group_name: str, world_size: int, rank: int):
+        import jax
+        from jax.sharding import Mesh
+
+        n_proc = jax.process_count()
+        if world_size != n_proc:
+            raise ValueError(
+                f"global-mesh collective group needs one rank per joined "
+                f"process: world_size={world_size} but "
+                f"jax.process_count()={n_proc}")
+        if rank != jax.process_index():
+            raise ValueError(
+                f"rank {rank} must equal jax.process_index() "
+                f"{jax.process_index()} — the global runtime fixes rank "
+                "order")
+        self.group_name = group_name
+        self.world_size = world_size
+        self.rank = rank
+        devs = np.array(jax.devices())
+        local = len(jax.local_devices())
+        if len(devs) != n_proc * local:
+            raise ValueError("unequal device counts per process")
+        # process-major mesh: row p = process p's devices
+        self.mesh = Mesh(devs.reshape(n_proc, local), ("proc", "local"))
+        self._jits: dict = {}
+
+    # -- plumbing --------------------------------------------------------
+
+    def _global_rows(self, arr: np.ndarray):
+        """This rank's tensor -> one row of a [world, ...] global array
+        sharded along 'proc' (host data never leaves its process)."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        sharding = NamedSharding(
+            self.mesh, P("proc", *([None] * arr.ndim)))
+        return jax.make_array_from_process_local_data(
+            sharding, np.ascontiguousarray(arr)[None])
+
+    def _jit(self, key, fn):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        if key not in self._jits:
+            self._jits[key] = jax.jit(
+                fn, out_shardings=NamedSharding(self.mesh, P()))
+        return self._jits[key]
+
+    def _reduce_rows(self, garr, op: ReduceOp):
+        import jax.numpy as jnp
+
+        name = _JNP_REDUCE[ReduceOp(op)]
+
+        def fn(g):
+            return getattr(jnp, name)(g, axis=0)
+
+        return self._jit(("reduce", name, garr.shape, str(garr.dtype)),
+                         fn)(garr)
+
+    # -- op surface (mirrors host_backend) -------------------------------
+
+    def allreduce(self, arr: np.ndarray, op: ReduceOp = ReduceOp.SUM):
+        out = self._reduce_rows(self._global_rows(arr), op)
+        return np.asarray(out)
+
+    def reduce(self, arr: np.ndarray, dst_rank: int = 0,
+               op: ReduceOp = ReduceOp.SUM):
+        out = self.allreduce(arr, op)
+        return out if self.rank == dst_rank else arr
+
+    def broadcast(self, arr: np.ndarray, src_rank: int = 0):
+        import jax.numpy as jnp
+
+        garr = self._global_rows(arr)
+        out = self._jit(("bcast", src_rank, garr.shape, str(garr.dtype)),
+                        lambda g: jnp.take(g, src_rank, axis=0))(garr)
+        return np.asarray(out)
+
+    def allgather(self, arr: np.ndarray) -> list[np.ndarray]:
+        garr = self._global_rows(arr)
+        out = self._jit(("gather", garr.shape, str(garr.dtype)),
+                        lambda g: g)(garr)
+        rows = np.asarray(out)
+        return [rows[i] for i in range(self.world_size)]
+
+    def reducescatter(self, arr: np.ndarray, op: ReduceOp = ReduceOp.SUM):
+        flat = np.ascontiguousarray(arr).reshape(-1)
+        if flat.size % self.world_size:
+            raise ValueError(
+                f"reducescatter needs size divisible by world "
+                f"({flat.size} % {self.world_size})")
+        total = self.allreduce(flat, op)
+        chunk = flat.size // self.world_size
+        return total[self.rank * chunk:(self.rank + 1) * chunk]
+
+    def barrier(self):
+        self.allreduce(np.zeros(1, np.float32))
+
+    def send(self, arr, dst_rank: int, tag: int = 0):
+        raise NotImplementedError(
+            "point-to-point ops are HOST-backend only; the global mesh "
+            "expresses transfers as collectives")
+
+    recv = send
+
+    def destroy(self):
+        self._jits.clear()

@@ -35,9 +35,29 @@ class GroupManager:
 
             group = HostGroup(group_name, world_size, rank, timeout=timeout)
         else:
-            from ray_tpu.collective.backends.xla_backend import XlaGroup
+            from ray_tpu.parallel import multihost
 
-            group = XlaGroup(group_name)
+            def _spans_processes() -> bool:
+                if world_size <= 1 or not multihost.is_initialized():
+                    return False
+                import jax
+
+                # only a one-rank-per-process group rides the global
+                # mesh; other sizes are single-controller device groups
+                return world_size == jax.process_count()
+
+            if _spans_processes():
+                # N actor processes joined one jax.distributed runtime:
+                # group ops ride XLA collectives over the global mesh
+                # (the NCCL-across-actors capability; weak #8)
+                from ray_tpu.collective.backends.xla_global import (
+                    GlobalMeshGroup)
+
+                group = GlobalMeshGroup(group_name, world_size, rank)
+            else:
+                from ray_tpu.collective.backends.xla_backend import XlaGroup
+
+                group = XlaGroup(group_name)
         with self._lock:
             self._groups[group_name] = group
         return group
