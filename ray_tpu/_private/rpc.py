@@ -115,6 +115,12 @@ class Connection:
         self._push_handler: Callable[[str, Any], Awaitable[None]] | None = None
         self._send_lock = asyncio.Lock()
         self._undrained = 0
+        # outbound frame coalescing: frames queue here and one call_soon
+        # callback writes them in a single transport write per loop tick
+        # (a submit burst of 100 small calls = a handful of socket sends
+        # instead of 100 — sock.send was 15% of the n:n microbenchmark)
+        self._outbuf: list[bytes] = []
+        self._flush_scheduled = False
         self._closed = False
         self._reader_task = asyncio.create_task(self._read_loop())
         # Opaque per-connection state slot for servers (e.g. worker identity).
@@ -153,6 +159,7 @@ class Connection:
     async def _shutdown(self):
         if self._closed:
             return
+        self._flush()  # don't strand queued frames (e.g. a last reply)
         self._closed = True
         for fut in self._pending.values():
             if not fut.done():
@@ -205,7 +212,10 @@ class Connection:
         data = _pack(msg)
         async with self._send_lock:
             try:
-                self._writer.write(data)
+                self._outbuf.append(data)
+                if not self._flush_scheduled:
+                    self._flush_scheduled = True
+                    asyncio.get_running_loop().call_soon(self._flush)
                 # drain() per frame costs a syscall-sized stall on every
                 # small control message (it was the top cost in the
                 # actor-call microbenchmark). Small frames skip it, but
@@ -214,6 +224,7 @@ class Connection:
                 # the transport buffer without backpressure.
                 self._undrained += len(data)
                 if len(data) > 65536 or self._undrained > (1 << 20):
+                    self._flush()
                     await self._writer.drain()
                     self._undrained = 0
             except (ConnectionError, OSError, RuntimeError) as e:
@@ -223,6 +234,26 @@ class Connection:
                 # (ReconnectingConnection) only understand ConnectionLost
                 raise ConnectionLost(
                     f"connection {self.name} lost mid-send: {e}") from e
+
+    def _flush(self):
+        """Write every queued frame in one transport call. Runs on the
+        event loop (call_soon AFTER the burst of _send callbacks that
+        queued frames, preserving FIFO order with the immediate
+        large-frame path, which calls this synchronously first)."""
+        self._flush_scheduled = False
+        if not self._outbuf or self._closed:
+            self._outbuf.clear()
+            return
+        buf = (self._outbuf[0] if len(self._outbuf) == 1
+               else b"".join(self._outbuf))
+        self._outbuf.clear()
+        try:
+            self._writer.write(buf)
+        except (ConnectionError, OSError, RuntimeError):
+            # the reader loop notices the dead transport and runs the
+            # full shutdown path; callers see ConnectionLost there
+            logger.debug("flush failed on %s (connection dying)",
+                         self.name)
 
     async def call(self, method: str, data: Any = None, timeout: float | None = None):
         msgid = next(self._msgid)

@@ -110,13 +110,21 @@ def _run_on(cfg: dict, host, cmd: str, timeout: float = 300.0) -> str:
 def _start_env(cfg: dict, host) -> str:
     env = dict(cfg.get("env") or {})
     # merge (not overwrite) a user-provided system config from the YAML
-    # env block with the per-host advertise address
-    try:
-        sysconf = json.loads(env.get("RAY_TPU_SYSTEM_CONFIG") or "{}")
-    except ValueError:
+    # env block with the per-host advertise address; accept both the
+    # natural YAML mapping form and a JSON string
+    raw = env.get("RAY_TPU_SYSTEM_CONFIG") or {}
+    if isinstance(raw, str):
+        try:
+            raw = json.loads(raw)
+        except ValueError as e:
+            raise LauncherError(
+                "env.RAY_TPU_SYSTEM_CONFIG in the cluster YAML is not "
+                f"valid JSON: {e}") from e
+    if not isinstance(raw, dict):
         raise LauncherError(
-            "env.RAY_TPU_SYSTEM_CONFIG in the cluster YAML is not valid "
-            "JSON")
+            "env.RAY_TPU_SYSTEM_CONFIG must be a mapping of config "
+            f"overrides, got {type(raw).__name__}")
+    sysconf = dict(raw)
     sysconf["node_ip_address"] = _host_name(host)
     env["RAY_TPU_SYSTEM_CONFIG"] = json.dumps(sysconf)
     return " ".join(f"{k}={shlex.quote(str(v))}" for k, v in env.items())

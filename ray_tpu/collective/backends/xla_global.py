@@ -51,12 +51,20 @@ class GlobalMeshGroup:
         self.group_name = group_name
         self.world_size = world_size
         self.rank = rank
-        devs = np.array(jax.devices())
-        local = len(jax.local_devices())
-        if len(devs) != n_proc * local:
-            raise ValueError("unequal device counts per process")
-        # process-major mesh: row p = process p's devices
-        self.mesh = Mesh(devs.reshape(n_proc, local), ("proc", "local"))
+        # row p MUST be process p's devices — jax.devices() is sorted by
+        # id, and on 3-D TPU slices (v4/v5p) ids follow topology
+        # coordinates, so one host's chips need not be contiguous; group
+        # explicitly by process_index
+        by_proc: dict[int, list] = {}
+        for d in jax.devices():
+            by_proc.setdefault(d.process_index, []).append(d)
+        counts = {len(v) for v in by_proc.values()}
+        if len(by_proc) != n_proc or len(counts) != 1:
+            raise ValueError(
+                f"unequal device counts per process: "
+                f"{ {p: len(v) for p, v in by_proc.items()} }")
+        rows = [by_proc[p] for p in sorted(by_proc)]
+        self.mesh = Mesh(np.array(rows), ("proc", "local"))
         self._jits: dict = {}
 
     # -- plumbing --------------------------------------------------------
@@ -119,14 +127,11 @@ class GlobalMeshGroup:
         return [rows[i] for i in range(self.world_size)]
 
     def reducescatter(self, arr: np.ndarray, op: ReduceOp = ReduceOp.SUM):
-        flat = np.ascontiguousarray(arr).reshape(-1)
-        if flat.size % self.world_size:
-            raise ValueError(
-                f"reducescatter needs size divisible by world "
-                f"({flat.size} % {self.world_size})")
-        total = self.allreduce(flat, op)
-        chunk = flat.size // self.world_size
-        return total[self.rank * chunk:(self.rank + 1) * chunk]
+        # HOST-backend semantics exactly (host_backend.py hub path):
+        # reduce, then np.array_split along axis 0 — uneven leading dims
+        # allowed, rank r gets chunk r with trailing dims intact
+        total = self.allreduce(arr, op)
+        return np.array_split(total, self.world_size, axis=0)[self.rank]
 
     def barrier(self):
         self.allreduce(np.zeros(1, np.float32))
