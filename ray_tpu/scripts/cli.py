@@ -337,6 +337,51 @@ def cmd_dashboard(args) -> int:
     return 0
 
 
+def cmd_debug(args) -> int:
+    """Attach to a live rpdb breakpoint (reference: `ray debug`,
+    scripts/scripts.py + util/rpdb.py)."""
+    addr = _gcs_address(args)
+    if not addr:
+        print("no cluster found (no --address, RAY_TPU_ADDRESS, or "
+              "record)", file=sys.stderr)
+        return 2
+    import ray_tpu
+
+    ray_tpu.init(address=addr)
+    from ray_tpu.util import rpdb
+
+    sessions = rpdb.active_sessions()
+    if not sessions:
+        print("no active breakpoints (call ray_tpu.util.rpdb.set_trace()"
+              " inside a task/actor)")
+        return 0
+    for i, s in enumerate(sessions):
+        print(f"[{i}] pid {s['pid']} at {s['filename']}:{s['lineno']}")
+    idx = args.index
+    if idx is None:
+        if len(sessions) == 1:
+            idx = 0
+        else:
+            try:
+                idx = int(input("attach to which breakpoint? "))
+            except (ValueError, EOFError):
+                print("not a breakpoint number", file=sys.stderr)
+                return 2
+    if not 0 <= idx < len(sessions):
+        print(f"breakpoint index {idx} out of range "
+              f"(0..{len(sessions) - 1})", file=sys.stderr)
+        return 2
+    print(f"attaching to [{idx}] — pdb commands apply remotely "
+          f"(c to continue, q to abort the task)")
+    try:
+        rpdb.connect(sessions[idx])
+    except OSError as e:
+        print(f"breakpoint unreachable ({e}); it may have just "
+              f"finished — rerun `ray-tpu debug`", file=sys.stderr)
+        return 1
+    return 0
+
+
 def cmd_up(args) -> int:
     from ray_tpu.autoscaler import launcher
 
@@ -451,6 +496,12 @@ def main(argv=None) -> int:
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=8265)
     p.set_defaults(fn=cmd_dashboard)
+
+    p = sub.add_parser("debug", help="attach to a live rpdb breakpoint")
+    p.add_argument("--address", default=None)
+    p.add_argument("--index", type=int, default=None,
+                   help="breakpoint number (skip the prompt)")
+    p.set_defaults(fn=cmd_debug)
 
     p = sub.add_parser("up", help="launch a cluster from a YAML spec")
     p.add_argument("config", help="cluster YAML (see autoscaler/launcher.py)")

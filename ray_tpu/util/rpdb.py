@@ -1,0 +1,266 @@
+"""Remote pdb — debug live tasks/actors from the driver machine
+(reference: python/ray/util/rpdb.py set_trace/_connect + the `ray debug`
+CLI command in scripts/scripts.py).
+
+`ray_tpu.util.rpdb.set_trace()` inside any task/actor opens a TCP
+listener, advertises it in the GCS KV store, and blocks the worker in a
+pdb session served over the socket. `ray-tpu debug` lists active
+breakpoints and bridges your terminal to one. Breakpoints set with `b`
+survive `c`: the worker keeps its listener and re-accepts a client at
+the next stop.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import select as select_mod
+import socket
+import sys
+import time
+import uuid
+
+_KV_PREFIX = "rpdb:"
+
+
+class _SocketIO:
+    """File-like adapter pdb can use for stdin/stdout over a socket,
+    re-accepting a new client from the listener when the current one
+    goes away (so `b <line>` + `c` + reattach works)."""
+
+    def __init__(self, listener: socket.socket):
+        self._listener = listener
+        self._sock: socket.socket | None = None
+        self._rfile = None
+
+    def _ensure(self) -> bool:
+        if self._sock is not None:
+            return True
+        try:
+            conn, _ = self._listener.accept()
+        except OSError:
+            return False
+        self._sock = conn
+        self._rfile = conn.makefile("r", encoding="utf-8", newline="\n")
+        return True
+
+    def _drop(self):
+        try:
+            if self._rfile is not None:
+                self._rfile.close()
+            if self._sock is not None:
+                self._sock.close()
+        except OSError:
+            pass
+        self._sock = None
+        self._rfile = None
+
+    def readline(self):
+        while True:
+            if not self._ensure():
+                return ""  # listener closed: EOF -> pdb quits
+            line = self._rfile.readline()
+            if line:
+                return line
+            self._drop()  # client went away; wait for a reattach
+
+    def write(self, data: str):
+        if self._sock is not None:
+            try:
+                self._sock.sendall(data.encode())
+            except OSError:
+                self._drop()
+        return len(data)
+
+    def flush(self):
+        pass
+
+    def close(self):
+        self._drop()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+
+
+class _RemotePdb:
+    """pdb over a socket. Teardown runs on quit, or on continue when no
+    breakpoints remain; with breakpoints set the session stays
+    advertised so a client can reattach at the next stop."""
+
+    def __new__(cls, io, cleanup):
+        import pdb
+
+        class _P(pdb.Pdb):
+            def set_continue(self):
+                super().set_continue()
+                if not self.breaks:
+                    cleanup()
+
+            def set_quit(self):
+                cleanup()
+                super().set_quit()
+
+            def dispatch_return(self, frame, arg):
+                # the traced (bottom) frame returning ends the session
+                # even if breakpoints are still set — otherwise the KV
+                # entry and listener would outlive the code being
+                # debugged as a phantom
+                try:
+                    return super().dispatch_return(frame, arg)
+                finally:
+                    if frame is self.botframe:
+                        cleanup()
+
+        dbg = _P(stdin=io, stdout=io)
+        dbg.prompt = "(rpdb) "
+        return dbg
+
+
+def set_trace(frame=None):
+    """Breakpoint: park this worker in a remote pdb session (reference:
+    rpdb.py:set_trace). The worker blocks until a `ray-tpu debug` client
+    attaches; on `c` execution continues, on `q` the task aborts."""
+    from ray_tpu._private.config import get_config
+    from ray_tpu.experimental import internal_kv
+
+    cfg = get_config()
+    listener = socket.socket()
+    listener.bind((cfg.bind_host, 0))
+    listener.listen(1)
+    port = listener.getsockname()[1]
+    session_id = f"{os.getpid()}-{uuid.uuid4().hex[:8]}"
+    key = f"{_KV_PREFIX}{session_id}"
+    caller = sys._getframe(1) if frame is None else frame
+    internal_kv._kv_put(key, json.dumps({
+        # advertise the host's reachable IP, not loopback: the CLI
+        # attaches from another machine in a launched cluster
+        "address": f"{cfg.node_ip_address}:{port}",
+        "pid": os.getpid(),
+        "filename": caller.f_code.co_filename,
+        "lineno": caller.f_lineno,
+        "created": time.time(),
+    }).encode())
+
+    done = []
+    io = _SocketIO(listener)
+
+    def cleanup():
+        if done:
+            return
+        done.append(True)
+        try:
+            internal_kv._kv_del(key)
+        except Exception:
+            pass
+        io.close()
+
+    try:
+        if not io._ensure():  # block until the first client attaches
+            cleanup()
+            return
+    except BaseException:
+        cleanup()
+        raise
+    debugger = _RemotePdb(io, cleanup)
+    # arms tracing and returns; the first interactive stop is the
+    # caller's next statement, teardown fires on continue/quit
+    debugger.set_trace(caller)
+
+
+def active_sessions(probe: bool = True) -> list[dict]:
+    """All advertised breakpoints (driver side). With probe=True,
+    entries whose listener is gone (worker OOM-killed, node dead) are
+    dropped from the KV store instead of listed as phantoms."""
+    from ray_tpu.experimental import internal_kv
+
+    out = []
+    for key in internal_kv._kv_list(_KV_PREFIX):
+        raw = internal_kv._kv_get(key)
+        if not raw:
+            continue
+        rec = json.loads(raw)
+        rec["session"] = key[len(_KV_PREFIX):]
+        if probe and not _reachable(rec["address"]):
+            try:
+                internal_kv._kv_del(key)
+            except Exception:
+                pass
+            continue
+        out.append(rec)
+    return sorted(out, key=lambda r: r.get("created", 0))
+
+
+def _reachable(address: str, timeout: float = 5.0) -> bool:
+    host, port = address.rsplit(":", 1)
+    try:
+        # connect_ex probe: a listening-but-busy breakpoint (one client
+        # already attached) still accepts the TCP handshake
+        s = socket.create_connection((host, int(port)), timeout=timeout)
+        s.close()
+        return True
+    except OSError:
+        return False
+
+
+def connect(session: dict, *, stdin=None, stdout=None) -> None:
+    """Bridge the local terminal to a breakpoint (reference: rpdb.py
+    _connect). Returns when the remote side closes the connection."""
+    import threading
+
+    stdin = stdin if stdin is not None else sys.stdin
+    stdout = stdout if stdout is not None else sys.stdout
+    host, port = session["address"].rsplit(":", 1)
+    sock = socket.create_connection((host, int(port)), timeout=10)
+
+    done = threading.Event()
+
+    def pump_out():
+        try:
+            while not done.is_set():
+                data = sock.recv(4096)
+                if not data:
+                    break
+                stdout.write(data.decode(errors="replace"))
+                stdout.flush()
+        except OSError:
+            pass
+        finally:
+            done.set()
+
+    t = threading.Thread(target=pump_out, daemon=True)
+    t.start()
+    try:
+        fd = None
+        try:
+            fd = stdin.fileno()
+        except (OSError, AttributeError, ValueError):
+            pass
+        while not done.is_set():
+            if fd is not None:
+                # detach is driven by the SOCKET closing (pump sets
+                # done), never by guessing which commands end a session
+                ready, _, _ = select_mod.select([fd], [], [], 0.2)
+                if not ready:
+                    continue
+            line = stdin.readline()
+            if not line:
+                break
+            try:
+                sock.sendall(line.encode())
+            except OSError:
+                break
+    finally:
+        # graceful half-close: FIN (not RST) lets the worker drain any
+        # commands still buffered in flight, then see EOF; an abrupt
+        # close() would flush its receive buffer mid-script
+        try:
+            sock.shutdown(socket.SHUT_WR)
+        except OSError:
+            pass
+        t.join(timeout=3.0)
+        done.set()
+        try:
+            sock.close()
+        except OSError:
+            pass

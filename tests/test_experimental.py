@@ -172,3 +172,119 @@ def test_inspect_serializability():
     ok, failures = inspect_serializability(Holder())
     assert not ok
     assert any(f.name == "bad" for f in failures)
+
+
+def test_distributed_array_ops(ray_start_shared):
+    """experimental.array: block-decomposed arrays with remote blockwise
+    ops (reference: experimental/array/distributed/core.py)."""
+    import numpy as np
+
+    from ray_tpu.experimental import array as da
+
+    rng = np.random.RandomState(0)
+    a = rng.randn(70, 45).astype(np.float32)
+    b = rng.randn(45, 30).astype(np.float32)
+
+    dx = da.from_numpy(a, block_size=32)
+    dy = da.from_numpy(b, block_size=32)
+    assert dx.grid == (3, 2)
+    np.testing.assert_allclose(dx.assemble(), a)
+
+    np.testing.assert_allclose(
+        da.add(dx, dx).assemble(), a + a, rtol=1e-6)
+    np.testing.assert_allclose(
+        da.transpose(dx).assemble(), a.T, rtol=1e-6)
+    np.testing.assert_allclose(
+        da.dot(dx, dy).assemble(), a @ b, rtol=1e-4, atol=1e-4)
+
+    z = da.zeros((40, 40), np.float32, block_size=16)
+    o = da.ones((40, 40), np.float32, block_size=16)
+    np.testing.assert_allclose(
+        da.subtract(o, z).assemble(), np.ones((40, 40)))
+
+
+def test_rpdb_breakpoint_attach_and_continue(ray_start_shared):
+    """util.rpdb: a task parks in a remote pdb session advertised via
+    GCS KV; a client attaches, inspects a variable, continues, and the
+    task completes (reference: util/rpdb.py + `ray debug`)."""
+    import io
+    import time
+
+    import ray_tpu
+    from ray_tpu.util import rpdb
+
+    @ray_tpu.remote
+    def buggy():
+        secret = 41 + 1  # noqa: F841 — inspected through the debugger
+        from ray_tpu.util import rpdb as r
+
+        r.set_trace()
+        return secret
+
+    ref = buggy.remote()
+    deadline = time.monotonic() + 30
+    sessions = []
+    while time.monotonic() < deadline:
+        sessions = rpdb.active_sessions()
+        if sessions:
+            break
+        time.sleep(0.1)
+    assert sessions, "breakpoint never advertised"
+    assert sessions[0]["pid"] > 0
+
+    out = io.StringIO()
+    rpdb.connect(sessions[0], stdin=io.StringIO("p secret\nc\n"),
+                 stdout=out)
+    assert ray_tpu.get(ref, timeout=60) == 42
+    assert "42" in out.getvalue(), out.getvalue()
+    # session cleaned out of the KV store
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and rpdb.active_sessions():
+        time.sleep(0.1)
+    assert not rpdb.active_sessions()
+
+
+def test_rpdb_breakpoint_survives_continue_and_reattach(ray_start_shared):
+    """`b <line>` + `c` keeps the session alive: the worker re-accepts a
+    new client at the breakpoint, and the session tears down when the
+    traced frame returns."""
+    import io
+    import time
+
+    import ray_tpu
+    from ray_tpu.util import rpdb
+
+    @ray_tpu.remote
+    def stepped():
+        from ray_tpu.util import rpdb as r
+
+        r.set_trace()
+        x = 1
+        x = x + 10          # breakpoint lands here
+        return x
+
+    ref = stepped.remote()
+    deadline = time.monotonic() + 30
+    sessions = []
+    while time.monotonic() < deadline and not sessions:
+        sessions = rpdb.active_sessions()
+        time.sleep(0.1)
+    assert sessions
+    line = sessions[0]["lineno"] + 2  # the `x = x + 10` line
+
+    # attach 1: set a breakpoint and continue (client detaches)
+    rpdb.connect(sessions[0], stdin=io.StringIO(f"b {line}\nc\n"),
+                 stdout=io.StringIO())
+    # session still advertised (breakpoint pending), worker waiting
+    assert rpdb.active_sessions(), "session died on c with breaks set"
+
+    # attach 2: at the breakpoint, inspect and continue to completion
+    out = io.StringIO()
+    rpdb.connect(rpdb.active_sessions()[0],
+                 stdin=io.StringIO("p x\ncl\ny\nc\n"), stdout=out)
+    assert ray_tpu.get(ref, timeout=60) == 11
+    assert "1" in out.getvalue()
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and rpdb.active_sessions():
+        time.sleep(0.2)
+    assert not rpdb.active_sessions()
