@@ -129,6 +129,33 @@ class Dashboard:
                         "num_workers": info["num_workers"]})
         return out
 
+    async def logs(self, node: str | None = None, file: str | None = None,
+                   lines: int = 200):
+        """Per-node log browsing via each raylet's get_logs handler —
+        logs stay node-local, pulled on demand (the scalable agent model;
+        reference: dashboard/agent.py log routes). Without `node`: map of
+        node -> log file list. With node (+optional file): that node's
+        files, or the file's tail."""
+        nodes = await self._gcs("get_all_nodes")
+        by_id = {n["node_id"].hex()[:12]: n for n in nodes}
+        if node is None:
+            async def one(nid, n):
+                try:
+                    return nid, await self._raylet(n["address"],
+                                                   "get_logs")
+                except Exception:
+                    return None
+            got = await asyncio.gather(
+                *(one(nid, n) for nid, n in by_id.items()))
+            return dict(p for p in got if p)
+        n = by_id.get(node[:12])
+        if n is None:
+            return {"error": f"unknown node {node!r}"}
+        payload = {"lines": lines}
+        if file:
+            payload["file"] = file
+        return await self._raylet(n["address"], "get_logs", payload)
+
     async def timeline(self) -> list[dict]:
         from ray_tpu._private.profiling import to_chrome_trace
 
@@ -156,6 +183,22 @@ class Dashboard:
         app.router.add_get("/api/objects", jroute(self.objects))
         app.router.add_get("/api/timeline", jroute(self.timeline))
         app.router.add_get("/api/events", jroute(self.events))
+
+        async def logs_handler(request):
+            q = request.rel_url.query
+            try:
+                lines = int(q.get("lines", 200))
+            except ValueError:
+                return web.json_response(
+                    {"error": f"lines={q.get('lines')!r} is not a "
+                              f"number"}, status=400)
+            try:
+                return web.json_response(await self.logs(
+                    node=q.get("node"), file=q.get("file"), lines=lines))
+            except Exception as e:
+                return web.json_response({"error": str(e)}, status=400)
+
+        app.router.add_get("/api/logs", logs_handler)
         runner = web.AppRunner(app)
         await runner.setup()
         site = web.TCPSite(runner, self.host, self.port)

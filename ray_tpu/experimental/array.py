@@ -168,6 +168,10 @@ def dot(x: DistArray, y: DistArray) -> DistArray:
                     dtype=np.result_type(x.dtype, y.dtype))
     k_blocks = x.grid[1]
     for (i, j) in out._indices():
+        if k_blocks == 0:  # zero inner dim: matmul result is zeros
+            out.blocks[(i, j)] = _fill_block.remote(
+                out._block_shape((i, j)), 0, out.dtype.str)
+            continue
         a_chain = [x.blocks[(i, k)] for k in range(k_blocks)]
         b_chain = [y.blocks[(k, j)] for k in range(k_blocks)]
         out.blocks[(i, j)] = _dot_chain.remote(

@@ -148,6 +148,7 @@ class Raylet:
             "free_objects": self.h_free_objects,
             "pin_object": self.h_pin_object,
             "spill_now": self.h_spill_now,
+            "get_logs": self.h_get_logs,
             "cluster_info": self.h_cluster_info,
             "get_metrics": self.h_get_metrics,
             "set_resource": self.h_set_resource,
@@ -888,6 +889,41 @@ class Raylet:
             return bytes(buf.view[d["offset"] : d["offset"] + d["size"]])
         finally:
             buf.close()
+
+    async def h_get_logs(self, conn, d):
+        """Node-local log access — the per-node dashboard-agent role
+        (reference: dashboard/agent.py log routes): the dashboard fans
+        out here instead of aggregating every node's logs centrally.
+        Without 'file': list this node's log files; with 'file': tail
+        the last `lines` lines (bounded read)."""
+        log_dir = os.path.join(self.session_dir, "logs")
+        fname = d.get("file")
+        if not fname:
+            try:
+                entries = []
+                for name in sorted(os.listdir(log_dir)):
+                    path = os.path.join(log_dir, name)
+                    if os.path.isfile(path):
+                        entries.append({"name": name,
+                                        "size": os.path.getsize(path)})
+                return entries
+            except FileNotFoundError:
+                return []
+        if os.path.basename(fname) != fname or fname in (".", ".."):
+            raise ValueError(f"log file must be a bare name: {fname!r}")
+        path = os.path.join(log_dir, fname)
+        lines = max(1, min(int(d.get("lines", 200)), 10_000))
+        try:
+            if not os.path.isfile(path):
+                raise FileNotFoundError(path)
+            size = os.path.getsize(path)
+            with open(path, "rb") as f:
+                f.seek(max(0, size - 512 * lines))  # bounded tail read
+                data = f.read()
+        except (FileNotFoundError, IsADirectoryError):
+            raise ValueError(f"no log file {fname!r} on this node")
+        text = data.decode(errors="replace")
+        return "\n".join(text.splitlines()[-lines:])
 
     async def h_spill_now(self, conn, d):
         """Synchronous spill on behalf of a worker whose store create

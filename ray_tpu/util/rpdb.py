@@ -32,6 +32,10 @@ class _SocketIO:
         self._listener = listener
         self._sock: socket.socket | None = None
         self._rfile = None
+        # output produced while detached (the stack header + prompt at
+        # a breakpoint stop) replays to the next client so it sees WHERE
+        # execution stopped instead of a blank terminal
+        self._backlog: list[bytes] = []
 
     def _ensure(self) -> bool:
         if self._sock is not None:
@@ -42,6 +46,12 @@ class _SocketIO:
             return False
         self._sock = conn
         self._rfile = conn.makefile("r", encoding="utf-8", newline="\n")
+        if self._backlog:
+            try:
+                conn.sendall(b"".join(self._backlog[-64:]))
+            except OSError:
+                pass
+            self._backlog.clear()
         return True
 
     def _drop(self):
@@ -68,8 +78,10 @@ class _SocketIO:
         if self._sock is not None:
             try:
                 self._sock.sendall(data.encode())
+                return len(data)
             except OSError:
                 self._drop()
+        self._backlog.append(data.encode())
         return len(data)
 
     def flush(self):

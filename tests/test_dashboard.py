@@ -60,5 +60,15 @@ def test_dashboard_endpoints(ray_start_regular):
     objects = get_json("/api/objects")
     assert objects and objects[0]["num_workers"] >= 1
 
+    # per-node log browsing (the dashboard-agent role the raylet plays):
+    # list nodes' files, then tail one file from the node that owns it
+    logs = get_json("/api/logs")
+    assert logs, "no nodes reported logs"
+    node_id, files = next(iter(logs.items()))
+    assert any(f["name"].startswith("raylet") for f in files), files
+    fname = next(f["name"] for f in files if f["name"].startswith("raylet"))
+    tail = get_json(f"/api/logs?node={node_id}&file={fname}&lines=5")
+    assert isinstance(tail, str) and tail, tail
+
     with urllib.request.urlopen(base + "/", timeout=10) as r:
         assert b"ray_tpu cluster" in r.read()
