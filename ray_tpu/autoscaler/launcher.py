@@ -46,6 +46,12 @@ _DEFAULTS = {
     "worker_start_command": None,
     "stop_command": "ray-tpu stop",
     "env": {},
+    # {remote_path: local_path} synced to every host before setup
+    # (reference: ray-schema.json file_mounts + updater.sync_file_mounts)
+    "file_mounts": {},
+    # template copying local->host; rsync in production, `cp -r` under
+    # the bash test transport
+    "sync_command": "rsync -az {local} {host}:{remote}",
 }
 
 
@@ -156,6 +162,7 @@ def up(config_path: str) -> dict:
     head, workers = hosts[0], hosts[1:]
     port = cfg["port"]
 
+    _sync_mounts(cfg, head)
     for cmd in cfg["setup_commands"] + cfg["head_setup_commands"]:
         _run_on(cfg, head, cmd)
 
@@ -175,6 +182,7 @@ def up(config_path: str) -> dict:
     _save_state(cfg, state)
     for w in workers:
         try:
+            _sync_mounts(cfg, w)
             for cmd in cfg["setup_commands"]:
                 _run_on(cfg, w, cmd)
             worker_cmd = (cfg["worker_start_command"]
@@ -191,6 +199,27 @@ def up(config_path: str) -> dict:
         state["nodes"].append({"host": _host_name(w), "role": "worker"})
         _save_state(cfg, state)
     return state
+
+
+def _sync_mounts(cfg: dict, host, timeout: float = 600.0):
+    """Copy file_mounts {remote: local} to one host (reference:
+    updater.py sync_file_mounts). Runs the sync_command template
+    locally — it names the host itself."""
+    for remote, local in (cfg.get("file_mounts") or {}).items():
+        local = os.path.expanduser(local)
+        if not os.path.exists(local):
+            raise LauncherError(
+                f"file_mounts source {local!r} does not exist")
+        full = cfg["sync_command"].format(
+            host=_host_name(host), local=shlex.quote(local),
+            remote=shlex.quote(remote))
+        proc = subprocess.run(full, shell=True, capture_output=True,
+                              text=True, timeout=timeout)
+        if proc.returncode != 0:
+            raise LauncherError(
+                f"file mount sync to {_host_name(host)} failed "
+                f"(exit {proc.returncode}): {full}\n"
+                f"{proc.stderr[-2000:]}")
 
 
 def _host_extra_args(host) -> str:

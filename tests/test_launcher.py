@@ -28,7 +28,7 @@ def launcher_env(tmp_path, monkeypatch):
     yield tmp_path
 
 
-def _write_config(tmp_path, hosts) -> str:
+def _write_config(tmp_path, hosts, extra: str = "") -> str:
     cli = f"{PY} -m ray_tpu.scripts.cli"
     cfg = textwrap.dedent(f"""\
         cluster_name: lctest
@@ -40,7 +40,7 @@ def _write_config(tmp_path, hosts) -> str:
         head_start_command: "{cli} start --head --port {{port}} --num-cpus 1"
         worker_start_command: "{cli} start --address {{gcs_address}} --num-cpus 1"
         stop_command: "{cli} stop"
-        """)
+        """) + textwrap.dedent(extra)
     path = tmp_path / "cluster.yaml"
     path.write_text(cfg)
     return str(path)
@@ -106,3 +106,38 @@ def test_launcher_config_validation(launcher_env, tmp_path):
         launcher.load_cluster_config(str(bad))
     with pytest.raises(launcher.LauncherError, match="no launcher state"):
         launcher.down("never-upped")
+
+
+def test_launcher_file_mounts(launcher_env, tmp_path):
+    """file_mounts sync to every host before setup commands run
+    (reference: ray-schema.json file_mounts + updater.sync_file_mounts);
+    the bash transport stands in for rsync."""
+    src = tmp_path / "payload.txt"
+    src.write_text("mounted-content")
+    dest = tmp_path / "synced" / "payload.txt"
+    (tmp_path / "synced").mkdir()
+    extra = f"""\
+        file_mounts:
+          {dest}: {src}
+        sync_command: "cp -r {{local}} {{remote}}"
+        setup_commands:
+          - "test -f {dest}"
+        """
+    path = _write_config(launcher_env, ["127.0.0.1"], extra)
+    state = launcher.up(path)
+    try:
+        assert dest.read_text() == "mounted-content"
+        assert len(state["nodes"]) == 1
+    finally:
+        assert launcher.down("lctest") == 0
+
+    # a missing source fails loudly before anything starts
+    bad = _write_config(launcher_env, ["127.0.0.1"], f"""\
+        file_mounts:
+          {dest}: {tmp_path / 'nope.txt'}
+        sync_command: "cp -r {{local}} {{remote}}"
+        """)
+    import pytest as _pytest
+
+    with _pytest.raises(launcher.LauncherError, match="does not exist"):
+        launcher.up(bad)
