@@ -17,6 +17,9 @@ YAML schema (all commands run through provider.run_command):
     port: 6379                     # GCS port on the head
     setup_commands: ["pip install -e /opt/ray_tpu"]   # every host
     head_setup_commands: []        # head only, after setup_commands
+    file_mounts: {/opt/app/conf.yaml: ./conf.yaml}    # {REMOTE: LOCAL},
+                                   # synced to every host before setup
+    sync_command: "rsync -az {local} {host}:{remote}" # copy transport
     head_start_command: null       # default: ray-tpu start --head ...
     worker_start_command: null     # default: ray-tpu start --address ...
     stop_command: "ray-tpu stop"
@@ -203,18 +206,29 @@ def up(config_path: str) -> dict:
 
 def _sync_mounts(cfg: dict, host, timeout: float = 600.0):
     """Copy file_mounts {remote: local} to one host (reference:
-    updater.py sync_file_mounts). Runs the sync_command template
-    locally — it names the host itself."""
+    updater.py sync_file_mounts, which also mkdir -p's the target's
+    parent first). Runs the sync_command template locally — it names
+    the host itself."""
     for remote, local in (cfg.get("file_mounts") or {}).items():
         local = os.path.expanduser(local)
         if not os.path.exists(local):
             raise LauncherError(
                 f"file_mounts source {local!r} does not exist")
+        parent = os.path.dirname(remote.rstrip("/"))
+        if parent:
+            _run_on(cfg, host, f"mkdir -p {shlex.quote(parent)}")
         full = cfg["sync_command"].format(
             host=_host_name(host), local=shlex.quote(local),
             remote=shlex.quote(remote))
-        proc = subprocess.run(full, shell=True, capture_output=True,
-                              text=True, timeout=timeout)
+        try:
+            proc = subprocess.run(full, shell=True, capture_output=True,
+                                  text=True, timeout=timeout)
+        except subprocess.TimeoutExpired as e:
+            # same normalization as _run_on: up()'s partial-bring-up
+            # guidance and down() retries only understand LauncherError
+            raise LauncherError(
+                f"file mount sync to {_host_name(host)} timed out "
+                f"after {timeout}s: {full}") from e
         if proc.returncode != 0:
             raise LauncherError(
                 f"file mount sync to {_host_name(host)} failed "

@@ -114,8 +114,9 @@ def test_launcher_file_mounts(launcher_env, tmp_path):
     the bash transport stands in for rsync."""
     src = tmp_path / "payload.txt"
     src.write_text("mounted-content")
+    # parent dir intentionally NOT pre-created: _sync_mounts mkdir -p's
+    # it on the host first (reference updater behavior)
     dest = tmp_path / "synced" / "payload.txt"
-    (tmp_path / "synced").mkdir()
     extra = f"""\
         file_mounts:
           {dest}: {src}
@@ -137,7 +138,5 @@ def test_launcher_file_mounts(launcher_env, tmp_path):
           {dest}: {tmp_path / 'nope.txt'}
         sync_command: "cp -r {{local}} {{remote}}"
         """)
-    import pytest as _pytest
-
-    with _pytest.raises(launcher.LauncherError, match="does not exist"):
+    with pytest.raises(launcher.LauncherError, match="does not exist"):
         launcher.up(bad)
