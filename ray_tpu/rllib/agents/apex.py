@@ -1,0 +1,178 @@
+"""Ape-X: distributed prioritized experience replay (reference:
+rllib/agents/dqn/apex.py + execution/replay_ops — Horgan et al. 2018).
+
+The DQN execution plan scaled out: rollout workers sample with
+per-worker exploration epsilons, fragments flow DIRECTLY into sharded
+replay-buffer ACTORS (the driver only routes ObjectRefs, so experience
+bytes move worker→shard through the object plane without a driver copy),
+and the learner loop round-robins sampled batches out of the shards,
+trains, and pushes TD-error priority updates back to the shard each
+batch came from.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.agents.dqn import DQN_CONFIG, DQNPolicy, DQNTrainer
+from ray_tpu.rllib.execution.replay_buffer import PrioritizedReplayBuffer
+from ray_tpu.rllib.policy.sample_batch import SampleBatch
+
+APEX_CONFIG = {
+    **DQN_CONFIG,
+    "num_workers": 2,
+    "num_replay_buffer_shards": 2,
+    "rollout_fragment_length": 50,
+    "train_batch_size": 64,
+    "learning_starts": 500,
+    "sgd_rounds_per_step": 8,
+    "target_network_update_freq": 2000,
+    # per-worker epsilons spread exploration (reference: apex.py
+    # per-worker-epsilon schedule)
+    "worker_min_epsilon": 0.05,
+    "worker_max_epsilon": 0.6,
+}
+
+
+@ray_tpu.remote
+class ReplayShard:
+    """One shard of the distributed prioritized buffer (reference:
+    execution/replay_ops ReplayActor)."""
+
+    def __init__(self, capacity: int, alpha: float, seed=None):
+        self._buffer = PrioritizedReplayBuffer(capacity, alpha=alpha,
+                                               seed=seed)
+
+    def add_batch(self, batch) -> int:
+        if not isinstance(batch, SampleBatch):
+            batch = SampleBatch(batch)
+        self._buffer.add_batch(batch)
+        return len(self._buffer)
+
+    def sample(self, batch_size: int, beta: float):
+        if len(self._buffer) < batch_size:
+            return None
+        return self._buffer.sample(batch_size, beta=beta)
+
+    def update_priorities(self, idx, priorities) -> bool:
+        self._buffer.update_priorities(np.asarray(idx),
+                                       np.asarray(priorities))
+        return True
+
+    def size(self) -> int:
+        return len(self._buffer)
+
+
+class ApexTrainer(DQNTrainer):
+    """reference: rllib/agents/dqn/apex.py apex_execution_plan."""
+
+    _default_config = APEX_CONFIG
+    _name = "APEX"
+
+    @staticmethod
+    def policy_builder(obs_space, action_space, config):
+        idx = config.get("worker_index", 0)
+        if idx > 0:
+            # rollout workers explore at a FIXED per-worker epsilon (no
+            # anneal): the spread covers explore/exploit across the
+            # fleet, pinned against the learner's weight broadcasts
+            # (pin_epsilon is a DQNPolicy config contract, and each
+            # worker's RNG stream is independent via worker_index)
+            policy = DQNPolicy(obs_space, action_space,
+                               {**config, "pin_epsilon": True})
+            n = max(1, config.get("num_workers", 1))
+            lo = config.get("worker_min_epsilon", 0.05)
+            hi = config.get("worker_max_epsilon", 0.6)
+            policy.set_epsilon(
+                lo + (hi - lo) * ((idx - 1) / max(1, n - 1)))
+        else:
+            policy = DQNPolicy(obs_space, action_space, config)
+            policy.set_epsilon(0.0)  # learner/eval copy acts greedily
+        return policy
+
+    def _make_buffer(self, config):
+        return None  # replaced by the shard actors
+
+    def setup(self, config):
+        super().setup(config)
+        n_shards = config["num_replay_buffer_shards"]
+        per_shard = max(1, config["buffer_size"] // n_shards)
+        seed = config.get("seed")
+        self._shards = [
+            ReplayShard.remote(per_shard,
+                               config.get("prioritized_replay_alpha", 0.6),
+                               None if seed is None else seed + i)
+            for i in range(n_shards)
+        ]
+        self._next_shard = 0
+        self._inflight_stores: list = []
+
+    def train_step(self) -> dict:
+        cfg = self.config
+        if not self.workers.remote_workers:
+            raise ValueError("APEX needs num_workers >= 1 rollout actors")
+        # 1. sampling: fragment refs flow worker -> shard without being
+        # materialized on the driver (the ref is the add_batch argument)
+        sample_refs = [w.sample.remote(cfg["rollout_fragment_length"])
+                       for w in self.workers.remote_workers]
+        for ref in sample_refs:
+            shard = self._shards[self._next_shard % len(self._shards)]
+            self._next_shard += 1
+            self._inflight_stores.append(shard.add_batch.remote(ref))
+        self._timesteps += (cfg["rollout_fragment_length"]
+                            * len(sample_refs))
+        # bound the store pipeline (backpressure, and surfacing errors)
+        if len(self._inflight_stores) >= 4 * len(self._shards):
+            ray_tpu.get(self._inflight_stores, timeout=120)
+            self._inflight_stores = []
+
+        sizes = ray_tpu.get([s.size.remote() for s in self._shards],
+                            timeout=60)
+        metrics = {"timesteps_total": self._timesteps,
+                   "buffer_size": int(sum(sizes)),
+                   "num_replay_shards": len(self._shards)}
+        if sum(sizes) < cfg["learning_starts"]:
+            return metrics
+
+        # 2. learner loop: round-robin sampled batches out of the
+        # shards, prefetching round i+1's sample before training on
+        # round i's batch so replay round-trips overlap learner compute
+        policy = self.workers.local_worker.policy
+        beta = cfg.get("prioritized_replay_beta", 0.4)
+        rounds = cfg["sgd_rounds_per_step"]
+
+        def request(i):
+            shard = self._shards[i % len(self._shards)]
+            return shard.sample.remote(cfg["train_batch_size"], beta)
+
+        trained = 0
+        pending = request(0)
+        for i in range(rounds):
+            replay = ray_tpu.get(pending, timeout=60)
+            if i + 1 < rounds:
+                pending = request(i + 1)
+            if replay is None:
+                continue
+            info = policy.learn_on_batch(replay)
+            self._shards[i % len(self._shards)].update_priorities.remote(
+                replay["batch_indexes"], info.pop("td_errors"))
+            trained += len(replay)
+            metrics.update(info)
+        metrics["num_env_steps_trained"] = trained
+
+        # 3. target sync + weight broadcast
+        if (self._timesteps - self._last_target_update
+                >= cfg.get("target_network_update_freq", 2000)):
+            self._last_target_update = self._timesteps
+            policy.update_target()
+        self.workers.sync_weights()
+        return metrics
+
+    def cleanup(self):
+        for s in getattr(self, "_shards", []):
+            try:
+                ray_tpu.kill(s)
+            except Exception:
+                pass
+        super().cleanup()

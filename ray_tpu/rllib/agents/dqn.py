@@ -62,7 +62,14 @@ class DQNPolicy(Policy):
         self._optimizer = optax.adam(config.get("lr", 5e-4))
         self.opt_state = self._optimizer.init(self.params)
         self.eps = float(config.get("exploration_initial_eps", 1.0))
-        self._rng = np.random.RandomState(seed + 1)
+        # fold the worker index into the exploration stream: workers
+        # must explore INDEPENDENTLY (identical streams make one
+        # worker's exploration a nested copy of another's)
+        self._rng = np.random.RandomState(
+            seed + 1 + 7919 * config.get("worker_index", 0))
+        # learner broadcasts must not overwrite a fixed per-worker
+        # epsilon (APEX's exploration spread)
+        self._pin_epsilon = bool(config.get("pin_epsilon", False))
         gamma = config.get("gamma", 0.99)
         double_q = bool(config.get("double_q", True))
         optimizer = self._optimizer
@@ -147,7 +154,11 @@ class DQNPolicy(Policy):
 
     def set_weights(self, weights):
         self.params = jax.tree.map(jnp.asarray, weights["q"])
-        self.eps = weights["eps"]
+        # APEX pins per-worker exploration epsilons: the learner's
+        # broadcast must not overwrite them (reference: apex.py
+        # per-worker epsilon schedule)
+        if not self._pin_epsilon:
+            self.eps = weights["eps"]
 
 
 class DQNTrainer(Trainer):
@@ -163,16 +174,20 @@ class DQNTrainer(Trainer):
 
     def setup(self, config):
         super().setup(config)
+        self._buffer = self._make_buffer(config)
+        self._timesteps = 0
+        self._last_target_update = 0
+
+    def _make_buffer(self, config):
+        """Overridable: APEX replaces the local buffer with shard actors
+        and returns None here."""
         if config.get("prioritized_replay", True):
-            self._buffer = PrioritizedReplayBuffer(
+            return PrioritizedReplayBuffer(
                 config["buffer_size"],
                 alpha=config.get("prioritized_replay_alpha", 0.6),
                 seed=config.get("seed"))
-        else:
-            self._buffer = ReplayBuffer(config["buffer_size"],
-                                        seed=config.get("seed"))
-        self._timesteps = 0
-        self._last_target_update = 0
+        return ReplayBuffer(config["buffer_size"],
+                            seed=config.get("seed"))
 
     def _epsilon(self) -> float:
         cfg = self.config

@@ -37,8 +37,12 @@ class RolloutWorker:
         self._completed_lengths: list[int] = []
         self._episode_lengths = [0] * num_envs
         builder = cloudpickle.loads(policy_builder)
+        # worker_index rides in the config so builders can vary per
+        # worker (e.g. APEX's spread of exploration epsilons)
         self.policy = builder(self.envs[0].observation_space,
-                              self.envs[0].action_space, self.config)
+                              self.envs[0].action_space,
+                              {**self.config,
+                               "worker_index": worker_index})
         # recurrent policies thread (h, c) per env across steps and
         # fragments (reference: rollout_worker's state_in/state_out cols)
         self._is_recurrent = getattr(self.policy, "is_recurrent", False)

@@ -194,3 +194,51 @@ def test_model_catalog_fcnet_and_convnet():
     # trainable end-to-end: grads flow through the conv stack
     g = jax.grad(lambda p: apply(p, obs).sum())(params)
     assert jnp.abs(g["conv"][0]["w"]).sum() > 0
+
+
+def test_apex_learns_cartpole(ray_start_shared):
+    """Ape-X: sharded replay ACTORS fed directly by rollout workers
+    (fragments flow worker->shard as ObjectRefs), per-worker pinned
+    exploration epsilons, learner pulls round-robin and pushes priority
+    updates back (reference: rllib/agents/dqn/apex.py)."""
+    import ray_tpu
+    from ray_tpu.rllib.agents.apex import ApexTrainer
+
+    trainer = ApexTrainer(config={
+        "env": "CartPole-v1",
+        "num_workers": 2,
+        "num_replay_buffer_shards": 2,
+        "rollout_fragment_length": 50,
+        "train_batch_size": 64,
+        "learning_starts": 400,
+        "sgd_rounds_per_step": 12,
+        "target_network_update_freq": 1000,
+        "lr": 1e-3,
+        "buffer_size": 50_000,
+        "seed": 0,
+    })
+    # distributed pieces actually exist
+    assert len(trainer._shards) == 2
+    best = 0.0
+    trained_total = 0
+    m = {}
+    for _ in range(30):
+        m = trainer.step()
+        trained_total += m.get("num_env_steps_trained", 0)
+        r = m.get("episode_reward_mean")
+        if r == r:
+            best = max(best, r)
+        # only a post-training reward counts as learning (early lucky
+        # episodes can spike before the learner has consumed anything)
+        if best > 100 and trained_total > 1500:
+            break
+    assert m["buffer_size"] >= 400, m
+    assert trained_total > 1500, m
+    # per-worker epsilons spread and survived weight broadcasts
+    eps = ray_tpu.get([w.get_weights.remote()
+                       for w in trainer.workers.remote_workers],
+                      timeout=60)
+    got = sorted(e["eps"] for e in eps)
+    assert got[0] != got[1], got
+    trainer.cleanup()
+    assert best > 100, f"APEX failed to learn CartPole (best={best})"
