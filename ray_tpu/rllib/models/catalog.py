@@ -22,6 +22,10 @@ MODEL_DEFAULTS: dict = {
     "use_lstm": False,
     "lstm_cell_size": 64,
     "max_seq_len": 20,
+    # attention wrapper (reference: models/tf/attention_net.py GTrXL):
+    # memory = a window of K past encodings attended over per step
+    "use_attention": False,
+    "attention_memory": 8,
 }
 
 _ACTS = {"tanh": jnp.tanh, "relu": jax.nn.relu,
@@ -150,6 +154,85 @@ class ModelCatalog:
             return out, state
 
         return init, step, seq, cell
+
+    # -- attention memory (reference: models/tf/attention_net.py) --------
+
+    @staticmethod
+    def get_attention_model(obs_space, num_outputs: int,
+                            config: dict | None = None):
+        """fc encoder → single-head attention over a K-slot memory of
+        past encodings → linear head. Same (init, step, seq, state
+        sizes) contract as get_recurrent_model, with state = (memory
+        [K*enc] flattened, validity [K]); resets zero both, which
+        empties the memory."""
+        cfg = ModelCatalog.get_model_config(config)
+        obs_dim = int(np.prod(obs_space.shape))
+        mem_k = int(cfg["attention_memory"])
+        enc_sizes = [obs_dim] + list(cfg["fcnet_hiddens"])
+        enc = enc_sizes[-1]
+        act = _ACTS[cfg["fcnet_activation"]]
+
+        def init(key):
+            k1, k2, k3, k4, k5 = jax.random.split(key, 5)
+            scale = 1.0 / math.sqrt(enc)
+            return {"enc": _fc_init(k1, enc_sizes),
+                    "attn": {
+                        "wq": jax.random.normal(k2, (enc, enc)) * scale,
+                        "wk": jax.random.normal(k3, (enc, enc)) * scale,
+                        "wv": jax.random.normal(k4, (enc, enc)) * scale,
+                    },
+                    "head": _fc_init(k5, [2 * enc, num_outputs])}
+
+        def _encode(params, obs):
+            return _fc_apply(params["enc"], obs, act, final_linear=False)
+
+        def _attend(params, e, mem, valid):
+            # e [B, enc]; mem [B, K, enc]; valid [B, K]
+            a = params["attn"]
+            q = e @ a["wq"]
+            k = mem @ a["wk"]
+            v = mem @ a["wv"]
+            scores = jnp.einsum("be,bke->bk", q, k) / math.sqrt(enc)
+            scores = jnp.where(valid > 0, scores, -1e30)
+            # empty memory (episode start): softmax over all -inf would
+            # NaN; zero the context instead
+            any_valid = (valid.sum(-1, keepdims=True) > 0)
+            probs = jax.nn.softmax(scores, axis=-1)
+            ctx = jnp.einsum("bk,bke->be", probs, v)
+            return jnp.where(any_valid, ctx, 0.0)
+
+        def _cell(params, obs, state):
+            mem_flat, valid = state
+            b = obs.shape[0]
+            mem = mem_flat.reshape(b, mem_k, enc)
+            e = _encode(params, obs.reshape(b, -1))
+            ctx = _attend(params, e, mem, valid)
+            out = _fc_apply(params["head"],
+                            jnp.concatenate([e, ctx], -1), act)
+            mem = jnp.concatenate([mem[:, 1:], e[:, None]], axis=1)
+            valid = jnp.concatenate(
+                [valid[:, 1:], jnp.ones((b, 1), valid.dtype)], axis=1)
+            return out, (mem.reshape(b, mem_k * enc), valid)
+
+        def step(params, obs, state):
+            return _cell(params, obs, state)
+
+        def seq(params, obs, state, resets):
+            xt = jnp.swapaxes(obs, 0, 1)      # [T, B, D]
+            rt = jnp.swapaxes(resets, 0, 1)   # [T, B]
+
+            def body(carry, inp):
+                mem, valid = carry
+                xi, ri = inp
+                keep = (1.0 - ri)[:, None]
+                out, (mem, valid) = _cell(
+                    params, xi, (mem * keep, valid * keep))
+                return (mem, valid), out
+
+            state, outs = jax.lax.scan(body, state, (xt, rt))
+            return jnp.swapaxes(outs, 0, 1), state
+
+        return init, step, seq, (mem_k * enc, mem_k)
 
     # -- visionnet (reference: models/catalog.py vision path) ------------
 

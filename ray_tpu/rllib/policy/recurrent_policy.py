@@ -58,11 +58,18 @@ class RecurrentPGPolicy(Policy):
             act_out = 2 * int(np.prod(action_space.shape))
         # one trunk, two outputs: [pi_out | value]
         self._act_out = act_out
-        init, step, seq, cell = ModelCatalog.get_recurrent_model(
-            observation_space, act_out + 1, merged)
+        if merged.get("use_attention"):
+            init, step, seq, sizes = ModelCatalog.get_attention_model(
+                observation_space, act_out + 1, merged)
+        else:
+            init, step, seq, cell = ModelCatalog.get_recurrent_model(
+                observation_space, act_out + 1, merged)
+            sizes = (cell, cell)
         self._step_fn = jax.jit(step)
         self._seq_fn = seq
-        self.cell_size = cell
+        # two state arrays per env (LSTM: h/c; attention: memory/valid)
+        self.state_sizes = tuple(sizes)
+        self.cell_size = sizes[0]
         seed = merged.get("seed") or 0
         self.params = init(jax.random.key(seed))
         self._optimizer = optax.adam(merged["lr"])
@@ -71,8 +78,7 @@ class RecurrentPGPolicy(Policy):
         self._build()
 
     def get_initial_state(self) -> list[np.ndarray]:
-        return [np.zeros(self.cell_size, np.float32),
-                np.zeros(self.cell_size, np.float32)]
+        return [np.zeros(s, np.float32) for s in self.state_sizes]
 
     # -- acting ----------------------------------------------------------
 
@@ -178,9 +184,10 @@ class RecurrentPGPolicy(Policy):
 
     def compute_actions(self, obs_batch, explore: bool = True):
         # stateless call (evaluate() greedy loops): zero state per call
-        h = np.zeros((len(obs_batch), self.cell_size), np.float32)
+        states = [np.zeros((len(obs_batch), s), np.float32)
+                  for s in self.state_sizes]
         acts, extra, _ = self.compute_actions_with_state(
-            obs_batch, [h, h.copy()], explore)
+            obs_batch, states, explore)
         return acts, extra
 
     def _value_after(self, obs_last, next_obs, h, c):
@@ -249,8 +256,8 @@ class RecurrentPGPolicy(Policy):
             "returns": np.zeros((s_n, max_t), np.float32),
             "resets": np.zeros((s_n, max_t), np.float32),
             "mask": np.zeros((s_n, max_t), np.float32),
-            "h0": np.zeros((s_n, self.cell_size), np.float32),
-            "c0": np.zeros((s_n, self.cell_size), np.float32),
+            "h0": np.zeros((s_n, self.state_sizes[0]), np.float32),
+            "c0": np.zeros((s_n, self.state_sizes[1]), np.float32),
         }
         for si, (s0, ln) in enumerate(seqs):
             sl = slice(s0, s0 + ln)
