@@ -1,0 +1,407 @@
+"""QMIX — cooperative multi-agent Q-learning with monotonic value
+factorization (reference: rllib/agents/qmix/qmix.py + qmix_policy.py;
+Rashid et al. 2018).
+
+Agents share one Q network (agent-id one-hot appended to the local
+observation, the standard parameter-sharing setup) and a hypernetwork
+mixer combines per-agent chosen-action Q values into Q_tot conditioned
+on the global state (concatenated observations), with abs() on the
+mixing weights enforcing monotonicity — so per-agent greedy argmax is
+also the Q_tot greedy joint action. One jitted TD step trains agent net
+and mixer end-to-end on the TEAM reward.
+
+QMIX needs TIME-ALIGNED joint transitions, which the per-agent
+MultiAgentBatch can't express — so this trainer runs its own joint
+sampler over the dict-style multi-agent env (fixed agent set)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ray_tpu.rllib.agents.trainer import COMMON_CONFIG, Trainer
+from ray_tpu.rllib.env import make_env
+from ray_tpu.rllib.policy.jax_policy import _mlp_apply, _mlp_init
+from ray_tpu.rllib.policy.policy import Policy
+
+QMIX_CONFIG = {
+    **COMMON_CONFIG,
+    "rollout_fragment_length": 32,
+    "train_batch_size": 64,
+    "buffer_size": 20_000,
+    "learning_starts": 500,
+    "sgd_rounds_per_step": 8,
+    "target_network_update_freq": 400,
+    "mixing_embed_dim": 32,
+    "lr": 5e-4,
+    "exploration_initial_eps": 1.0,
+    "exploration_final_eps": 0.05,
+    "total_timesteps_anneal": 10_000,
+    "exploration_fraction": 0.4,
+}
+
+
+class QMixPolicy(Policy):
+    """Shared agent Q net + hypernetwork mixer, one pytree."""
+
+    def __init__(self, observation_space, action_space, config: dict,
+                 n_agents: int):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        merged = {**QMIX_CONFIG, **config}
+        super().__init__(observation_space, action_space, merged)
+        if not hasattr(action_space, "n"):
+            raise ValueError("QMIX is discrete-action only")
+        self.discrete = True
+        self.n_agents = n_agents
+        obs_dim = int(np.prod(observation_space.shape))
+        self._obs_dim = obs_dim
+        n_act = int(action_space.n)
+        self._n_act = n_act
+        state_dim = obs_dim * n_agents
+        hiddens = list(merged.get("fcnet_hiddens", [64, 64]))
+        embed = merged["mixing_embed_dim"]
+        seed = merged.get("seed") or 0
+        keys = jax.random.split(jax.random.key(seed), 6)
+        self.params = {
+            # shared agent net over [obs ⊕ one-hot agent id]
+            "agent": _mlp_init(keys[0],
+                               [obs_dim + n_agents] + hiddens + [n_act]),
+            # hypernets: state -> mixing weights/biases (abs for
+            # monotonicity applied at use time)
+            "hw1": _mlp_init(keys[1], [state_dim, n_agents * embed]),
+            "hb1": _mlp_init(keys[2], [state_dim, embed]),
+            "hw2": _mlp_init(keys[3], [state_dim, embed]),
+            "hb2": _mlp_init(keys[4], [state_dim, embed, 1]),
+        }
+        self.target_params = jax.tree.map(jnp.copy, self.params)
+        self._optimizer = optax.adam(merged["lr"])
+        self.opt_state = self._optimizer.init(self.params)
+        self.eps = float(merged.get("exploration_initial_eps", 1.0))
+        self._rng = np.random.RandomState(seed + 17)
+        self._eye = np.eye(n_agents, dtype=np.float32)
+        self._build()
+
+    # -- nets ------------------------------------------------------------
+
+    @staticmethod
+    def _agent_q(params, obs_id):
+        """[B, n, obs+n] -> [B, n, n_act]."""
+        return _mlp_apply(params["agent"], obs_id)
+
+    @staticmethod
+    def _mix(params, q_chosen, state):
+        """Monotonic mixer: q_chosen [B, n], state [B, s] -> [B]."""
+        import jax.numpy as jnp
+
+        b, n = q_chosen.shape
+        embed_w1 = jnp.abs(_mlp_apply(params["hw1"], state))
+        w1 = embed_w1.reshape(b, n, -1)
+        b1 = _mlp_apply(params["hb1"], state)
+        hidden = jnp.einsum("bn,bne->be", q_chosen, w1) + b1
+        hidden = jnp.where(hidden > 0, hidden, 0.01 * hidden)  # elu-ish
+        w2 = jnp.abs(_mlp_apply(params["hw2"], state))
+        b2 = _mlp_apply(params["hb2"], state)[:, 0]
+        return jnp.einsum("be,be->b", hidden, w2) + b2
+
+    def _build(self):
+        import jax
+        import jax.numpy as jnp
+
+        gamma = self.config.get("gamma", 0.99)
+        optimizer = self._optimizer
+        n = self.n_agents
+
+        @jax.jit
+        def q_values(params, obs_id):
+            return QMixPolicy._agent_q(params, obs_id)
+
+        def loss_fn(params, target_params, batch):
+            obs_id = batch["obs_id"]          # [B, n, obs+n]
+            next_obs_id = batch["next_obs_id"]
+            state = batch["state"]            # [B, s]
+            next_state = batch["next_state"]
+            acts = batch["actions"]           # [B, n] int32
+            q_all = QMixPolicy._agent_q(params, obs_id)
+            q_chosen = jnp.take_along_axis(
+                q_all, acts[..., None], axis=-1)[..., 0]  # [B, n]
+            q_tot = QMixPolicy._mix(params, q_chosen, state)
+            q_next = QMixPolicy._agent_q(target_params, next_obs_id)
+            q_next_max = q_next.max(axis=-1)  # [B, n]
+            q_tot_next = QMixPolicy._mix(target_params, q_next_max,
+                                         next_state)
+            y = jax.lax.stop_gradient(
+                batch["rewards"] + gamma * (1.0 - batch["dones"])
+                * q_tot_next)
+            td = q_tot - y
+            return (td ** 2).mean(), {"td_mean_abs": jnp.abs(td).mean()}
+
+        @jax.jit
+        def train(params, target_params, opt_state, batch):
+            (loss, metrics), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, target_params, batch)
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            params = jax.tree.map(lambda p, u: p + u, params, updates)
+            return params, opt_state, loss, metrics
+
+        self._q_values = q_values
+        self._train = train
+
+    # -- acting ----------------------------------------------------------
+
+    def _obs_with_ids(self, obs_rows: np.ndarray) -> np.ndarray:
+        """[B, n, obs] -> [B, n, obs+n] with agent one-hots appended."""
+        b = obs_rows.shape[0]
+        ids = np.broadcast_to(self._eye, (b, *self._eye.shape))
+        return np.concatenate([obs_rows, ids], axis=-1).astype(np.float32)
+
+    def compute_joint_actions(self, obs_rows: np.ndarray,
+                              explore: bool = True) -> np.ndarray:
+        """obs_rows [B, n, obs] -> actions [B, n] (eps-greedy)."""
+        q = np.asarray(self._q_values(self.params,
+                                      self._obs_with_ids(obs_rows)))
+        acts = q.argmax(axis=-1)
+        if explore and self.eps > 0:
+            rand = self._rng.randint(0, self._n_act, acts.shape)
+            mask = self._rng.rand(*acts.shape) < self.eps
+            acts = np.where(mask, rand, acts)
+        return acts.astype(np.int64)
+
+    def compute_actions(self, obs_batch, explore: bool = True):
+        # Policy-surface adapter: rows are per-agent observations of a
+        # SINGLE timestep (used by evaluate()); greedy per-agent argmax
+        # is Q_tot-greedy by monotonicity
+        obs = np.asarray(obs_batch, np.float32).reshape(
+            1, len(obs_batch), -1)
+        acts = self.compute_joint_actions(obs, explore)[0]
+        from ray_tpu.rllib.policy.sample_batch import SampleBatch
+
+        return acts, {SampleBatch.ACTION_LOGP: np.zeros(len(obs_batch)),
+                      SampleBatch.VF_PREDS: np.zeros(len(obs_batch))}
+
+    def set_epsilon(self, eps: float):
+        self.eps = float(eps)
+
+    def update_target(self):
+        import jax
+        import jax.numpy as jnp
+
+        self.target_params = jax.tree.map(jnp.copy, self.params)
+
+    def learn_on_joint_batch(self, batch: dict) -> dict:
+        import jax.numpy as jnp
+
+        jb = {
+            "obs_id": jnp.asarray(self._obs_with_ids(batch["obs"])),
+            "next_obs_id": jnp.asarray(
+                self._obs_with_ids(batch["next_obs"])),
+            "state": jnp.asarray(batch["obs"].reshape(
+                len(batch["obs"]), -1), jnp.float32),
+            "next_state": jnp.asarray(batch["next_obs"].reshape(
+                len(batch["next_obs"]), -1), jnp.float32),
+            "actions": jnp.asarray(batch["actions"], jnp.int32),
+            "rewards": jnp.asarray(batch["rewards"], jnp.float32),
+            "dones": jnp.asarray(batch["dones"], jnp.float32),
+        }
+        self.params, self.opt_state, loss, metrics = self._train(
+            self.params, self.target_params, self.opt_state, jb)
+        out = {"total_loss": float(loss)}
+        out.update({k: float(v) for k, v in metrics.items()})
+        return out
+
+    def get_weights(self):
+        import jax
+
+        return {"params": jax.tree.map(np.asarray, self.params),
+                "target": jax.tree.map(np.asarray, self.target_params),
+                "eps": self.eps}
+
+    def set_weights(self, weights):
+        import jax
+        import jax.numpy as jnp
+
+        self.params = jax.tree.map(jnp.asarray, weights["params"])
+        self.target_params = jax.tree.map(jnp.asarray, weights["target"])
+        self.eps = weights["eps"]
+
+
+class _JointReplay:
+    """Ring buffer of time-aligned joint transitions."""
+
+    def __init__(self, capacity: int, seed=None):
+        self.capacity = capacity
+        self._rng = np.random.default_rng(seed)
+        self._cols: dict | None = None
+        self._n = 0
+        self._i = 0
+
+    def add(self, row: dict):
+        if self._cols is None:
+            self._cols = {k: np.zeros((self.capacity, *np.shape(v)),
+                                      np.asarray(v).dtype)
+                          for k, v in row.items()}
+        for k, v in row.items():
+            self._cols[k][self._i] = v
+        self._i = (self._i + 1) % self.capacity
+        self._n = min(self._n + 1, self.capacity)
+
+    def __len__(self):
+        return self._n
+
+    def sample(self, n: int) -> dict:
+        idx = self._rng.integers(0, self._n, n)
+        return {k: v[idx] for k, v in self._cols.items()}
+
+
+class QMixTrainer(Trainer):
+    """reference: rllib/agents/qmix/qmix.py execution plan, with a joint
+    sampler instead of per-agent batches."""
+
+    _default_config = QMIX_CONFIG
+    _name = "QMIX"
+    _supports_multiagent = True  # it IS the multi-agent trainer
+
+    def setup(self, config):
+        if config.get("env") is None:
+            raise ValueError("config['env'] must be set")
+        self.env = make_env(config["env"], config.get("env_config", {}))
+        seed = config.get("seed")
+        obs, _ = self.env.reset(seed=seed)
+        self._agent_ids = sorted(obs.keys())
+        self._obs = obs
+        self.policy = QMixPolicy(
+            self.env.observation_space, self.env.action_space, config,
+            n_agents=len(self._agent_ids))
+        self._buffer = _JointReplay(config["buffer_size"], seed=seed)
+        self._timesteps = 0
+        self._last_target_update = 0
+        self._episode_reward = 0.0
+        self._completed: list[float] = []
+
+    def _rows(self, obs_dict) -> np.ndarray:
+        return np.stack([np.asarray(obs_dict[a], np.float32).ravel()
+                         for a in self._agent_ids])
+
+    def _epsilon(self) -> float:
+        cfg = self.config
+        anneal = (cfg["total_timesteps_anneal"]
+                  * cfg["exploration_fraction"])
+        frac = min(1.0, self._timesteps / max(1, anneal))
+        e0, e1 = (cfg["exploration_initial_eps"],
+                  cfg["exploration_final_eps"])
+        return e0 + frac * (e1 - e0)
+
+    def train_step(self) -> dict:
+        cfg = self.config
+        self.policy.set_epsilon(self._epsilon())
+        for _ in range(cfg["rollout_fragment_length"]):
+            rows = self._rows(self._obs)
+            acts = self.policy.compute_joint_actions(rows[None])[0]
+            action_dict = {a: int(acts[i])
+                           for i, a in enumerate(self._agent_ids)}
+            next_obs, rewards, terminated, truncated, _ = self.env.step(
+                action_dict)
+            done = bool(terminated.get("__all__")
+                        or truncated.get("__all__"))
+            team_r = float(sum(rewards.values()))
+            self._episode_reward += team_r
+            next_rows = (rows if done and not next_obs
+                         else self._rows(next_obs)
+                         if set(next_obs) >= set(self._agent_ids)
+                         else rows)
+            self._buffer.add({
+                "obs": rows, "next_obs": next_rows, "actions": acts,
+                "rewards": team_r,
+                "dones": float(bool(terminated.get("__all__"))),
+            })
+            self._timesteps += 1
+            if done:
+                self._completed.append(self._episode_reward)
+                self._episode_reward = 0.0
+                next_obs, _ = self.env.reset()
+            self._obs = next_obs
+        metrics = {"timesteps_total": self._timesteps,
+                   "epsilon": round(self.policy.eps, 4),
+                   "buffer_size": len(self._buffer)}
+        if len(self._buffer) >= cfg["learning_starts"]:
+            for _ in range(cfg["sgd_rounds_per_step"]):
+                metrics.update(self.policy.learn_on_joint_batch(
+                    self._buffer.sample(cfg["train_batch_size"])))
+            if (self._timesteps - self._last_target_update
+                    >= cfg["target_network_update_freq"]):
+                self._last_target_update = self._timesteps
+                self.policy.update_target()
+        return metrics
+
+    def step(self) -> dict:
+        metrics = self.train_step()
+        if self._completed:
+            metrics["episode_reward_mean"] = float(
+                np.mean(self._completed[-50:]))
+            metrics["episodes_total"] = len(self._completed)
+        interval = self.config.get("evaluation_interval") or 0
+        if interval and (self.iteration + 1) % interval == 0:
+            metrics["evaluation"] = self.evaluate()
+        return metrics
+
+    def get_policy(self, policy_id=None):
+        return self.policy
+
+    def evaluate(self, num_episodes: int | None = None) -> dict:
+        """Greedy joint-policy episodes on a fresh env (the base
+        Trainer's evaluate() assumes a WorkerSet this trainer doesn't
+        have)."""
+        n = (self.config.get("evaluation_num_episodes", 5)
+             if num_episodes is None else num_episodes)
+        env = make_env(self.config["env"],
+                       self.config.get("env_config", {}))
+        rewards, lengths = [], []
+        try:
+            for _ in range(n):
+                obs, _ = env.reset()
+                total, steps, done = 0.0, 0, False
+                while not done and steps < 10_000:
+                    rows = self._rows(obs)[None]
+                    acts = self.policy.compute_joint_actions(
+                        rows, explore=False)[0]
+                    obs, rew, term, trunc, _ = env.step(
+                        {a: int(acts[i])
+                         for i, a in enumerate(self._agent_ids)})
+                    total += float(sum(rew.values()))
+                    steps += 1
+                    done = bool(term.get("__all__")
+                                or trunc.get("__all__"))
+                rewards.append(total)
+                lengths.append(steps)
+        finally:
+            try:
+                env.close()
+            except Exception:
+                pass
+        return {"episode_reward_mean": float(np.mean(rewards)),
+                "episode_len_mean": float(np.mean(lengths)),
+                "episodes": n}
+
+    def compute_action(self, obs, explore: bool = False):
+        """Joint action for one timestep's obs dict -> action dict."""
+        if not isinstance(obs, dict):
+            raise ValueError(
+                "QMIX acts jointly: pass the env's obs dict "
+                "({agent_id: obs}); per-agent scalars have no meaning "
+                "through the mixer")
+        acts = self.policy.compute_joint_actions(
+            self._rows(obs)[None], explore=explore)[0]
+        return {a: int(acts[i]) for i, a in enumerate(self._agent_ids)}
+
+    def save_checkpoint(self, checkpoint_dir):
+        return {"weights": self.policy.get_weights()}
+
+    def load_checkpoint(self, state):
+        self.policy.set_weights(state["weights"])
+
+    def cleanup(self):
+        try:
+            self.env.close()
+        except Exception:
+            pass

@@ -201,11 +201,11 @@ class ModelCatalog:
             ctx = jnp.einsum("bk,bke->be", probs, v)
             return jnp.where(any_valid, ctx, 0.0)
 
-        def _cell(params, obs, state):
+        def _cell_from_enc(params, e, state):
+            """e [B, enc] (pre-encoded observation)."""
             mem_flat, valid = state
-            b = obs.shape[0]
+            b = e.shape[0]
             mem = mem_flat.reshape(b, mem_k, enc)
-            e = _encode(params, obs.reshape(b, -1))
             ctx = _attend(params, e, mem, valid)
             out = _fc_apply(params["head"],
                             jnp.concatenate([e, ctx], -1), act)
@@ -215,21 +215,25 @@ class ModelCatalog:
             return out, (mem.reshape(b, mem_k * enc), valid)
 
         def step(params, obs, state):
-            return _cell(params, obs, state)
+            e = _encode(params, obs.reshape(obs.shape[0], -1))
+            return _cell_from_enc(params, e, state)
 
         def seq(params, obs, state, resets):
-            xt = jnp.swapaxes(obs, 0, 1)      # [T, B, D]
+            # encoder has no time dependency: one batched [B*T] matmul
+            # outside the scan (only the memory update scans)
+            e_seq = _encode(params, obs)      # [B, T, enc]
+            et = jnp.swapaxes(e_seq, 0, 1)    # [T, B, enc]
             rt = jnp.swapaxes(resets, 0, 1)   # [T, B]
 
             def body(carry, inp):
                 mem, valid = carry
-                xi, ri = inp
+                ei, ri = inp
                 keep = (1.0 - ri)[:, None]
-                out, (mem, valid) = _cell(
-                    params, xi, (mem * keep, valid * keep))
+                out, (mem, valid) = _cell_from_enc(
+                    params, ei, (mem * keep, valid * keep))
                 return (mem, valid), out
 
-            state, outs = jax.lax.scan(body, state, (xt, rt))
+            state, outs = jax.lax.scan(body, state, (et, rt))
             return jnp.swapaxes(outs, 0, 1), state
 
         return init, step, seq, (mem_k * enc, mem_k)

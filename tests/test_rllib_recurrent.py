@@ -219,3 +219,80 @@ def test_attention_policy_learns_memory_task(ray_start_shared):
     trainer.cleanup()
     assert best > 0.85, (
         f"attention failed the memory task (best={best}; chance is 0.5)")
+
+
+class CoopSignalEnv:
+    """Cooperative 2-agent env: both agents see a broadcast bit and the
+    TEAM earns 1.0 only when BOTH echo it (pure joint credit — no
+    per-agent reward shaping). One-step episodes; chance is 0.25."""
+
+    observation_space = gymnasium.spaces.Box(0, 1, (1,), np.float32)
+    action_space = gymnasium.spaces.Discrete(2)
+
+    def __init__(self, config=None):
+        self._rng = np.random.default_rng(0)
+        self._sig = 0
+
+    def _obs(self):
+        return {a: np.array([self._sig], np.float32)
+                for a in ("a0", "a1")}
+
+    def reset(self, seed=None):
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._sig = int(self._rng.integers(2))
+        return self._obs(), {}
+
+    def step(self, actions):
+        ok = all(int(actions[a]) == self._sig for a in ("a0", "a1"))
+        r = 1.0 if ok else 0.0
+        rewards = {"a0": r / 2, "a1": r / 2}  # team total = r
+        self._sig = int(self._rng.integers(2))
+        return (self._obs(), rewards, {"__all__": True},
+                {"__all__": False}, {})
+
+    def close(self):
+        pass
+
+
+def test_qmix_learns_cooperative_signal(ray_start_shared):
+    """QMIX (monotonic value factorization over a shared agent net) must
+    learn the joint echo policy from TEAM reward only (reference:
+    rllib/agents/qmix/qmix.py; Rashid et al. 2018)."""
+    from ray_tpu.rllib.agents.qmix import QMixTrainer
+
+    trainer = QMixTrainer(config={
+        "env": CoopSignalEnv,
+        "rollout_fragment_length": 64,
+        "train_batch_size": 64,
+        "learning_starts": 200,
+        "sgd_rounds_per_step": 8,
+        "target_network_update_freq": 200,
+        "lr": 3e-3,
+        "total_timesteps_anneal": 3000,
+        "exploration_fraction": 0.5,
+        "fcnet_hiddens": [32],
+        "mixing_embed_dim": 16,
+        "seed": 0,
+    })
+    best = 0.0
+    for _ in range(40):
+        m = trainer.step()
+        r = m.get("episode_reward_mean")
+        if r == r and m.get("buffer_size", 0) > 200:
+            best = max(best, r)
+        if best > 0.9:
+            break
+    # greedy joint action matches the signal for both values
+    pol = trainer.get_policy()
+    for sig in (0.0, 1.0):
+        rows = np.full((1, 2, 1), sig, np.float32)
+        acts = pol.compute_joint_actions(rows, explore=False)[0]
+        assert (acts == int(sig)).all(), (sig, acts)
+    # trainer surface: greedy evaluation + joint compute_action
+    ev = trainer.evaluate(num_episodes=3)
+    assert ev["episode_reward_mean"] > 0.9, ev
+    obs = {a: np.array([1.0], np.float32) for a in ("a0", "a1")}
+    assert trainer.compute_action(obs) == {"a0": 1, "a1": 1}
+    trainer.cleanup()
+    assert best > 0.9, f"QMIX failed the coop task (best={best})"
