@@ -1,0 +1,44 @@
+"""CQL — conservative Q-learning for PURELY OFFLINE RL (reference:
+rllib/agents/cql (later snapshots) / the offline-RL role the reference's
+offline IO feeds; Kumar et al. 2020).
+
+Discrete CQL on the DQN machinery: the TD loss gains
+alpha * (logsumexp_a Q(s,·) − Q(s, a_data)), pushing down
+out-of-distribution action values so the greedy policy stays inside the
+dataset's support. The trainer never steps an env: rollout "sampling"
+reads the offline dataset (config["input"], the JsonReader path the
+rollout worker already understands), and config["env"] is used only for
+observation/action spaces and greedy evaluation."""
+
+from __future__ import annotations
+
+from ray_tpu.rllib.agents.dqn import DQN_CONFIG, DQNTrainer
+
+CQL_CONFIG = {
+    **DQN_CONFIG,
+    "cql_alpha": 1.0,
+    "input": None,               # REQUIRED: offline dataset path
+    # no exploration/anneal — actions are never taken in an env
+    "exploration_initial_eps": 0.0,
+    "exploration_final_eps": 0.0,
+    "learning_starts": 200,
+    "sgd_rounds_per_step": 16,
+}
+
+
+class CQLTrainer(DQNTrainer):
+    """DQN execution plan with the dataset as the only experience
+    source and the conservative penalty active."""
+
+    _default_config = CQL_CONFIG
+    _name = "CQL"
+
+    def setup(self, config):
+        if not config.get("input") or config["input"] == "sampler":
+            raise ValueError(
+                "CQL is offline-only: set config['input'] to the "
+                "dataset path (JsonWriter output)")
+        if float(config.get("cql_alpha", 0.0)) <= 0:
+            raise ValueError("CQL needs cql_alpha > 0 — with 0 this is "
+                             "plain offline DQN")
+        super().setup(config)

@@ -72,6 +72,11 @@ class DQNPolicy(Policy):
         self._pin_epsilon = bool(config.get("pin_epsilon", False))
         gamma = config.get("gamma", 0.99)
         double_q = bool(config.get("double_q", True))
+        # conservative Q-learning penalty (reference: agents/cql —
+        # Kumar et al. 2020): alpha * (logsumexp_a Q(s,·) − Q(s, a_data))
+        # pushes down out-of-distribution action values, which is what
+        # makes PURELY OFFLINE training stable
+        cql_alpha = float(config.get("cql_alpha", 0.0))
         optimizer = self._optimizer
 
         @jax.jit
@@ -98,20 +103,26 @@ class DQNPolicy(Policy):
             targets = jax.lax.stop_gradient(targets)
 
             def loss_fn(p):
+                q_all = _mlp_apply(p, obs)
                 q = jnp.take_along_axis(
-                    _mlp_apply(p, obs), actions[:, None], axis=-1)[:, 0]
+                    q_all, actions[:, None], axis=-1)[:, 0]
                 td = q - targets
                 huber = jnp.where(jnp.abs(td) < 1.0, 0.5 * td * td,
                                   jnp.abs(td) - 0.5)
                 if weights is not None:
                     huber = huber * weights
-                return huber.mean(), td
+                loss = huber.mean()
+                cql = (jax.scipy.special.logsumexp(q_all, axis=-1)
+                       - q).mean()
+                if cql_alpha > 0:
+                    loss = loss + cql_alpha * cql
+                return loss, (td, cql)
 
-            (loss, td), grads = jax.value_and_grad(
+            (loss, (td, cql)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params)
             updates, opt_state = optimizer.update(grads, opt_state, params)
             params = jax.tree.map(lambda p, u: p + u, params, updates)
-            return params, opt_state, loss, td
+            return params, opt_state, loss, (td, cql)
 
         self._q_values = q_values
         self._td_step = td_step
@@ -137,9 +148,10 @@ class DQNPolicy(Policy):
     def learn_on_batch(self, batch: SampleBatch) -> dict:
         jb = {k: jnp.asarray(v) for k, v in batch.items()
               if k != "batch_indexes" and v.dtype != object}
-        self.params, self.opt_state, loss, td = self._td_step(
+        self.params, self.opt_state, loss, (td, cql) = self._td_step(
             self.params, self.target_params, self.opt_state, jb)
-        return {"loss": float(loss), "td_errors": np.asarray(td)}
+        return {"loss": float(loss), "cql_gap": float(cql),
+                "td_errors": np.asarray(td)}
 
     def update_target(self):
         self.target_params = jax.tree.map(jnp.copy, self.params)
@@ -159,6 +171,17 @@ class DQNPolicy(Policy):
         # per-worker epsilon schedule)
         if not self._pin_epsilon:
             self.eps = weights["eps"]
+
+
+def linear_epsilon(config: dict, timesteps: int) -> float:
+    """Shared linear exploration anneal (reference: dqn.py exploration
+    schedule); used by DQN and QMIX."""
+    anneal = (config.get("total_timesteps_anneal", 25_000)
+              * config.get("exploration_fraction", 0.1))
+    frac = min(1.0, timesteps / max(1, anneal))
+    e0 = config.get("exploration_initial_eps", 1.0)
+    e1 = config.get("exploration_final_eps", 0.02)
+    return e0 + frac * (e1 - e0)
 
 
 class DQNTrainer(Trainer):
@@ -190,13 +213,7 @@ class DQNTrainer(Trainer):
                             seed=config.get("seed"))
 
     def _epsilon(self) -> float:
-        cfg = self.config
-        anneal = (cfg.get("total_timesteps_anneal", 25_000)
-                  * cfg.get("exploration_fraction", 0.1))
-        frac = min(1.0, self._timesteps / max(1, anneal))
-        e0 = cfg.get("exploration_initial_eps", 1.0)
-        e1 = cfg.get("exploration_final_eps", 0.02)
-        return e0 + frac * (e1 - e0)
+        return linear_epsilon(self.config, self._timesteps)
 
     def train_step(self) -> dict:
         cfg = self.config

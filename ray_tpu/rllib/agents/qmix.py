@@ -19,6 +19,7 @@ from __future__ import annotations
 import numpy as np
 
 from ray_tpu.rllib.agents.trainer import COMMON_CONFIG, Trainer
+from ray_tpu.rllib.execution.replay_buffer import ReplayBuffer
 from ray_tpu.rllib.env import make_env
 from ray_tpu.rllib.policy.jax_policy import _mlp_apply, _mlp_init
 from ray_tpu.rllib.policy.policy import Policy
@@ -226,34 +227,6 @@ class QMixPolicy(Policy):
         self.eps = weights["eps"]
 
 
-class _JointReplay:
-    """Ring buffer of time-aligned joint transitions."""
-
-    def __init__(self, capacity: int, seed=None):
-        self.capacity = capacity
-        self._rng = np.random.default_rng(seed)
-        self._cols: dict | None = None
-        self._n = 0
-        self._i = 0
-
-    def add(self, row: dict):
-        if self._cols is None:
-            self._cols = {k: np.zeros((self.capacity, *np.shape(v)),
-                                      np.asarray(v).dtype)
-                          for k, v in row.items()}
-        for k, v in row.items():
-            self._cols[k][self._i] = v
-        self._i = (self._i + 1) % self.capacity
-        self._n = min(self._n + 1, self.capacity)
-
-    def __len__(self):
-        return self._n
-
-    def sample(self, n: int) -> dict:
-        idx = self._rng.integers(0, self._n, n)
-        return {k: v[idx] for k, v in self._cols.items()}
-
-
 class QMixTrainer(Trainer):
     """reference: rllib/agents/qmix/qmix.py execution plan, with a joint
     sampler instead of per-agent batches."""
@@ -273,7 +246,10 @@ class QMixTrainer(Trainer):
         self.policy = QMixPolicy(
             self.env.observation_space, self.env.action_space, config,
             n_agents=len(self._agent_ids))
-        self._buffer = _JointReplay(config["buffer_size"], seed=seed)
+        # time-aligned JOINT transitions ride the standard ring buffer:
+        # each env step is a one-row SampleBatch whose columns carry the
+        # [n_agents, ...] joint arrays
+        self._buffer = ReplayBuffer(config["buffer_size"], seed=seed)
         self._timesteps = 0
         self._last_target_update = 0
         self._episode_reward = 0.0
@@ -284,13 +260,9 @@ class QMixTrainer(Trainer):
                          for a in self._agent_ids])
 
     def _epsilon(self) -> float:
-        cfg = self.config
-        anneal = (cfg["total_timesteps_anneal"]
-                  * cfg["exploration_fraction"])
-        frac = min(1.0, self._timesteps / max(1, anneal))
-        e0, e1 = (cfg["exploration_initial_eps"],
-                  cfg["exploration_final_eps"])
-        return e0 + frac * (e1 - e0)
+        from ray_tpu.rllib.agents.dqn import linear_epsilon
+
+        return linear_epsilon(self.config, self._timesteps)
 
     def train_step(self) -> dict:
         cfg = self.config
@@ -306,15 +278,26 @@ class QMixTrainer(Trainer):
                         or truncated.get("__all__"))
             team_r = float(sum(rewards.values()))
             self._episode_reward += team_r
-            next_rows = (rows if done and not next_obs
-                         else self._rows(next_obs)
-                         if set(next_obs) >= set(self._agent_ids)
-                         else rows)
-            self._buffer.add({
-                "obs": rows, "next_obs": next_rows, "actions": acts,
-                "rewards": team_r,
-                "dones": float(bool(terminated.get("__all__"))),
-            })
+            if done and not next_obs:
+                next_rows = rows  # terminal step with no further obs
+            elif set(next_obs) >= set(self._agent_ids):
+                next_rows = self._rows(next_obs)
+            else:
+                raise ValueError(
+                    "QMIX requires a FIXED agent set every step; env "
+                    f"returned obs for {sorted(next_obs)} but the "
+                    f"episode declares agents {self._agent_ids} "
+                    "(early-exiting agents are not supported)")
+            from ray_tpu.rllib.policy.sample_batch import SampleBatch
+
+            self._buffer.add_batch(SampleBatch({
+                "obs": rows[None], "next_obs": next_rows[None],
+                "actions": acts[None],
+                "rewards": np.array([team_r], np.float32),
+                "dones": np.array(
+                    [float(bool(terminated.get("__all__")))],
+                    np.float32),
+            }))
             self._timesteps += 1
             if done:
                 self._completed.append(self._episode_reward)
