@@ -1,0 +1,241 @@
+"""MAML — model-agnostic meta-learning for RL (reference:
+rllib/agents/maml (later snapshots); Finn et al. 2017).
+
+This is where the jax-native design pays off directly: the inner
+adaptation step is a literal `jax.grad` composition and the outer
+meta-gradient differentiates THROUGH it — one jitted function computes
+θ'_i = θ − α·∇L(pre_i, θ) per task and backprops the post-adaptation
+policy-gradient loss to θ. The reference needs explicit higher-order
+torch autograd plumbing for the same math.
+
+Task protocol (reference MAML env API): the env exposes
+`sample_tasks(n)` and `set_task(task)`; each train step samples a task
+batch, collects a PRE batch per task with θ, adapts, collects a POST
+batch with θ'_i, and applies one outer Adam step on the summed
+post-adaptation loss."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ray_tpu.rllib.agents.pg import discounted_returns
+from ray_tpu.rllib.agents.trainer import COMMON_CONFIG, Trainer
+from ray_tpu.rllib.env import make_env
+from ray_tpu.rllib.policy.jax_policy import (JAXPolicy, categorical_logp,
+                                             gaussian_logp)
+
+MAML_CONFIG = {
+    **COMMON_CONFIG,
+    "num_tasks_per_step": 4,
+    "inner_lr": 0.5,
+    "inner_rollout_steps": 64,
+    "lr": 1e-2,                 # outer (meta) Adam lr
+    "gamma": 0.99,
+}
+
+
+class MAMLTrainer(Trainer):
+    """Driver-local meta-training loop (tasks are cheap envs; the meta
+    math is the point). Reuses JAXPolicy's model/act machinery."""
+
+    _default_config = MAML_CONFIG
+    _name = "MAML"
+
+    @staticmethod
+    def policy_builder(obs_space, action_space, config):
+        return JAXPolicy(obs_space, action_space, config)
+
+    def setup(self, config):
+        if config.get("env") is None:
+            raise ValueError("config['env'] must be set")
+        self.env = make_env(config["env"], config.get("env_config", {}))
+        if not hasattr(self.env, "sample_tasks") or not hasattr(
+                self.env, "set_task"):
+            raise ValueError(
+                "MAML needs a task-distribution env exposing "
+                "sample_tasks(n) and set_task(task) (the reference MAML "
+                "env API)")
+        self.policy = JAXPolicy(self.env.observation_space,
+                                self.env.action_space, config)
+        self._build_meta()
+        self._timesteps = 0
+        self._completed: list[float] = []
+
+    def _build_meta(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        cfg = self.config
+        inner_lr = cfg["inner_lr"]
+        discrete = self.policy.discrete
+        logp_fn = categorical_logp if discrete else gaussian_logp
+
+        def pg_loss(params, batch):
+            pi_out, _ = JAXPolicy.model_out(params, batch["obs"])
+            logp = logp_fn(pi_out, batch["actions"])
+            adv = batch["returns"]
+            adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+            return -(logp * adv).mean()
+
+        def adapt(params, pre_batch):
+            """θ' = θ − α∇L(pre, θ) — the inner step, differentiable."""
+            grads = jax.grad(pg_loss)(params, pre_batch)
+            return jax.tree.map(lambda p, g: p - inner_lr * g, params,
+                                grads)
+
+        def meta_loss(params, pre_batches, post_batches):
+            losses = [
+                pg_loss(adapt(params, pre), post)
+                for pre, post in zip(pre_batches, post_batches)
+            ]
+            return jnp.stack(losses).mean()
+
+        self._meta_optimizer = optax.adam(cfg["lr"])
+        self._meta_opt_state = self._meta_optimizer.init(
+            self.policy.params)
+
+        @jax.jit
+        def meta_step(params, opt_state, pre_batches, post_batches):
+            loss, grads = jax.value_and_grad(meta_loss)(
+                params, pre_batches, post_batches)
+            updates, opt_state = self._meta_optimizer.update(
+                grads, opt_state, params)
+            params = jax.tree.map(lambda p, u: p + u, params, updates)
+            return params, opt_state, loss
+
+        self._adapt = jax.jit(adapt)
+        self._meta_step = meta_step
+
+    # -- rollouts --------------------------------------------------------
+
+    def _collect(self, n_steps: int) -> dict:
+        """One on-policy fragment on the CURRENT env task with the
+        CURRENT policy params; returns jit-ready columns."""
+        import jax.numpy as jnp
+
+        obs_l, act_l, rew_l, done_l = [], [], [], []
+        obs, _ = self.env.reset()
+        ep_reward = 0.0
+        for _ in range(n_steps):
+            acts, _extra = self.policy.compute_actions(
+                np.asarray(obs, np.float32).ravel()[None])
+            act = acts[0]
+            env_act = int(act) if self.policy.discrete else act
+            nxt, r, term, trunc, _ = self.env.step(env_act)
+            obs_l.append(np.asarray(obs, np.float32).ravel())
+            act_l.append(act)
+            rew_l.append(np.float32(r))
+            # truncation counts as done HERE: plain discounted returns
+            # have no value bootstrap, so letting the next episode's
+            # rewards discount backward across a reset would bias both
+            # gradients (rollout_worker keeps trunc done=False only
+            # because GAE bootstraps the tail)
+            done_l.append(bool(term or trunc))
+            ep_reward += float(r)
+            self._timesteps += 1
+            if term or trunc:
+                self._completed.append(ep_reward)
+                ep_reward = 0.0
+                nxt, _ = self.env.reset()
+            obs = nxt
+        returns = discounted_returns(
+            np.asarray(rew_l, np.float64), np.asarray(done_l, np.float64),
+            self.config["gamma"])
+        return {"obs": jnp.asarray(np.stack(obs_l)),
+                "actions": jnp.asarray(np.stack(act_l)),
+                "returns": jnp.asarray(returns),
+                "reward_mean": float(np.mean(rew_l))}
+
+    def train_step(self) -> dict:
+        cfg = self.config
+        tasks = self.env.sample_tasks(cfg["num_tasks_per_step"])
+        theta = self.policy.params
+        pre_batches, post_batches = [], []
+        pre_r, post_r = [], []
+        for task in tasks:
+            self.env.set_task(task)
+            self.policy.params = theta
+            pre = self._collect(cfg["inner_rollout_steps"])
+            # pop metrics BEFORE the jit boundary: both _adapt call
+            # sites must share one pytree structure (one compilation)
+            pre_r.append(pre.pop("reward_mean"))
+            adapted = self._adapt(theta, pre)
+            self.policy.params = adapted
+            post = self._collect(cfg["inner_rollout_steps"])
+            post_r.append(post.pop("reward_mean"))
+            pre_batches.append(pre)
+            post_batches.append(post)
+        self.policy.params = theta
+        (self.policy.params, self._meta_opt_state,
+         loss) = self._meta_step(theta, self._meta_opt_state,
+                                 pre_batches, post_batches)
+        return {
+            "meta_loss": float(loss),
+            "timesteps_total": self._timesteps,
+            "pre_adaptation_reward": float(np.mean(pre_r)),
+            "post_adaptation_reward": float(np.mean(post_r)),
+        }
+
+    def step(self) -> dict:
+        metrics = self.train_step()
+        if self._completed:
+            metrics["episode_reward_mean"] = float(
+                np.mean(self._completed[-100:]))
+        interval = self.config.get("evaluation_interval") or 0
+        if interval and (self.iteration + 1) % interval == 0:
+            metrics["evaluation"] = self.evaluate()
+        return metrics
+
+    def evaluate(self, num_episodes: int | None = None) -> dict:
+        """ZERO-SHOT greedy evaluation of the meta-init θ across fresh
+        tasks (the base Trainer's evaluate assumes a WorkerSet this
+        trainer doesn't have); per-task ADAPTED performance is the
+        post_adaptation_reward train metric / adapt_to()."""
+        n = (self.config.get("evaluation_num_episodes", 5)
+             if num_episodes is None else num_episodes)
+        rewards, lengths = [], []
+        theta = self.policy.params
+        for task in self.env.sample_tasks(n):
+            self.env.set_task(task)
+            obs, _ = self.env.reset()
+            total, steps, done = 0.0, 0, False
+            while not done and steps < 10_000:
+                acts, _ = self.policy.compute_actions(
+                    np.asarray(obs, np.float32).ravel()[None],
+                    explore=False)
+                act = int(acts[0]) if self.policy.discrete else acts[0]
+                obs, r, term, trunc, _ = self.env.step(act)
+                total += float(r)
+                steps += 1
+                done = bool(term or trunc)
+            rewards.append(total)
+            lengths.append(steps)
+        self.policy.params = theta
+        return {"episode_reward_mean": float(np.mean(rewards)),
+                "episode_len_mean": float(np.mean(lengths)),
+                "episodes": n}
+
+    def adapt_to(self, task, n_steps: int | None = None):
+        """Deploy-time adaptation: one inner step on a fresh task;
+        returns the adapted params (θ is left untouched)."""
+        self.env.set_task(task)
+        theta = self.policy.params
+        pre = self._collect(n_steps or self.config["inner_rollout_steps"])
+        pre.pop("reward_mean")
+        return self._adapt(theta, pre)
+
+    def get_policy(self, policy_id=None):
+        return self.policy
+
+    def save_checkpoint(self, checkpoint_dir):
+        return {"weights": self.policy.get_weights()}
+
+    def load_checkpoint(self, state):
+        self.policy.set_weights(state["weights"])
+
+    def cleanup(self):
+        try:
+            self.env.close()
+        except Exception:
+            pass

@@ -374,3 +374,73 @@ def test_cql_learns_purely_offline(ray_start_shared, tmp_path):
     ev = trainer.evaluate(num_episodes=20)
     trainer.cleanup()
     assert ev["episode_reward_mean"] > 0.9, ev
+
+
+class FlipBanditTasks:
+    """MAML task distribution: each task flips which of 2 arms pays.
+    Zero-shot a single policy caps at 0.5 average across tasks; one
+    adaptation step on task data should approach 1.0."""
+
+    observation_space = gymnasium.spaces.Box(0, 1, (1,), np.float32)
+    action_space = gymnasium.spaces.Discrete(2)
+
+    def __init__(self, config=None):
+        self._rng = np.random.default_rng(0)
+        self._task = 0
+
+    def sample_tasks(self, n):
+        return [int(self._rng.integers(2)) for _ in range(n)]
+
+    def set_task(self, task):
+        self._task = int(task)
+
+    def reset(self, seed=None):
+        return np.ones(1, np.float32), {}
+
+    def step(self, action):
+        r = 1.0 if int(action) == self._task else 0.0
+        return np.ones(1, np.float32), r, True, False, {}
+
+    def close(self):
+        pass
+
+
+def test_maml_meta_learns_fast_adaptation(ray_start_shared):
+    """MAML: the outer objective is POST-adaptation reward — after meta
+    training, ONE inner gradient step on a new task's data must solve it
+    while the un-adapted policy stays near chance (reference:
+    rllib/agents/maml; Finn et al. 2017 — here the inner step is a
+    literal jax.grad composition differentiated through)."""
+    from ray_tpu.rllib.agents.maml import MAMLTrainer
+
+    trainer = MAMLTrainer(config={
+        "env": FlipBanditTasks,
+        "num_tasks_per_step": 4,
+        "inner_rollout_steps": 32,
+        "inner_lr": 1.0,
+        "lr": 5e-3,
+        "fcnet_hiddens": [16],
+        "seed": 0,
+    })
+    post_hist = []
+    for _ in range(40):
+        m = trainer.step()
+        post_hist.append(m["post_adaptation_reward"])
+        if np.mean(post_hist[-5:]) > 0.85 and len(post_hist) >= 5:
+            break
+    assert np.mean(post_hist[-5:]) > 0.8, (
+        f"post-adaptation reward stuck at {np.mean(post_hist[-5:])}")
+    # zero-shot stays near chance: the meta-init encodes adaptability,
+    # not a fixed answer
+    assert m["pre_adaptation_reward"] < 0.75, m
+
+    # deploy-time adaptation solves each concrete task
+    pol = trainer.get_policy()
+    theta = pol.params
+    for task in (0, 1):
+        pol.params = trainer.adapt_to(task)
+        acts, _ = pol.compute_actions(np.ones((64, 1), np.float32),
+                                      explore=True)
+        assert (acts == task).mean() > 0.8, (task, acts.mean())
+        pol.params = theta
+    trainer.cleanup()
