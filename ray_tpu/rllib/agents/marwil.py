@@ -1,0 +1,86 @@
+"""MARWIL — monotonic advantage re-weighted imitation learning
+(reference: rllib/agents/marwil/marwil.py + marwil_policy.py; Wang et
+al. 2018).
+
+Imitation from logged data, but better-than-the-demonstrator: each
+logged action's log-likelihood is weighted by exp(beta * advantage)
+where the advantage comes against a learned value baseline — good
+demonstrated actions are cloned hard, bad ones barely. beta=0 reduces
+to plain behavior cloning. Works purely offline (config["input"]) or
+on-policy; one jitted step trains policy and value heads together."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rllib.agents.pg import PGPolicy, pg_train_step
+from ray_tpu.rllib.agents.trainer import COMMON_CONFIG, build_trainer
+from ray_tpu.rllib.policy.jax_policy import JAXPolicy
+from ray_tpu.rllib.policy.sample_batch import SampleBatch
+
+MARWIL_CONFIG = {
+    **COMMON_CONFIG,
+    "beta": 1.0,             # 0 = plain behavior cloning
+    "vf_coeff": 1.0,
+    # moving-average normalizer for advantages inside the exp()
+    # (reference: marwil_policy.py ma_adv_norm)
+    "norm_update_rate": 1e-3,
+    "train_batch_size": 512,
+    "rollout_fragment_length": 256,
+    "lr": 1e-3,
+}
+
+
+class MARWILPolicy(PGPolicy):
+    """Shares PG's return-bootstrapping postprocess; only the loss (and
+    its moving-average advantage normalizer) differs."""
+
+    def __init__(self, observation_space, action_space, config):
+        merged = {**MARWIL_CONFIG, **config}
+        JAXPolicy.__init__(self, observation_space, action_space, merged,
+                           loss_fn=marwil_loss)
+        # running normalizer for squared advantages (device scalar)
+        self.ma_adv_sq = jnp.asarray(1.0)
+
+    def learn_on_batch(self, batch: SampleBatch) -> dict:
+        # offline batches arrive WITHOUT the postprocessed returns
+        # column — compute it here like the on-policy path would
+        if SampleBatch.ADVANTAGES not in batch:
+            batch = self.postprocess_trajectory(batch)
+        jb = {k: jnp.asarray(v) for k, v in batch.items()
+              if k not in self._NON_LOSS_COLUMNS and v.dtype != object}
+        jb["ma_adv_sq"] = self.ma_adv_sq
+        self.params, self.opt_state, loss, metrics = self._sgd_step(
+            self.params, self.opt_state, jb)
+        self.ma_adv_sq = metrics.pop("ma_adv_sq")
+        out = {"total_loss": float(loss)}
+        out.update({k: float(v) for k, v in metrics.items()})
+        return out
+
+
+def marwil_loss(params, batch, policy: MARWILPolicy):
+    cfg = policy.config
+    pi_out, values = JAXPolicy.model_out(
+        params, batch[SampleBatch.OBS].astype(jnp.float32))
+    returns = batch[SampleBatch.ADVANTAGES]
+    adv = returns - jax.lax.stop_gradient(values)
+    vf_loss = ((values - returns) ** 2).mean()
+    # moving-average normalization keeps exp() in a sane range
+    # (reference: marwil_policy.py update of the squared-adv EMA)
+    ma = batch["ma_adv_sq"]
+    ma = ma + cfg["norm_update_rate"] * ((adv ** 2).mean() - ma)
+    scale = jax.lax.rsqrt(jnp.maximum(ma, 1e-8))
+    weights = jnp.exp(cfg["beta"]
+                      * jnp.clip(adv * scale, -5.0, 5.0))
+    logp = policy.logp_fn()(pi_out, batch[SampleBatch.ACTIONS])
+    bc_loss = -(jax.lax.stop_gradient(weights) * logp).mean()
+    total = bc_loss + cfg["vf_coeff"] * vf_loss
+    return total, {"bc_loss": bc_loss, "vf_loss": vf_loss,
+                   "ma_adv_sq": ma}
+
+
+# same collect-then-learn execution plan as PG (reused, not copied)
+MARWILTrainer = build_trainer("MARWIL", MARWIL_CONFIG, MARWILPolicy,
+                              pg_train_step)
