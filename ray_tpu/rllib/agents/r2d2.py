@@ -1,0 +1,250 @@
+"""R2D2 — recurrent experience replay in distributed Q-learning
+(reference: rllib/agents/dqn/r2d2.py in later snapshots; Kapturowski et
+al. 2019). Value-based learning for partially-observable envs.
+
+A recurrent (LSTM) Q network acts with per-env hidden state threaded by
+the rollout worker (the same state/unroll columns the recurrent policy
+family records); replay stores fixed-length SEQUENCES with the sampled
+initial state of each; training replays every sequence through the LSTM
+— a burn-in prefix rebuilds state off stored (possibly stale) values
+before TD errors count — and targets come from a target network run over
+the same sequences, double-DQN style. One jitted step does the whole
+sequence TD update."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ray_tpu.rllib.agents.dqn import linear_epsilon
+from ray_tpu.rllib.agents.trainer import COMMON_CONFIG, Trainer
+from ray_tpu.rllib.execution.replay_buffer import ReplayBuffer
+from ray_tpu.rllib.models.catalog import ModelCatalog
+from ray_tpu.rllib.policy.policy import Policy
+from ray_tpu.rllib.policy.recurrent_policy import (STATE_C, STATE_H,
+                                                   UNROLL_ID,
+                                                   chop_sequences)
+from ray_tpu.rllib.policy.sample_batch import SampleBatch
+
+R2D2_CONFIG = {
+    **COMMON_CONFIG,
+    "num_workers": 0,
+    "rollout_fragment_length": 64,
+    "train_batch_size": 16,       # sequences per update
+    "seq_len": 16,                # replayed sequence length
+    "burn_in": 4,                 # state-rebuild prefix, no TD loss
+    "buffer_size": 2000,          # sequences
+    "learning_starts": 64,        # sequences
+    "sgd_rounds_per_step": 4,
+    "target_network_update_freq": 500,
+    "lstm_cell_size": 64,
+    "double_q": True,
+    "lr": 1e-3,
+    "exploration_initial_eps": 1.0,
+    "exploration_final_eps": 0.05,
+    "exploration_fraction": 0.4,
+    "total_timesteps_anneal": 10_000,
+}
+
+
+class R2D2Policy(Policy):
+    """Recurrent epsilon-greedy Q policy (discrete only)."""
+
+    is_recurrent = True
+    discrete = True
+
+    def __init__(self, observation_space, action_space, config: dict):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        merged = {**R2D2_CONFIG, **config}
+        super().__init__(observation_space, action_space, merged)
+        if not hasattr(action_space, "n"):
+            raise ValueError("R2D2 requires a discrete action space")
+        n_act = int(action_space.n)
+        self._n_act = n_act
+        init, step, seq, cell = ModelCatalog.get_recurrent_model(
+            observation_space, n_act, merged)
+        self._step_fn = jax.jit(step)
+        self._seq_fn = seq
+        self.cell_size = cell
+        self.state_sizes = (cell, cell)
+        seed = merged.get("seed") or 0
+        self.params = init(jax.random.key(seed))
+        self.target_params = jax.tree.map(jnp.copy, self.params)
+        self._optimizer = optax.adam(merged["lr"])
+        self.opt_state = self._optimizer.init(self.params)
+        self.eps = float(merged["exploration_initial_eps"])
+        self._rng = np.random.RandomState(
+            seed + 3 + 7919 * merged.get("worker_index", 0))
+        self._build()
+
+    def get_initial_state(self):
+        return [np.zeros(self.cell_size, np.float32),
+                np.zeros(self.cell_size, np.float32)]
+
+    def _build(self):
+        import jax
+        import jax.numpy as jnp
+
+        seq = self._seq_fn
+        gamma = self.config.get("gamma", 0.99)
+        double_q = bool(self.config.get("double_q", True))
+        burn_in = int(self.config.get("burn_in", 0))
+        optimizer = self._optimizer
+
+        def loss_fn(params, target_params, batch):
+            # batch: obs [S,T,D], actions [S,T], rewards/dones/resets/
+            # mask [S,T], h0/c0 [S,cell]
+            state0 = (batch["h0"], batch["c0"])
+            q, _ = seq(params, batch["obs"], state0, batch["resets"])
+            q_t, _ = seq(target_params, batch["obs"], state0,
+                         batch["resets"])
+            q_chosen = jnp.take_along_axis(
+                q, batch["actions"][..., None].astype(jnp.int32),
+                axis=-1)[..., 0]                       # [S, T]
+            if double_q:
+                sel = jnp.argmax(q, axis=-1)
+            else:
+                sel = jnp.argmax(q_t, axis=-1)
+            boot = jnp.take_along_axis(q_t, sel[..., None],
+                                       axis=-1)[..., 0]
+            # in-sequence targets: step t bootstraps from t+1 (the last
+            # step of each sequence has no successor and is masked out)
+            targets = (batch["rewards"][:, :-1]
+                       + gamma * (1.0 - batch["dones"][:, :-1])
+                       * boot[:, 1:])
+            targets = jax.lax.stop_gradient(targets)
+            td = q_chosen[:, :-1] - targets
+            # mask: padding, the burn-in prefix, and TRUNCATED episode
+            # boundaries. A reset at t+1 only invalidates step t when t
+            # was NOT terminal — terminal steps need no successor (their
+            # bootstrap is already zeroed by (1-dones)) and they carry
+            # the clearest TD signal, so they must stay in the loss.
+            dones_t = batch["dones"][:, :-1]
+            mask = batch["mask"][:, :-1] * batch["mask"][:, 1:]
+            mask = mask * (1.0 - batch["resets"][:, 1:] * (1.0 - dones_t))
+            if burn_in:
+                mask = mask.at[:, :burn_in].set(0.0)
+            n = jnp.maximum(mask.sum(), 1.0)
+            huber = jnp.where(jnp.abs(td) < 1.0, 0.5 * td * td,
+                              jnp.abs(td) - 0.5)
+            return (huber * mask).sum() / n, jnp.abs(td * mask).sum() / n
+
+        @jax.jit
+        def train(params, target_params, opt_state, batch):
+            (loss, td_abs), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, target_params, batch)
+            updates, opt_state = optimizer.update(grads, opt_state,
+                                                  params)
+            params = jax.tree.map(lambda p, u: p + u, params, updates)
+            return params, opt_state, loss, td_abs
+
+        self._train = train
+
+    # -- acting (recurrent surface the rollout worker drives) ------------
+
+    def compute_actions_with_state(self, obs_batch, states,
+                                   explore: bool = True):
+        import jax.numpy as jnp
+
+        obs = jnp.asarray(obs_batch, jnp.float32).reshape(
+            len(obs_batch), -1)
+        h = jnp.asarray(states[0], jnp.float32)
+        c = jnp.asarray(states[1], jnp.float32)
+        q, (h2, c2) = self._step_fn(self.params, obs, (h, c))
+        q = np.asarray(q)
+        actions = q.argmax(axis=-1)
+        if explore and self.eps > 0:
+            mask = self._rng.random_sample(len(actions)) < self.eps
+            actions = np.where(
+                mask, self._rng.randint(0, self._n_act, len(actions)),
+                actions)
+        extra = {SampleBatch.ACTION_LOGP: np.zeros(len(actions),
+                                                   np.float32),
+                 SampleBatch.VF_PREDS: q.max(axis=-1)}
+        return actions, extra, [np.asarray(h2), np.asarray(c2)]
+
+    def compute_actions(self, obs_batch, explore: bool = True):
+        h = np.zeros((len(obs_batch), self.cell_size), np.float32)
+        acts, extra, _ = self.compute_actions_with_state(
+            obs_batch, [h, h.copy()], explore)
+        return acts, extra
+
+    def set_epsilon(self, eps: float):
+        self.eps = float(eps)
+        return True
+
+    def update_target(self):
+        import jax
+        import jax.numpy as jnp
+
+        self.target_params = jax.tree.map(jnp.copy, self.params)
+
+    def learn_on_sequences(self, seq_batch: dict) -> dict:
+        import jax.numpy as jnp
+
+        jb = {k: jnp.asarray(v) for k, v in seq_batch.items()}
+        self.params, self.opt_state, loss, td_abs = self._train(
+            self.params, self.target_params, self.opt_state, jb)
+        return {"loss": float(loss), "td_abs": float(td_abs)}
+
+    def get_weights(self):
+        import jax
+
+        return {"q": jax.tree.map(np.asarray, self.params),
+                "eps": self.eps}
+
+    def set_weights(self, weights):
+        import jax
+        import jax.numpy as jnp
+
+        self.params = jax.tree.map(jnp.asarray, weights["q"])
+        self.eps = weights["eps"]
+
+
+class R2D2Trainer(Trainer):
+    """reference: rllib/agents/dqn/r2d2.py execution plan — the DQN
+    store→replay→train shape over SEQUENCES."""
+
+    _default_config = R2D2_CONFIG
+    _name = "R2D2"
+
+    @staticmethod
+    def policy_builder(obs_space, action_space, config):
+        return R2D2Policy(obs_space, action_space, config)
+
+    def setup(self, config):
+        super().setup(config)
+        self._buffer = ReplayBuffer(config["buffer_size"],
+                                    seed=config.get("seed"))
+        self._timesteps = 0
+        self._last_target_update = 0
+
+    def train_step(self) -> dict:
+        cfg = self.config
+        policy = self.workers.local_worker.policy
+        policy.set_epsilon(linear_epsilon(cfg, self._timesteps))
+        batch = self.workers.sample(cfg["rollout_fragment_length"])
+        self._timesteps += batch.count
+        # chop the fragment into stored-state sequences and stash them
+        # (each buffer ROW is one [T, ...] sequence)
+        seq_cols = chop_sequences(
+            batch, policy.state_sizes, int(cfg["seq_len"]),
+            {"rewards": batch[SampleBatch.REWARDS].astype(np.float32),
+             "dones": batch[SampleBatch.DONES].astype(np.float32)})
+        self._buffer.add_batch(SampleBatch(seq_cols))
+        metrics = {"timesteps_total": self._timesteps,
+                   "epsilon": round(policy.eps, 4),
+                   "buffer_sequences": len(self._buffer)}
+        if len(self._buffer) < cfg["learning_starts"]:
+            return metrics
+        for _ in range(cfg["sgd_rounds_per_step"]):
+            replay = self._buffer.sample(cfg["train_batch_size"])
+            metrics.update(policy.learn_on_sequences(dict(replay)))
+        if (self._timesteps - self._last_target_update
+                >= cfg["target_network_update_freq"]):
+            self._last_target_update = self._timesteps
+            policy.update_target()
+        self.workers.sync_weights()
+        return metrics

@@ -39,6 +39,58 @@ STATE_C = "state_in_c"
 UNROLL_ID = "unroll_id"
 
 
+def chop_sequences(batch, state_sizes, max_t: int,
+                   value_cols: dict) -> dict:
+    """Chop rows into [S, T]-padded sequences along stored unrolls
+    (reference: policy/rnn_sequencing.py chop_into_sequences). Shared by
+    every sequence-trained policy (RecurrentPG, R2D2).
+
+    value_cols: {out_name: np.ndarray[rows, ...]} per-step columns to
+    sequence alongside obs/actions; outputs also carry resets (episode
+    boundaries within a sequence), mask (padding), and the h0/c0 initial
+    states sampled at each sequence's first step."""
+    obs = batch[SampleBatch.OBS].astype(np.float32)
+    obs = obs.reshape(len(obs), -1)
+    actions = batch[SampleBatch.ACTIONS]
+    eps = batch[SampleBatch.EPS_ID]
+    unroll = batch[UNROLL_ID]
+    sh = batch[STATE_H].astype(np.float32)
+    sc = batch[STATE_C].astype(np.float32)
+
+    seqs = []  # (start, length) within one unroll
+    start = 0
+    for t in range(1, len(obs) + 1):
+        boundary = (t == len(obs) or unroll[t] != unroll[start]
+                    or t - start == max_t)
+        if boundary:
+            seqs.append((start, t - start))
+            start = t
+    s_n = len(seqs)
+    cols = {
+        "obs": np.zeros((s_n, max_t, obs.shape[1]), np.float32),
+        "actions": np.zeros((s_n, max_t) + actions.shape[1:],
+                            actions.dtype),
+        "resets": np.zeros((s_n, max_t), np.float32),
+        "mask": np.zeros((s_n, max_t), np.float32),
+        "h0": np.zeros((s_n, state_sizes[0]), np.float32),
+        "c0": np.zeros((s_n, state_sizes[1]), np.float32),
+    }
+    for name, v in value_cols.items():
+        cols[name] = np.zeros((s_n, max_t) + v.shape[1:], v.dtype)
+    for si, (s0, ln) in enumerate(seqs):
+        sl = slice(s0, s0 + ln)
+        cols["obs"][si, :ln] = obs[sl]
+        cols["actions"][si, :ln] = actions[sl]
+        cols["mask"][si, :ln] = 1.0
+        cols["h0"][si] = sh[s0]
+        cols["c0"][si] = sc[s0]
+        for name, v in value_cols.items():
+            cols[name][si, :ln] = v[sl]
+        e = eps[sl]
+        cols["resets"][si, 1:ln] = (e[1:] != e[:-1]).astype(np.float32)
+    return cols
+
+
 class RecurrentPGPolicy(Policy):
     """LSTM actor-critic trained with an advantage policy gradient
     (A2C-style: whole-batch update, no sequence-breaking minibatches)."""
@@ -229,47 +281,9 @@ class RecurrentPGPolicy(Policy):
         (reference: policy/rnn_sequencing.py chop_into_sequences)."""
         import jax.numpy as jnp
 
-        max_t = int(self.config["max_seq_len"])
-        obs = batch[SampleBatch.OBS].astype(np.float32)
-        obs = obs.reshape(len(obs), -1)
-        actions = batch[SampleBatch.ACTIONS]
-        returns = batch[SampleBatch.ADVANTAGES].astype(np.float32)
-        eps = batch[SampleBatch.EPS_ID]
-        unroll = batch[UNROLL_ID]
-        sh = batch[STATE_H].astype(np.float32)
-        sc = batch[STATE_C].astype(np.float32)
-
-        seqs = []  # (start, length) within one unroll
-        start = 0
-        for t in range(1, len(obs) + 1):
-            boundary = (t == len(obs) or unroll[t] != unroll[start]
-                        or t - start == max_t)
-            if boundary:
-                seqs.append((start, t - start))
-                start = t
-        s_n = len(seqs)
-        act_shape = actions.shape[1:]
-        cols = {
-            "obs": np.zeros((s_n, max_t, obs.shape[1]), np.float32),
-            "actions": np.zeros((s_n, max_t) + act_shape,
-                                actions.dtype),
-            "returns": np.zeros((s_n, max_t), np.float32),
-            "resets": np.zeros((s_n, max_t), np.float32),
-            "mask": np.zeros((s_n, max_t), np.float32),
-            "h0": np.zeros((s_n, self.state_sizes[0]), np.float32),
-            "c0": np.zeros((s_n, self.state_sizes[1]), np.float32),
-        }
-        for si, (s0, ln) in enumerate(seqs):
-            sl = slice(s0, s0 + ln)
-            cols["obs"][si, :ln] = obs[sl]
-            cols["actions"][si, :ln] = actions[sl]
-            cols["returns"][si, :ln] = returns[sl]
-            cols["mask"][si, :ln] = 1.0
-            cols["h0"][si] = sh[s0]
-            cols["c0"][si] = sc[s0]
-            e = eps[sl]
-            cols["resets"][si, 1:ln] = (e[1:] != e[:-1]).astype(
-                np.float32)
+        cols = chop_sequences(
+            batch, self.state_sizes, int(self.config["max_seq_len"]),
+            {"returns": batch[SampleBatch.ADVANTAGES].astype(np.float32)})
         return {k: jnp.asarray(v) for k, v in cols.items()}
 
     def learn_on_batch(self, batch: SampleBatch) -> dict:
