@@ -686,6 +686,27 @@ class CoreWorker:
             return {"kind": "ref", **desc}
         return {"kind": "inline", "data": data}
 
+    def _make_return_refs(self, task_id: TaskID, num_returns: int):
+        """Register + open a task's return set with two lock hops total
+        (the per-return _register_owned/register_ref/open triple cost three
+        lock round-trips EACH — pure bookkeeping churn on the serve request
+        path where every query is a return slot)."""
+        return_ids = [ObjectID.for_return(task_id, i)
+                      for i in range(num_returns)]
+        with self._lock:
+            for return_id in return_ids:
+                rec = self.owned.get(return_id)
+                if rec is None:
+                    rec = self.owned[return_id] = _OwnedRef()
+                rec.local += 1
+        self.memstore.open_many(return_ids)
+        refs = []
+        for return_id in return_ids:
+            ref = ObjectRef(return_id, self.address, False, _register=False)
+            ref._registered = True  # owned count bumped above
+            refs.append(ref)
+        return refs
+
     def _release_pins(self, pinned: list[ObjectID]):
         with self._lock:
             for object_id in pinned:
@@ -721,17 +742,12 @@ class CoreWorker:
             placement_group_id=placement_group,
             bundle_index=bundle_index,
         )
-        refs = []
-        for i in range(num_returns):
-            return_id = ObjectID.for_return(task_id, i)
-            self._register_owned(return_id)
-            self.memstore.open(return_id)
-            refs.append(ObjectRef(return_id, self.address, False))
+        refs = self._make_return_refs(task_id, num_returns)
         self.submitted[task_id.binary()] = {
             "spec": spec, "pinned": pinned,
             "retries": spec["max_retries"], "cancelled": False,
         }
-        self._io.submit(self._submit_async(spec))
+        self._io.submit_nowait(self._submit_async(spec))
         return refs
 
     async def _submit_async(self, spec):
@@ -888,11 +904,12 @@ class CoreWorker:
         # refs live, task_manager.h lineage pinning).
         lineage = {"spec": spec,
                    "retries": rec["retries"] if rec else 0}
+        inline_puts = []
         for i, ret in enumerate(reply["returns"]):
             return_id = ObjectID.for_return(TaskID(task_id), i)
             if ret["kind"] == "inline":
-                self.memstore.put(return_id, ret["data"],
-                                  is_exception=ret.get("err", False))
+                inline_puts.append((return_id, ret["data"],
+                                    ret.get("err", False)))
             else:  # plasma
                 with self._lock:
                     owned = self.owned.get(return_id)
@@ -903,6 +920,10 @@ class CoreWorker:
                         if rec is not None or owned.lineage_task is None:
                             owned.lineage_task = lineage
                 self.memstore.put(return_id, IN_PLASMA)
+        if inline_puts:
+            # one lock/notify for the whole return set (a serve batch is
+            # num_returns inline values landing together)
+            self.memstore.put_many(inline_puts)
 
     def _fail_task(self, spec, error: Exception, release=False):
         task_id = spec["task_id"]
@@ -1130,12 +1151,7 @@ class CoreWorker:
             args=descs,
             num_returns=num_returns,
         )
-        refs = []
-        for i in range(num_returns):
-            return_id = ObjectID.for_return(task_id, i)
-            self._register_owned(return_id)
-            self.memstore.open(return_id)
-            refs.append(ObjectRef(return_id, self.address, False))
+        refs = self._make_return_refs(task_id, num_returns)
         self.submitted[task_id.binary()] = {
             "spec": spec, "pinned": pinned, "retries": 0, "cancelled": False}
 
@@ -1149,7 +1165,7 @@ class CoreWorker:
         client.queued.append((spec, pinned))
         if not client.flush_scheduled:
             client.flush_scheduled = True
-            self._io.submit(self._submit_flush(client))
+            self._io.submit_nowait(self._submit_flush(client))
         return refs
 
     async def _submit_flush(self, client: _ActorClient):
@@ -1576,6 +1592,56 @@ class CoreWorker:
                 deliver(exception=result)
             else:
                 deliver(result)
+
+        self._ensure_fetch(ref)
+        self.memstore.add_ready_callback(object_id, on_ready)
+        return fut
+
+    def resolve_async(self, ref: ObjectRef) -> asyncio.Future:
+        """Asyncio-native get: an asyncio.Future on the CALLING loop that
+        resolves to the value. Unlike `as_future` + `wrap_future` (a
+        concurrent.Future plus one call_soon_threadsafe per ref), delivery
+        rides the loop's coalesced call queue — a task reply carrying N
+        awaited results costs one loop wakeup, not N. This is what
+        `await ref` uses under an event loop (the serve proxy hot path)."""
+        loop = asyncio.get_running_loop()
+        fut = loop.create_future()
+        caller = rpc.loop_call_queue(loop)
+        object_id = ref.id()
+
+        def deliver(result, is_exc):
+            def _set():
+                if fut.cancelled():
+                    return
+                if is_exc:
+                    fut.set_exception(result)
+                else:
+                    fut.set_result(result)
+            try:
+                caller.call(_set)
+            except RuntimeError:
+                pass  # caller's loop closed: nobody is waiting
+
+        def resolve_blocking():
+            try:
+                deliver(self._get_one(ref, None), False)
+            except BaseException as e:
+                deliver(e, True)
+
+        def on_ready():
+            found, value, is_exc = self.memstore.get_if_ready(object_id)
+            if not found or value is IN_PLASMA:
+                # raced a reset(), or plasma-resident: the pull/restore can
+                # block for seconds — resolve on a thread, off this loop
+                threading.Thread(target=resolve_blocking,
+                                 daemon=True).start()
+                return
+            try:
+                result = serialization.deserialize(value)
+            except BaseException as e:
+                deliver(e, True)
+                return
+            deliver(result, is_exc)
 
         self._ensure_fetch(ref)
         self.memstore.add_ready_callback(object_id, on_ready)

@@ -39,18 +39,34 @@ class MemoryStore:
         with self._lock:
             self._entries.setdefault(object_id, _Entry())
 
+    def open_many(self, object_ids) -> None:
+        """open() for a task's whole return set under one lock hop."""
+        with self._lock:
+            for object_id in object_ids:
+                self._entries.setdefault(object_id, _Entry())
+
     def put(self, object_id: ObjectID, value: Any, is_exception=False) -> None:
+        self.put_many([(object_id, value, is_exception)])
+
+    def put_many(self, items) -> None:
+        """put() for a batch of (object_id, value, is_exception) triples:
+        one lock acquisition and one notify_all for a whole task reply
+        (a serve batch reply is num_returns puts in a tight loop — the
+        per-put lock/notify churn was measurable on the HTTP path)."""
+        fired = []
         with self._cv:
-            entry = self._entries.setdefault(object_id, _Entry())
-            if entry.ready:
-                return  # first write wins
-            entry.value = value
-            entry.is_exception = is_exception
-            entry.ready = True
-            cbs = entry.callbacks
-            entry.callbacks = None
+            for object_id, value, is_exception in items:
+                entry = self._entries.setdefault(object_id, _Entry())
+                if entry.ready:
+                    continue  # first write wins
+                entry.value = value
+                entry.is_exception = is_exception
+                entry.ready = True
+                if entry.callbacks:
+                    fired.extend(entry.callbacks)
+                entry.callbacks = None
             self._cv.notify_all()
-        for cb in cbs or ():  # outside the lock: callbacks may re-enter
+        for cb in fired:  # outside the lock: callbacks may re-enter
             try:
                 cb()
             except Exception:

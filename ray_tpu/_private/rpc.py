@@ -485,6 +485,96 @@ class ReconnectingConnection:
             await self._conn.close()
 
 
+class ThreadsafeCallQueue:
+    """Coalesced cross-thread dispatch onto one event loop.
+
+    Every `loop.call_soon_threadsafe` writes a byte to the loop's self-pipe
+    — a real syscall per call, and the single largest per-request cost on
+    the serve HTTP path (one wakeup per dispatched query + one per result).
+    This queue batches them: callers append under a plain lock and only the
+    FIRST append per burst schedules a drain, so N wakeups from any number
+    of threads collapse into one self-pipe write per loop tick (the same
+    trick the Connection send path uses for outbound frames)."""
+
+    def __init__(self, loop):
+        self._loop = loop
+        self._lock = threading.Lock()
+        self._pending: list = []
+        self._scheduled = False
+
+    def call(self, fn, *args) -> None:
+        """Run fn(*args) on the loop soon; never blocks. Raises
+        RuntimeError if the loop is closed (same as call_soon_threadsafe).
+        """
+        if self._loop.is_closed():
+            # checked BEFORE the _scheduled shortcut: a drain scheduled
+            # just before the loop stopped never runs, and the shortcut
+            # would otherwise swallow every later call silently
+            raise RuntimeError("Event loop is closed")
+        with self._lock:
+            self._pending.append((fn, args))
+            if self._scheduled:
+                return
+            self._scheduled = True
+        try:
+            running = asyncio.get_running_loop()
+        except RuntimeError:
+            running = None
+        try:
+            if running is self._loop:
+                self._loop.call_soon(self._drain)  # already on-loop: no pipe
+            else:
+                self._loop.call_soon_threadsafe(self._drain)
+        except RuntimeError:
+            # loop closed: nothing will ever drain. Reset so every later
+            # call() retries the schedule and raises too (otherwise they
+            # would see _scheduled=True and silently report success).
+            # Concurrent winners of the append race lose their items —
+            # same as a callback accepted just before close — but any
+            # coroutine arguments (submit_nowait) get close()d so they
+            # don't leak un-awaited.
+            with self._lock:
+                self._scheduled = False
+                dropped, self._pending = self._pending, []
+            for _fn, args in dropped:
+                for a in args:
+                    if asyncio.iscoroutine(a):
+                        a.close()
+            raise
+
+    def _drain(self):
+        while True:
+            with self._lock:
+                batch = self._pending
+                if not batch:
+                    self._scheduled = False
+                    return
+                self._pending = []
+            for fn, args in batch:
+                try:
+                    fn(*args)
+                except Exception:
+                    logger.exception("threadsafe call failed")
+
+
+_loop_queues_lock = threading.Lock()
+
+
+def loop_call_queue(loop) -> ThreadsafeCallQueue:
+    """The shared ThreadsafeCallQueue for `loop` (created on first use).
+    Stored as an attribute ON the loop so the queue dies with the loop —
+    short-lived loops (tests, proxy restarts) can't pile up in any
+    module-global registry."""
+    queue = getattr(loop, "_ray_tpu_call_queue", None)
+    if queue is None:
+        with _loop_queues_lock:
+            queue = getattr(loop, "_ray_tpu_call_queue", None)
+            if queue is None:
+                queue = ThreadsafeCallQueue(loop)
+                loop._ray_tpu_call_queue = queue
+    return queue
+
+
 class EventLoopThread:
     """A dedicated asyncio loop on a daemon thread.
 
@@ -495,6 +585,9 @@ class EventLoopThread:
 
     def __init__(self, name="ray_tpu-io"):
         self.loop = asyncio.new_event_loop()
+        # via the registry, so resolve_async/_watch_batch waiters reaching
+        # this loop through loop_call_queue() coalesce into the SAME queue
+        self._calls = loop_call_queue(self.loop)
         self._thread = threading.Thread(target=self._run, name=name, daemon=True)
         self._thread.start()
 
@@ -508,6 +601,25 @@ class EventLoopThread:
 
     def submit(self, coro):
         return asyncio.run_coroutine_threadsafe(coro, self.loop)
+
+    def call_threadsafe(self, fn, *args):
+        """Coalesced call_soon_threadsafe: a burst of calls from worker
+        threads costs one loop wakeup, not one per call."""
+        self._calls.call(fn, *args)
+
+    def submit_nowait(self, coro):
+        """Fire-and-forget coroutine scheduling through the coalesced
+        queue — for hot paths that never look at the result (submit()
+        builds a concurrent.Future + an uncoalesced wakeup per call)."""
+        try:
+            self._calls.call(self._spawn, coro)
+        except RuntimeError:
+            coro.close()  # loop closed: don't leak an unawaited coroutine
+            raise
+
+    @staticmethod
+    def _spawn(coro):
+        asyncio.ensure_future(coro)
 
     def stop(self):
         def _cancel_all():

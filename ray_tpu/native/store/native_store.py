@@ -25,6 +25,7 @@ from __future__ import annotations
 import ctypes
 import mmap
 import os
+import sys
 
 from ray_tpu._private.ids import ObjectID
 
@@ -200,16 +201,32 @@ class NativeObjectStore:
         if rc != 0:
             return None
         raw = self._mv[off.value:off.value + size.value]
-        pinned = _PinnedBlock(self, oid, off.value, raw)
-        return _ArenaBuffer(memoryview(pinned), size.value)
+        if sys.version_info >= (3, 12):
+            pinned = _PinnedBlock(self, oid, off.value, raw)
+            return _ArenaBuffer(memoryview(pinned), size.value)
+        # Python < 3.12 cannot export the buffer protocol from pure
+        # Python (PEP 688), so the pinned zero-copy path is unavailable:
+        # copy the payload out and drop the pin immediately. One memcpy
+        # slower than 3.12, but views can never see reused arena memory.
+        try:
+            data = bytes(raw)
+        finally:
+            raw.release()
+            self._release(oid, off.value)
+        return _ArenaBuffer(memoryview(data), size.value)
 
     def size_of(self, object_id: ObjectID) -> int:
-        buf = self.get(object_id)
-        if buf is None:
+        # size-only: rts_get already returns it — don't materialize the
+        # payload (on <3.12 get() copies the whole object out)
+        off = ctypes.c_uint64()
+        size = ctypes.c_uint64()
+        oid = object_id.binary()
+        rc = self._lib.rts_get(self._h, oid,
+                               ctypes.byref(off), ctypes.byref(size))
+        if rc != 0:
             raise FileNotFoundError(object_id.hex())
-        size = buf.size
-        buf.close()
-        return size
+        self._release(oid, off.value)
+        return size.value
 
     def delete(self, object_id: ObjectID) -> int:
         return int(self._lib.rts_delete(self._h, object_id.binary()))

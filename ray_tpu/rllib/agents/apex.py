@@ -155,8 +155,13 @@ class ApexTrainer(DQNTrainer):
             if replay is None:
                 continue
             info = policy.learn_on_batch(replay)
-            self._shards[i % len(self._shards)].update_priorities.remote(
-                replay["batch_indexes"], info.pop("td_errors"))
+            # drained with _inflight_stores below: a dead shard raises
+            # at the next drain instead of silently dropping priority
+            # updates (degrading to uniform replay)
+            self._inflight_stores.append(
+                self._shards[i % len(self._shards)]
+                .update_priorities.remote(
+                    replay["batch_indexes"], info.pop("td_errors")))
             trained += len(replay)
             metrics.update(info)
         metrics["num_env_steps_trained"] = trained

@@ -278,8 +278,14 @@ class QMixTrainer(Trainer):
                         or truncated.get("__all__"))
             team_r = float(sum(rewards.values()))
             self._episode_reward += team_r
+            terminal = float(bool(terminated.get("__all__")))
             if done and not next_obs:
-                next_rows = rows  # terminal step with no further obs
+                # no further obs: next_rows is a placeholder, so the TD
+                # target must NOT bootstrap from it — a truncated episode
+                # (terminated=0) would otherwise bootstrap from the
+                # CURRENT obs, biasing Q toward self-consistent loops
+                next_rows = rows
+                terminal = 1.0
             elif set(next_obs) >= set(self._agent_ids):
                 next_rows = self._rows(next_obs)
             else:
@@ -294,9 +300,7 @@ class QMixTrainer(Trainer):
                 "obs": rows[None], "next_obs": next_rows[None],
                 "actions": acts[None],
                 "rewards": np.array([team_r], np.float32),
-                "dones": np.array(
-                    [float(bool(terminated.get("__all__")))],
-                    np.float32),
+                "dones": np.array([terminal], np.float32),
             }))
             self._timesteps += 1
             if done:

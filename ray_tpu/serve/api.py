@@ -146,13 +146,26 @@ def _start_proxies(controller, host: str, port: int,
         port = holder.getsockname()[1]
     else:
         holder = None
+    proxies = []
     try:
         proxy_cls = ray_tpu.remote(HTTPProxy)
-        proxies = [proxy_cls.remote(controller, host, port,
-                                    reuse_port=(n > 1))
-                   for _ in range(n)]
+        for _ in range(n):
+            # append as we go (not a comprehension): if the k-th remote()
+            # raises, the k-1 already-spawned proxies must be killable
+            proxies.append(proxy_cls.remote(controller, host, port,
+                                            reuse_port=(n > 1)))
         actual = ray_tpu.get([p.port.remote() for p in proxies],
                              timeout=60)
+    except Exception:
+        # a proxy failed to bind (port in use) or never came up: kill the
+        # ones already spawned so nothing is leaked — the caller never
+        # learns their handles (ADVICE.md: orphaned HTTPProxy actors)
+        for p in proxies:
+            try:
+                ray_tpu.kill(p)
+            except Exception:
+                pass
+        raise
     finally:
         if holder is not None:
             holder.close()
@@ -173,8 +186,18 @@ def start(*, http: bool = False, http_host: str = "127.0.0.1",
     proxies = []
     port = None
     if http:
-        proxies, port = _start_proxies(controller, http_host, http_port,
-                                       http_workers)
+        try:
+            proxies, port = _start_proxies(controller, http_host,
+                                           http_port, http_workers)
+        except Exception:
+            # _start_proxies already killed its proxies; without this
+            # the controller would outlive the failed start() as an
+            # orphan no caller holds a handle to
+            try:
+                ray_tpu.kill(controller)
+            except Exception:
+                pass
+            raise
     _client = Client(controller, proxies, port)
     return _client
 

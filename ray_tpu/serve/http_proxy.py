@@ -16,8 +16,12 @@ class HTTPProxy:
     """Actor: runs an aiohttp server on a thread; one Router per endpoint."""
 
     def __init__(self, controller, host: str = "127.0.0.1", port: int = 0,
-                 reuse_port: bool = False):
+                 reuse_port: bool = False, legacy_path: bool = False):
         self._controller = controller
+        # legacy_path keeps the pre-coalescing request path (assign_async
+        # + wrap_future per ref) alive as the A/B control for the
+        # microbenchmark, and as a fallback switch for call_async
+        self._legacy_path = legacy_path
         self._routers: dict[str, object] = {}
         self._routes: dict[str, dict] = {}
         self._state_lock = threading.Lock()
@@ -30,6 +34,7 @@ class HTTPProxy:
         # the same way with one uvicorn proxy per node)
         self._reuse_port = reuse_port
         self._actual_port = None
+        self._error: BaseException | None = None
         self._ready = threading.Event()
         self._synced = threading.Event()
         self._closed = False
@@ -38,6 +43,14 @@ class HTTPProxy:
         self._thread = threading.Thread(target=self._serve, daemon=True)
         self._thread.start()
         self._ready.wait(timeout=10)
+        if self._error is not None:
+            # Surface bind failures (port in use, bad host) as an actor
+            # init error instead of a silent None port 10s later — the
+            # caller (_start_proxies) kills partially-started proxies on
+            # this (ADVICE.md: orphaned HTTPProxy actors on bind failure).
+            raise RuntimeError(
+                f"HTTP proxy failed to serve on {host}:{port}: "
+                f"{self._error}") from self._error
         self._synced.wait(timeout=10)
 
     def _poll_loop(self):
@@ -78,13 +91,12 @@ class HTTPProxy:
         from aiohttp import web
 
         async def handler(request: "web.Request"):
-            # Fully async request path: route lookup and JSON parse are
-            # loop-cheap, dispatch awaits the router's asyncio bridge,
-            # and the ObjectRef is awaited natively — no thread parked
-            # per request, so concurrency is bounded by the loop, not an
-            # executor pool (reference: serve's uvicorn proxy is equally
-            # async end-to-end).
-            body = await request.read()
+            # Fully async request path: route lookup is a plain dict get,
+            # the router resolves the RESULT directly (call_async) so a
+            # request costs zero per-query cross-thread wakeups — the
+            # batch's results arrive on this loop in one coalesced tick
+            # (reference: serve's uvicorn proxy is equally async
+            # end-to-end).
             route = self._routes.get(request.path)
             if route is None:
                 return web.json_response(
@@ -93,21 +105,28 @@ class HTTPProxy:
                 return web.json_response(
                     {"error": f"method {request.method} not allowed"},
                     status=405)
+            body = (await request.read()) if request.body_exists else None
             try:
                 data = json.loads(body) if body else None
             except json.JSONDecodeError:
                 return web.json_response({"error": "invalid JSON"},
                                          status=400)
-            router = self._router_for(route["endpoint"])
+            endpoint = route["endpoint"]
+            # lock-free hot path: dict reads are GIL-atomic; the locked
+            # creator runs only on the first request per endpoint
+            router = self._routers.get(endpoint)
+            if router is None:
+                router = self._router_for(endpoint)
             try:
-                ref = await router.assign_async(data)
-                result = await asyncio.wait_for(_await_ref(ref), 60)
+                if self._legacy_path:
+                    ref = await router.assign_async(data)
+                    result = await asyncio.wait_for(
+                        asyncio.wrap_future(ref.future()), 60)
+                else:
+                    result = await router.call_async(data, timeout=60.0)
                 return web.json_response({"result": result})
             except Exception as e:
                 return web.json_response({"error": str(e)}, status=500)
-
-        async def _await_ref(ref):
-            return await ref
 
         async def run():
             app = web.Application()
@@ -122,7 +141,21 @@ class HTTPProxy:
             while True:
                 await asyncio.sleep(3600)
 
-        asyncio.run(run())
+        try:
+            asyncio.run(run())
+        except BaseException as e:
+            already_up = self._ready.is_set()
+            self._error = e
+            self._ready.set()
+            if already_up:
+                # post-startup crash (EMFILE, serve-loop bug): __init__
+                # returned long ago and nothing reads _error — log loudly
+                # instead of leaving a dark proxy with a live-looking port
+                import logging
+
+                logging.getLogger("ray_tpu").exception(
+                    "HTTP proxy server crashed after startup")
+            # pre-ready failures (bind errors) are raised by __init__
 
     def port(self) -> int:
         return self._actual_port

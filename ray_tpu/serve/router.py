@@ -5,8 +5,11 @@ batch — the TPU-relevant unit of work).
 
 Each endpoint gets a flusher thread: queries queue up to max_batch_size or
 batch_wait_timeout, then fly to the least-loaded replica with a free slot
-(max_concurrent_queries in-flight batches per replica). A single completion
-thread polls outstanding batches to release replica slots."""
+(max_concurrent_queries in-flight batches per replica). Batch completion —
+releasing the replica slot, and resolving result-mode queries — rides
+memstore ready-callbacks fired by the task-reply path: there is no polling
+thread, and a whole batch's results reach a waiting event loop in one
+coalesced wakeup (rpc.loop_call_queue)."""
 
 from __future__ import annotations
 
@@ -16,7 +19,7 @@ import time
 
 class _PendingQuery:
     __slots__ = ("data", "event", "ref", "error", "abandoned", "loop",
-                 "future")
+                 "future", "want_result")
 
     def __init__(self, data):
         self.data = data
@@ -24,23 +27,31 @@ class _PendingQuery:
         self.ref = None
         self.error = None
         self.abandoned = False
-        self.loop = None    # set by assign_async: asyncio bridge
+        self.loop = None    # set by assign_async/call_async: asyncio bridge
         self.future = None
+        self.want_result = False  # call_async: resolve with the VALUE
 
     def _notify(self):
         """Dispatch outcome is ready: wake the sync waiter and, for async
         callers, resolve their future on its own event loop (the flusher
-        thread can't touch asyncio state directly)."""
+        thread can't touch asyncio state directly). Result-mode queries
+        only land here on dispatch ERRORS — their success path resolves at
+        completion with the value, with zero per-query dispatch wakeups."""
         self.event.set()
         if self.future is not None:
+            from ray_tpu._private import rpc
+
             def _done(q=self):
-                if not q.future.done():
+                # abandoned = caller timed out and stopped awaiting; an
+                # exception set now would only surface as "Future
+                # exception was never retrieved" GC spam
+                if not q.future.done() and not q.abandoned:
                     if q.error is not None:
                         q.future.set_exception(q.error)
                     else:
                         q.future.set_result(q.ref)
             try:
-                self.loop.call_soon_threadsafe(_done)
+                rpc.loop_call_queue(self.loop).call(_done)
             except RuntimeError:
                 # caller's event loop already closed (proxy shutdown
                 # race): nobody is waiting; the sync event is set
@@ -56,7 +67,6 @@ class Router:
         self._lock = threading.Lock()
         self._queue: list[_PendingQuery] = []
         self._inflight: dict[bytes, int] = {}   # actor_id -> live batches
-        self._outstanding: list[tuple[bytes, list]] = []  # (actor_id, refs)
         self._state = None
         self._state_time = 0.0
         self._closed = False
@@ -64,9 +74,6 @@ class Router:
         self._refresh()
         self._flusher = threading.Thread(target=self._flush_loop, daemon=True)
         self._flusher.start()
-        self._completer = threading.Thread(target=self._completion_loop,
-                                           daemon=True)
-        self._completer.start()
         self._poller = threading.Thread(target=self._poll_loop, daemon=True)
         self._poller.start()
 
@@ -119,10 +126,7 @@ class Router:
         if not q.event.wait(timeout):
             # Nobody will consume the result — withdraw the query so it
             # doesn't burn a replica slot after we've given up on it.
-            with self._lock:
-                q.abandoned = True
-                if q in self._queue:
-                    self._queue.remove(q)
+            self._abandon(q)
             raise TimeoutError(
                 f"no replica accepted the query within {timeout}s")
         if q.error is not None:
@@ -146,12 +150,46 @@ class Router:
             return await asyncio.wait_for(asyncio.shield(q.future),
                                           timeout)
         except asyncio.TimeoutError:
-            with self._lock:
-                q.abandoned = True
-                if q in self._queue:
-                    self._queue.remove(q)
+            self._abandon(q)
             raise TimeoutError(
                 f"no replica accepted the query within {timeout}s")
+        except asyncio.CancelledError:
+            self._abandon(q)  # caller task cancelled (client disconnect)
+            raise
+
+    async def call_async(self, data, timeout: float = 30.0):
+        """One round trip for asyncio callers (the HTTP proxy): enqueue and
+        await the RESULT VALUE directly. Versus assign_async + `await ref`
+        this removes both per-request cross-thread wakeups: dispatch does
+        not notify the caller at all, and the reply's deserialized values
+        are delivered for the whole batch in one coalesced loop tick."""
+        import asyncio
+
+        q = _PendingQuery(data)
+        q.loop = asyncio.get_running_loop()
+        q.future = q.loop.create_future()
+        q.want_result = True
+        with self._lock:
+            self._queue.append(q)
+        self._wake.set()
+        try:
+            return await asyncio.wait_for(asyncio.shield(q.future), timeout)
+        except asyncio.TimeoutError:
+            self._abandon(q)
+            raise TimeoutError(
+                f"request timed out after {timeout}s") from None
+        except asyncio.CancelledError:
+            # caller task cancelled (HTTP client disconnected mid-request):
+            # same cleanup as a timeout, or the dead client's query still
+            # dispatches and its orphaned future collects exception spam
+            self._abandon(q)
+            raise
+
+    def _abandon(self, q: _PendingQuery):
+        with self._lock:
+            q.abandoned = True
+            if q in self._queue:
+                self._queue.remove(q)
 
     def close(self):
         self._closed = True
@@ -218,13 +256,20 @@ class Router:
                 time.sleep(0.01)
                 continue
             cfg = state["backends"][backend]["config"]
-            # fill a batch (or give stragglers batch_wait_timeout)
+            # fill a batch (or give stragglers batch_wait_timeout) —
+            # event-driven: enqueues set _wake, so a full batch dispatches
+            # the moment it fills instead of on the next 1ms poll tick
+            # (each sleep(0.001) is a timer syscall that cost multiple ms
+            # under load on the 1-core box)
             if cfg["max_batch_size"]:
                 deadline = time.monotonic() + cfg["batch_wait_timeout"]
                 while (not self._closed
-                       and len(self._queue) < cfg["max_batch_size"]
-                       and time.monotonic() < deadline):
-                    time.sleep(0.001)
+                       and len(self._queue) < cfg["max_batch_size"]):
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._wake.wait(remaining)
+                    self._wake.clear()
             replica = self._pick_replica(state, backend)
             if replica is None:
                 # chosen backend saturated — try any other traffic
@@ -268,6 +313,8 @@ class Router:
             refs = [out] if len(batch) == 1 else list(out)
             if not shadow:
                 for q, ref in zip(batch, refs):
+                    if q.want_result:
+                        continue  # resolved at completion with the value
                     q.ref = ref
                     q._notify()
         except Exception as e:
@@ -275,37 +322,89 @@ class Router:
                 for q in batch:
                     q.error = e
                     q._notify()
-        with self._lock:
-            if refs:
-                # shadow batches still occupy a replica slot until done
-                # (backpressure), their results just go nowhere
-                self._outstanding.append((key, refs))
-            else:
+        if refs:
+            # shadow batches still occupy a replica slot until done
+            # (backpressure), their results just go nowhere
+            self._watch_batch(key, refs, () if shadow else batch)
+        else:
+            with self._lock:
                 self._inflight[key] -= 1
 
-    def _completion_loop(self):
-        """One thread polls every outstanding batch; a finished batch frees
-        its replica slot (no thread-per-batch)."""
-        import ray_tpu
+    def _watch_batch(self, key: bytes, refs: list, batch):
+        """Arm one memstore ready-callback per return: the last one to
+        fire frees the replica slot, and result-mode queries get their
+        deserialized value pushed straight to their event loop. The
+        callbacks run inline on the task-reply (io-loop) thread, so a
+        whole batch completes in one pass with no polling anywhere."""
+        from ray_tpu._private import global_state, rpc, serialization
+        from ray_tpu._private.memstore import IN_PLASMA
 
-        while not self._closed:
+        cw = global_state.get_core_worker()
+        state = {"left": len(refs)}
+        waiters = {ref.id(): q for q, ref in zip(batch, refs)
+                   if q.want_result}
+
+        def finish_one():
             with self._lock:
-                outstanding = list(self._outstanding)
-            if not outstanding:
-                time.sleep(0.005)
-                continue
-            for key, refs in outstanding:
+                state["left"] -= 1
+                done = state["left"] == 0
+                if done:
+                    self._inflight[key] -= 1
+            if done:
+                self._wake.set()
+
+        def deliver(q, result, is_exc):
+            def _set():
+                fut = q.future
+                # abandoned = caller timed out; setting an exception on
+                # the orphaned future would log "exception was never
+                # retrieved" at GC for every such request
+                if fut is None or fut.done() or q.abandoned:
+                    return
+                if is_exc:
+                    fut.set_exception(result)
+                else:
+                    fut.set_result(result)
+            try:
+                rpc.loop_call_queue(q.loop).call(_set)
+            except RuntimeError:
+                pass  # caller's loop closed; result goes nowhere
+
+        def make_cb(ref):
+            oid = ref.id()
+            q = waiters.get(oid)
+
+            def resolve_blocking():
+                import ray_tpu
                 try:
-                    _, not_done = ray_tpu.wait(
-                        refs, num_returns=len(refs), timeout=0)
-                except Exception:
-                    not_done = []
-                if not not_done:
-                    with self._lock:
-                        self._outstanding.remove((key, refs))
-                        self._inflight[key] -= 1
-                    self._wake.set()
-            time.sleep(0.005)
+                    deliver(q, ray_tpu.get(ref), False)
+                except BaseException as e:
+                    deliver(q, e, True)
+                finally:
+                    finish_one()
+
+            def on_ready():
+                if q is None:
+                    finish_one()
+                    return
+                found, value, is_exc = cw.memstore.get_if_ready(oid)
+                if not found or value is IN_PLASMA:
+                    # raced a reset(), or a plasma-resident result: the
+                    # read may pull/reconstruct — keep it off this thread
+                    threading.Thread(target=resolve_blocking,
+                                     daemon=True).start()
+                    return
+                try:
+                    result = serialization.deserialize(value)
+                except BaseException as e:
+                    result, is_exc = e, True
+                deliver(q, result, is_exc)
+                finish_one()
+
+            return on_ready
+
+        for ref in refs:
+            cw.memstore.add_ready_callback(ref.id(), make_cb(ref))
 
 
 class ServeHandle:

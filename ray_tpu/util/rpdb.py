@@ -82,6 +82,11 @@ class _SocketIO:
             except OSError:
                 self._drop()
         self._backlog.append(data.encode())
+        # bound at APPEND time: a chatty detached breakpoint (e.g. a
+        # watchpoint printing in a loop) must not grow worker memory
+        # without limit — replay only ever sends the last 64 chunks
+        if len(self._backlog) > 64:
+            del self._backlog[:-64]
         return len(data)
 
     def flush(self):

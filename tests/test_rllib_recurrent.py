@@ -517,3 +517,45 @@ def test_r2d2_learns_memory_task(ray_start_shared):
     trainer.cleanup()
     assert best > 0.85, (
         f"R2D2 failed the memory task (best={best}; chance is 0.5)")
+
+
+class TruncatingSignalEnv(CoopSignalEnv):
+    """CoopSignalEnv variant whose episodes end by TRUNCATION with an
+    EMPTY obs dict (a time-limit wrapper that has nothing more to show).
+    Exercises the no-next-obs bootstrap rule."""
+
+    def step(self, actions):
+        ok = all(int(actions[a]) == self._sig for a in ("a0", "a1"))
+        r = 1.0 if ok else 0.0
+        rewards = {"a0": r / 2, "a1": r / 2}
+        # truncated, not terminated — and no further observation
+        return {}, rewards, {"__all__": False}, {"__all__": True}, {}
+
+
+def test_qmix_truncation_without_obs_never_bootstraps(ray_start_shared):
+    """A truncated step with no next obs must be stored with dones=1.0:
+    the only 'next_obs' available is the CURRENT obs, and bootstrapping
+    the TD target from it would teach Q a self-consistent fixed point
+    instead of the env's value."""
+    from ray_tpu.rllib.agents.qmix import QMixTrainer
+
+    trainer = QMixTrainer(config={
+        "env": TruncatingSignalEnv,
+        "rollout_fragment_length": 8,
+        "train_batch_size": 4,
+        "learning_starts": 10_000,  # rollout only — no SGD needed
+        "fcnet_hiddens": [8],
+        "mixing_embed_dim": 4,
+        "seed": 0,
+    })
+    trainer.train_step()
+    buf = trainer._buffer
+    n = len(buf)
+    assert n == 8
+    dones = buf._cols["dones"][:n]
+    # EVERY stored transition ended its (one-step, truncated) episode
+    # with no next obs -> all must refuse to bootstrap
+    assert (dones == 1.0).all(), dones
+    # and the placeholder next_obs is the current obs (shape contract)
+    assert buf._cols["next_obs"][:n].shape == buf._cols["obs"][:n].shape
+    trainer.cleanup()

@@ -235,3 +235,40 @@ def test_traffic_split_and_shadow(serve_client):
     # deleting a backend still referenced by traffic fails
     with pytest.raises(Exception):
         client.delete_backend("split_v2")
+
+
+def test_http_bind_failure_leaves_no_orphan_actors(ray_start_shared):
+    """serve.start(http=True) on an occupied explicit port must fail AND
+    clean up after itself: no HTTPProxy (or controller) actor may outlive
+    the failed start (ADVICE.md: orphaned proxies on bind failure)."""
+    import socket
+
+    from ray_tpu._private import global_state
+
+    cw = global_state.get_core_worker()
+
+    def live_actor_ids():
+        actors = cw._io.run(cw.gcs.call("list_actors", {}))
+        return {a["actor_id"] for a in actors if a["state"] != "DEAD"}
+
+    before = live_actor_ids()
+    blocker = socket.socket()
+    blocker.bind(("127.0.0.1", 0))
+    blocker.listen(1)
+    port = blocker.getsockname()[1]
+    try:
+        with pytest.raises(Exception):
+            serve.start(http=True, http_port=port)
+        # the module must not think serve is running
+        with pytest.raises(RuntimeError):
+            serve.connect()
+        deadline = time.monotonic() + 30
+        while True:
+            orphans = live_actor_ids() - before
+            if not orphans:
+                break
+            assert time.monotonic() < deadline, (
+                f"orphan actors after failed serve.start: {orphans}")
+            time.sleep(0.25)
+    finally:
+        blocker.close()

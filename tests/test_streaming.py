@@ -3,6 +3,7 @@ word-count e2e is that project's canonical test)."""
 
 import ray_tpu
 from ray_tpu.streaming import StreamingContext
+from tests.conftest import scale_timeout
 
 TEXT = ("the quick brown fox jumps over the lazy dog "
         "the fox is quick and the dog is lazy ").split() * 25  # 450 words
@@ -15,7 +16,7 @@ def test_word_count_parallel_pipeline(ray_start_regular):
         .key_by(lambda t: t[0])
         .reduce(lambda a, b: (a[0], a[1] + b[1])).set_parallelism(2)
         .sink())
-    results = ctx.run(timeout=120)
+    results = ctx.run(timeout=scale_timeout(120))
     counts = {k: v[1] for k, v in results}
     expected = {}
     for w in TEXT:
@@ -36,7 +37,7 @@ def test_filter_flat_map_and_generator_source(ray_start_regular):
         .key_by(lambda x: x % 3)
         .reduce(lambda a, b: a + b)
         .sink())
-    results = dict(ctx.run(timeout=120))
+    results = dict(ctx.run(timeout=scale_timeout(120)))
     evens = [x * 10 for x in range(0, 100, 2) for _ in range(2)]
     expected = {}
     for v in evens:
@@ -49,7 +50,7 @@ def test_sink_transform_collects(ray_start_regular):
     ctx = StreamingContext()
     ctx.from_collection(range(10)).map(lambda x: x + 1).sink(
         lambda x: x * 2)
-    out = sorted(ctx.run(timeout=60))
+    out = sorted(ctx.run(timeout=scale_timeout(60)))
     assert out == [2 * (i + 1) for i in range(10)]
 
 
@@ -62,7 +63,7 @@ def test_parallel_key_by_routes_stably(ray_start_regular):
         .key_by(lambda t: t[0]).set_parallelism(2)
         .reduce(lambda a, b: (a[0], a[1] + b[1])).set_parallelism(3)
         .sink())
-    results = ctx.run(timeout=120)
+    results = ctx.run(timeout=scale_timeout(120))
     counts = {}
     for k, v in results:
         assert k not in counts, f"key {k!r} split across reducers"
@@ -81,7 +82,7 @@ def test_operator_error_propagates_and_cleans_up(ray_start_regular):
         .map(lambda x: 1 // x)   # raises on 0
         .sink())
     with pytest.raises(Exception):
-        ctx.run(timeout=60)
+        ctx.run(timeout=scale_timeout(60))
 
 
 def test_checkpoint_barriers_snapshot_state(ray_start_regular):
@@ -94,7 +95,7 @@ def test_checkpoint_barriers_snapshot_state(ray_start_regular):
     (ctx.from_collection(range(200)).set_parallelism(2)
         .map(lambda x: x + 1).set_parallelism(2)
         .sink())
-    out = ctx.run(timeout=120)
+    out = ctx.run(timeout=scale_timeout(120))
     assert sorted(out) == list(range(1, 201))
     # at least one complete checkpoint was recorded for the job that ran
     # (job ids are internal; verify via the pipeline rerun path instead)
@@ -127,7 +128,7 @@ def test_recovery_resumes_from_checkpoint(ray_start_regular):
         .key_by(lambda x: x % 3).set_parallelism(2)
         .reduce(lambda a, b: a + b)
         .sink())
-    out = ctx.run(timeout=180)
+    out = ctx.run(timeout=scale_timeout(180))
     expected = {}
     for x in range(300):
         k = (2 * x) % 3
@@ -152,5 +153,5 @@ def test_recovery_without_checkpoint_restarts_from_scratch(
 
     ctx = StreamingContext(batch_size=8, max_restarts=1)
     ctx.from_collection(range(80)).map(crash_once).sink()
-    out = ctx.run(timeout=120)
+    out = ctx.run(timeout=scale_timeout(120))
     assert sorted(out) == list(range(80))
