@@ -16,10 +16,11 @@ from ray_tpu.collective.collective import (
     reducescatter,
     send,
 )
-from ray_tpu.collective.types import Backend, ReduceOp
+from ray_tpu.collective.types import Backend, ReduceOp, Transport
 
 __all__ = [
-    "Backend", "CollectiveActorMixin", "ReduceOp", "allgather", "allreduce",
+    "Backend", "CollectiveActorMixin", "ReduceOp", "Transport",
+    "allgather", "allreduce",
     "barrier", "broadcast", "create_collective_group",
     "declare_collective_group", "destroy_collective_group",
     "get_collective_group_size", "get_rank", "init_collective_group",

@@ -1,4 +1,4 @@
-"""HOST backend: cross-process CPU collectives over TCP.
+"""HOST backend: cross-process CPU collectives with a tiered data plane.
 
 The gloo-equivalent of the reference's collective backends (reference:
 python/ray/util/collective/collective_group/ — NCCLGroup :115 and the MPI
@@ -6,27 +6,44 @@ stub). Rendezvous goes through the GCS KV (the reference used a named
 "Info" actor, util.py) — rank 0 binds a TCP hub, publishes its address
 under `collective/<group>`, and every other rank connects.
 
-Topology: star (hub at rank 0). Every collective is served by a shared
-contribution table guarded by a condition variable: the last arriving rank
-computes the reduction, everyone picks up their slice of the result. P2P
-send/recv routes through per-destination mailboxes on the hub. This favors
-correctness and portability; the ICI-bandwidth path on TPU is the XLA
-backend, not this one — HOST carries control-plane-sized tensors (metrics,
-broadcast configs, rendezvous barriers) and stands in for DCN in tests.
+Three transports, selected per op by payload size and node placement:
+
+hub   — star topology, all contributions through rank 0's socket +
+        shared op table. Latency-optimal for control-sized tensors
+        (metrics, barriers, rendezvous); carries every op kind.
+ring  — direct rank-to-rank TCP ring for large tensors: reduce-scatter
+        + allgather schedules for allreduce/reducescatter, block
+        rotation for allgather, a pipelined relay chain for broadcast.
+        Steps are chunk-pipelined (the reduce of chunk k overlaps the
+        receive of chunk k+1) and zero-copy (memoryview slices of the
+        work buffer go straight to sendall; recv_into fills scratch or
+        the destination — no tobytes per step). The unpipelined ring
+        allreduce is preserved verbatim as `ring_unpipelined`, the
+        control arm of the perf A/B.
+shm   — ranks that rendezvous on the same node map one tmpfs segment
+        (native/store segment alloc) and collectives become pure memory
+        traffic: write slot, counter-barrier, reduce a 1/w stripe,
+        read result — zero socket syscalls, zero serialization
+        (shm_transport.py).
+
+Every tier keeps the abort-not-hang contract: a dead peer turns into a
+TimeoutError within the group timeout on every survivor (hub per-op
+timeouts, ring socket timeouts + teardown, shm barrier deadline + abort
+word), so the SGD layer above can resize the group.
 """
 
 from __future__ import annotations
 
+import os
 import socket
 import struct
 import threading
 import time
-from typing import Any
 
 import msgpack
 import numpy as np
 
-from ray_tpu.collective.types import _NUMPY_REDUCE, ReduceOp
+from ray_tpu.collective.types import _NUMPY_REDUCE, ReduceOp, Transport
 
 _HDR = struct.Struct(">I")
 
@@ -77,22 +94,42 @@ class _CollectiveState:
     """Hub-side shared op table. contribute() blocks until the op's result
     is ready; the last contributor computes it."""
 
-    def __init__(self, world_size: int):
+    def __init__(self, world_size: int, sweep_timeout: float = 600.0):
         self.world_size = world_size
+        self.sweep_timeout = sweep_timeout
         self.lock = threading.Lock()
         self.cv = threading.Condition(self.lock)
         self.ops: dict[int, dict] = {}
         self.mailboxes: dict[tuple[int, int, int], tuple[dict, bytes]] = {}
 
+    def _sweep_locked(self):
+        """Completed-but-unread ops leak when a rank dies after
+        contributing but before reading (e.g. rank 0 interrupted inside
+        its local contribute — its arrival completes the op later, but
+        its reader slot never fills, so `readers` can't reach
+        world_size). Drop done ops past the sweep deadline, mirroring
+        the timeout-withdraw path for incomplete ones."""
+        now = time.monotonic()
+        dead = [op_id for op_id, op in self.ops.items()
+                if op.get("done")
+                and now - op.get("done_at", now) > self.sweep_timeout]
+        for op_id in dead:
+            del self.ops[op_id]
+
     def contribute(self, op_id: int, kind: str, rank: int, meta: dict,
                    payload: bytes, timeout: float = 300.0):
         with self.cv:
+            self._sweep_locked()
             op = self.ops.setdefault(op_id, {"arrivals": {}, "result": None,
                                              "done": False})
             op["arrivals"][rank] = (kind, meta, payload)
             if len(op["arrivals"]) == self.world_size:
-                op["result"] = self._compute(kind, op["arrivals"])
+                try:
+                    op["result"] = self._compute(kind, op["arrivals"])
+                except Exception as e:  # mismatched kinds/dtypes: surface
+                    op["error"] = str(e)  # to every rank, don't hang them
                 op["done"] = True
+                op["done_at"] = time.monotonic()
                 self.cv.notify_all()
             else:
                 deadline = time.monotonic() + timeout
@@ -111,16 +148,21 @@ class _CollectiveState:
                             f"{len(op['arrivals'])}/{self.world_size} arrived")
                     self.cv.wait(remaining)
             result = op["result"]
-            # last reader cleans up
+            err = op.get("error")
+            # last reader cleans up (pop: the sweep may have beaten us)
             op.setdefault("readers", set()).add(rank)
             if len(op["readers"]) == self.world_size:
-                del self.ops[op_id]
+                self.ops.pop(op_id, None)
+        if err is not None:
+            raise ValueError(f"collective op {op_id} failed: {err}")
         return result
 
     def _compute(self, kind: str, arrivals: dict):
         ranks = sorted(arrivals)
         kinds = {arrivals[r][0] for r in ranks}
-        assert len(kinds) == 1, f"mismatched collective kinds: {kinds}"
+        if len(kinds) != 1:  # not an assert: must survive python -O —
+            # this is the loud-failure net for route divergence
+            raise ValueError(f"mismatched collective kinds: {kinds}")
         metas = {r: arrivals[r][1] for r in ranks}
         payloads = {r: arrivals[r][2] for r in ranks}
         if kind == "barrier":
@@ -136,10 +178,23 @@ class _CollectiveState:
             return {"kind": kind, "meta": _arr_meta(out),
                     "payload": out.tobytes(),
                     "dst": metas[ranks[0]].get("dst", -1)}
-        if kind == "allgather":
+        if kind in ("allgather", "allgather_ctl_shm",
+                    "allgather_ctl_ring"):
+            # ctl kinds: transport-plumbing exchanges (ring addresses,
+            # shm ok flags), one kind EACH so a rank whose ROUTE diverged
+            # (ragged sizes straddling RING_MIN_BYTES) pairs with a real
+            # allgather as a kind mismatch — a loud ValueError on every
+            # rank, never a silent payload swap.
             return {"kind": "allgather",
                     "metas": [metas[r] for r in ranks],
                     "payloads": [payloads[r] for r in ranks]}
+        if kind == "allgather_meta":
+            # metadata-only control round for the ring data plane: a rank
+            # that routed the payload to the ring must never pair with a
+            # payload-carrying hub allgather (kind mismatch asserts above)
+            return {"kind": "allgather",
+                    "metas": [metas[r] for r in ranks],
+                    "payloads": [b"" for _ in ranks]}
         if kind == "reducescatter":
             op = ReduceOp(metas[ranks[0]]["op"])
             arrays = [_arr_from(metas[r], payloads[r]) for r in ranks]
@@ -170,7 +225,7 @@ class _CollectiveState:
 
 class HostGroup:
     def __init__(self, group_name: str, world_size: int, rank: int,
-                 timeout: float = 60.0):
+                 timeout: float = 60.0, transport: str = "auto"):
         from ray_tpu.experimental import internal_kv
 
         self.group_name = group_name
@@ -183,11 +238,26 @@ class HostGroup:
         self._key = f"collective/{group_name}"
         self._sock: socket.socket | None = None
         self._destroyed = False
+        # Data-plane state: force_transport pins every op to one tier
+        # (tests/benchmarks); "auto" routes by size and node placement.
+        tr = Transport(transport)
+        self.force_transport = None if tr == Transport.AUTO else tr.value
+        self._shm = None
+        self._shm_gen = 0
+        self._shm_disabled = False
+        self._shm_keys: list[str] = []
+        # buffered peer-direct sends awaiting their receiver, ONE per
+        # (dst, tag): a re-send overwrites the unclaimed predecessor
+        # (hub-mailbox semantics — keeps loop-sends to a wedged receiver
+        # from pinning unbounded snapshots/fds); destroy() reaps the rest
+        self._p2p_direct: dict[tuple[int, int], socket.socket] = {}
+        self._p2p_lock = threading.Lock()
         if world_size == 1:
-            self._state = _CollectiveState(1)
+            self._state = _CollectiveState(1, sweep_timeout=timeout * 2)
             return
         if rank == 0:
-            self._state = _CollectiveState(world_size)
+            self._state = _CollectiveState(world_size,
+                                           sweep_timeout=timeout * 2)
             self._listener = socket.socket()
             self._listener.bind(("127.0.0.1", 0))
             self._listener.listen(world_size)
@@ -242,16 +312,26 @@ class HostGroup:
                                      header["meta"], payload)
                     _send_msg(conn, {"ok": True})
                 elif kind == "p2p_recv":
-                    meta, data = self._state.take(header["src"], peer_rank,
-                                                  header["tag"])
+                    try:
+                        meta, data = self._state.take(
+                            header["src"], peer_rank, header["tag"],
+                            timeout=self._timeout)
+                    except TimeoutError as e:
+                        # TimeoutError is an OSError: without this reply
+                        # the outer except would eat it and the client
+                        # would block forever on a reply that never comes
+                        _send_msg(conn, {"error": str(e), "timeout": True})
+                        continue
                     _send_msg(conn, {"meta": meta}, data)
                 else:
                     try:
                         result = self._state.contribute(
                             header["op_id"], kind, peer_rank, header["meta"],
                             payload, timeout=self._timeout)
-                    except TimeoutError as e:
-                        _send_msg(conn, {"error": str(e)})
+                    except Exception as e:
+                        _send_msg(conn, {
+                            "error": str(e),
+                            "timeout": isinstance(e, TimeoutError)})
                         continue
                     reply, data = self._slice_result(result, peer_rank, kind)
                     _send_msg(conn, reply, data)
@@ -291,18 +371,242 @@ class HostGroup:
                   payload)
         reply, data = _recv_msg(self._sock)
         if "error" in reply:
-            raise TimeoutError(reply["error"])
+            if reply.get("timeout", True):
+                raise TimeoutError(reply["error"])
+            raise ValueError(reply["error"])
         return reply, data
 
-    # ---- ring data plane (large tensors) ----
+    def _hub_allgather(self, arr: np.ndarray,
+                       kind: str = "allgather") -> list[np.ndarray]:
+        reply, data = self._collective(kind, _arr_meta(arr),
+                                       arr.tobytes())
+        out, offset = [], 0
+        for m, size in zip(reply["metas"], reply["sizes"]):
+            out.append(_arr_from(m, data[offset:offset + size]))
+            offset += size
+        return out
+
+    def _hub_allgather_meta(self, arr: np.ndarray) -> list[dict]:
+        """Metadata-only allgather (control round for the ring plane)."""
+        reply, _ = self._collective("allgather_meta", _arr_meta(arr), b"")
+        return reply["metas"]
+
+    # ---- transport routing ----
     # The hub is latency-optimal for control-sized tensors but serializes
     # all-to-hub bandwidth through one socket — wrong for gradients
     # (reference role: gloo's ring algorithms behind torch.distributed).
-    # Large allreduces use a bidirectional ring of direct rank-to-rank
-    # TCP connections: reduce-scatter + allgather, 2*(w-1) steps, each
-    # rank moving 2*(w-1)/w of the tensor total.
+    # Large tensors take the shm segment when the whole group shares a
+    # node, else the direct rank-to-rank TCP ring.
 
     RING_MIN_BYTES = 1 << 16
+    _PIPE_BYTES = 1 << 18  # ring pipeline slice: reduce(k) overlaps recv(k+1)
+    # Segments grow by rebuild but never shrink, so one oversize op would
+    # pin (w+2)*slot of tmpfs for the group's life; above the cap the
+    # ring carries the op with no resident cost. Forced shm overrides.
+    SHM_MAX_SLOT_BYTES = int(os.environ.get(
+        "RAY_TPU_COLLECTIVE_SHM_MAX_MB", "32")) << 20
+
+    def _forced(self) -> str | None:
+        f = self.force_transport or os.environ.get(
+            "RAY_TPU_COLLECTIVE_TRANSPORT", "")
+        f = (f or "").strip().lower()
+        if not f or f == Transport.AUTO.value:
+            return None
+        return Transport(f).value  # validates the name
+
+    def _route(self, arr: np.ndarray) -> list[str]:
+        """Ordered transport candidates for one op. All ranks compute the
+        same route (collectives pass same-geometry tensors by contract;
+        ragged allgather is caught by the allgather_meta control round)."""
+        f = self._forced()
+        if f:
+            return [f]
+        if (self._destroyed or self.world_size == 1 or arr.ndim == 0
+                or arr.ndim > 24 or arr.nbytes < self.RING_MIN_BYTES):
+            return [Transport.HUB.value]
+        tiers = []
+        if (not self._shm_disabled
+                and arr.nbytes <= self.SHM_MAX_SLOT_BYTES):
+            tiers.append(Transport.SHM.value)
+        if self.world_size > 2:  # 2-rank ring degenerates to pairwise
+            tiers.append(Transport.RING.value)
+        tiers.append(Transport.HUB.value)
+        return tiers
+
+    def _forced_unavailable(self, tr: str):
+        if self._forced() == tr:
+            raise RuntimeError(
+                f"forced collective transport {tr!r} is unavailable for "
+                f"group {self.group_name!r} (world={self.world_size})")
+
+    @staticmethod
+    def _abort_not_hang(e: Exception):
+        """Normalize transport failures: a dead/stalled peer surfaces as
+        TimeoutError on every survivor (the contract the SGD resize path
+        keys on); programmer errors (dtype/shape mismatch) pass through."""
+        if isinstance(e, (ConnectionError, OSError)) and not isinstance(
+                e, TimeoutError):
+            raise TimeoutError(f"collective aborted: {e}") from e
+        raise e
+
+    def _shm_op(self, fn):
+        try:
+            return fn()
+        except Exception as e:
+            # any failure mid-op leaves ranks at different barrier phases:
+            # poison the segment (peers abort, not hang) and never reuse it
+            t, self._shm = self._shm, None
+            if t is not None:
+                try:
+                    t.abort()
+                finally:
+                    t.close()
+            self._shm_disabled = True
+            self._abort_not_hang(e)
+
+    def _ring_op(self, fn):
+        try:
+            return fn()
+        except Exception as e:
+            # a failed ring op leaves peers at different steps: the
+            # connections are unusable, rebuild from scratch next op
+            self._ring_teardown()
+            self._abort_not_hang(e)
+
+    # ---- shm data plane ----
+
+    @staticmethod
+    def _node_token() -> str | None:
+        try:
+            from ray_tpu._private import global_state
+
+            cw = global_state.get_core_worker()
+            if cw is not None and cw.node_id is not None:
+                return cw.node_id.hex()
+        except Exception:
+            pass
+        return None
+
+    def _ensure_shm(self, need_bytes: int):
+        """Map (or grow) the group's shared segment. Every rank computes
+        the same need (collective contract), so rebuild generations stay
+        aligned without extra coordination; the ok-flag allgather through
+        the hub makes enable/disable unanimous."""
+        if self._shm_disabled or self.world_size == 1 or self._destroyed:
+            return None
+        if (need_bytes > self.SHM_MAX_SLOT_BYTES
+                and self._forced() != Transport.SHM.value):
+            # result-dtype promotion (e.g. int8 MEAN -> float64) can
+            # inflate the slot well past the routed nbytes; enforce the
+            # tmpfs budget on the real slot need (forced shm overrides)
+            return None
+        if self._shm is not None and self._shm.slot_bytes >= need_bytes:
+            return self._shm
+        from ray_tpu.collective.backends.shm_transport import ShmTransport
+        from ray_tpu.experimental import internal_kv
+        from ray_tpu.native.store import is_shared_memory_path
+
+        if self._shm is not None:  # grow: all ranks rebuild together
+            self._shm.close()
+            self._shm = None
+        slot = max(1 << 20, 1 << (need_bytes - 1).bit_length())
+        self._shm_gen += 1
+        key = f"{self._key}/shm{self._shm_gen}"
+        seg, ok = None, 0
+        if self.rank == 0:
+            self._shm_keys.append(key)  # destroy() clears even fail markers
+        try:
+            if self.rank == 0:
+                cookie = os.urandom(16)
+                name = (f"{self.group_name}_g{self._shm_gen}_"
+                        f"{cookie.hex()[:8]}.seg")
+                try:
+                    seg = ShmTransport.create(name, cookie, self.world_size,
+                                              0, slot, self._timeout)
+                    token = self._node_token()
+                    if token is None and not is_shared_memory_path(seg.path):
+                        # without a node id, only /dev/shm placement
+                        # proves the mapping is node-local memory
+                        raise RuntimeError(
+                            "no node identity and segment not on /dev/shm")
+                    internal_kv._kv_put(key, msgpack.packb(
+                        {"path": seg.path, "cookie": cookie, "slot": slot,
+                         "node": token}, use_bin_type=True))
+                except Exception:
+                    internal_kv._kv_put(key, msgpack.packb(
+                        {"fail": True}, use_bin_type=True))
+                    raise
+            else:
+                deadline = time.monotonic() + self._timeout
+                info = None
+                while time.monotonic() < deadline:
+                    data = internal_kv._kv_get(key)
+                    if data:
+                        info = msgpack.unpackb(data, raw=False)
+                        break
+                    time.sleep(0.02)
+                if info is None:
+                    raise TimeoutError("shm segment rendezvous timed out")
+                if info.get("fail"):
+                    raise RuntimeError("rank 0 could not create the segment")
+                token = self._node_token()
+                if info["node"] is not None and token is not None:
+                    if info["node"] != token:
+                        raise RuntimeError(
+                            "rank is on a different node than rank 0")
+                elif not is_shared_memory_path(info["path"]):
+                    raise RuntimeError(
+                        "cannot prove node locality for shm segment")
+                seg = ShmTransport.open(info["path"], info["cookie"],
+                                        self.world_size, self.rank,
+                                        info["slot"], self._timeout)
+            ok = 1
+        except Exception:
+            ok = 0
+        try:
+            flags = self._hub_allgather(np.array([ok], np.uint8),
+                                        kind="allgather_ctl_shm")
+        except BaseException:
+            if seg is not None:
+                seg.close()  # rank 0 unlinks; tmpfs bytes must not leak
+            raise
+        if all(int(f[0]) for f in flags):
+            try:
+                seg.barrier()  # join fence: everyone mapped before first op
+            except BaseException:
+                # a peer died between the flag exchange and the fence:
+                # close (rank 0 unlinks) or the tmpfs bytes leak forever
+                seg.close()
+                self._shm_disabled = True
+                raise
+            if self.rank == 0:
+                # every rank is mapped (the fence proves it) and nothing
+                # reopens this generation: unlink NOW so the tmpfs bytes
+                # die with the last mapping even if rank 0 is SIGKILLed
+                try:
+                    os.unlink(seg.path)
+                except OSError:
+                    pass
+            self._shm = seg
+            return seg
+        if seg is not None:
+            seg.close()
+        self._shm_disabled = True  # unanimous: don't pay the probe again
+        return None
+
+    def _shm_need(self, arr: np.ndarray, op: ReduceOp | None) -> int:
+        """Slot bytes that fit both the contribution and half the result
+        region (the result region is 2 slots; MEAN promotes integers to
+        float64, which can outgrow the input slot)."""
+        from ray_tpu.collective.backends.shm_transport import result_dtype
+
+        need = arr.nbytes
+        if op is not None:
+            need = max(need, (arr.size * result_dtype(arr.dtype, op).itemsize
+                              + 1) // 2)
+        return max(need, 1)
+
+    # ---- ring data plane ----
 
     def _ensure_ring(self) -> bool:
         if self.world_size <= 2:
@@ -314,7 +618,8 @@ class HostGroup:
         listener.listen(2)
         port = listener.getsockname()[1]
         addr = f"127.0.0.1:{port}".encode().ljust(32, b"\0")
-        addrs = self.allgather(np.frombuffer(addr, np.uint8))
+        addrs = self._hub_allgather(np.frombuffer(addr, np.uint8),
+                                    kind="allgather_ctl_ring")
         nxt = bytes(addrs[(self.rank + 1) % self.world_size]
                     ).rstrip(b"\0").decode()
         host, p = nxt.rsplit(":", 1)
@@ -365,6 +670,9 @@ class HostGroup:
         finally:
             listener.close()
         out["sock"].settimeout(self._timeout)
+        # pipelined slices are small; don't let Nagle hold the tail
+        for s in (out["sock"], prev_sock):
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._ring_next = out["sock"]
         self._ring_prev = prev_sock
         return True
@@ -382,6 +690,8 @@ class HostGroup:
                 except Exception:
                     pass
             setattr(self, name, None)
+
+    # -- legacy (unpipelined) ring: the A/B control arm ----------------
 
     @staticmethod
     def _ring_send(sock: socket.socket, data: bytes):
@@ -416,6 +726,9 @@ class HostGroup:
         return data
 
     def _ring_allreduce(self, arr: np.ndarray, op: ReduceOp) -> np.ndarray:
+        """Unpipelined ring allreduce — one tobytes frame per step.
+        Preserved as the control arm of the pipelined-ring perf A/B
+        (force_transport='ring_unpipelined')."""
         w = self.world_size
         flat = arr.reshape(-1)
         pad = (-len(flat)) % w
@@ -458,23 +771,256 @@ class HostGroup:
             return out
         return out.astype(arr.dtype, copy=False)
 
+    # -- pipelined zero-copy ring --------------------------------------
+
+    def _ring_recv_into(self, mv: memoryview):
+        sock = self._ring_prev
+        got, n = 0, len(mv)
+        while got < n:
+            r = sock.recv_into(mv[got:], n - got)
+            if not r:
+                raise ConnectionError("collective peer disconnected")
+            got += r
+
+    def _ring_send_async(self, send_mv: memoryview):
+        """Stream a work-buffer slice to the next rank in _PIPE_BYTES
+        pieces (memoryview slices — no tobytes copy), off-thread so the
+        caller can consume the previous rank's stream concurrently.
+        Small steps send inline: a <=16KB sendall into a peer buffer
+        that the previous step fully drained cannot block (SO_SNDBUF
+        floors are far larger), and skipping the thread keeps
+        just-over-threshold collectives from paying thread churn per
+        step."""
+        if not len(send_mv):
+            return None, []
+        if len(send_mv) <= (1 << 14):
+            self._ring_next.sendall(send_mv)
+            return None, []
+        err: list = []
+
+        def _send():
+            try:
+                off, n = 0, len(send_mv)
+                while off < n:
+                    self._ring_next.sendall(
+                        send_mv[off:off + self._PIPE_BYTES])
+                    off += self._PIPE_BYTES
+            except Exception as e:
+                err.append(e)
+
+        t = threading.Thread(target=_send, daemon=True)
+        t.start()
+        return t, err
+
+    def _ring_join(self, t, err):
+        if t is None:
+            return
+        t.join(self._timeout)
+        if t.is_alive() or err:
+            raise TimeoutError(
+                f"ring send stalled/failed: {err or 'timeout'}")
+
+    def _ring_step_reduce(self, send_mv: memoryview, dst: np.ndarray,
+                          scratch: np.ndarray, combine):
+        """One pipelined reduce step: stream `send_mv` out while pulling
+        dst.nbytes from prev in slices; each slice is combined into `dst`
+        the moment it lands, so the reduce of slice k overlaps the
+        receive of slice k+1 (the peer keeps filling the socket buffer
+        while we compute). No frame headers: both sides derive the same
+        chunk schedule, so the stream is self-describing."""
+        t, err = self._ring_send_async(send_mv)
+        smv = memoryview(scratch).cast("B")
+        isz = dst.itemsize
+        total, off = dst.nbytes, 0
+        while off < total:
+            n = min(self._PIPE_BYTES, total - off)
+            self._ring_recv_into(smv[:n])
+            k = n // isz
+            lo = off // isz
+            combine(dst[lo:lo + k], scratch[:k], out=dst[lo:lo + k])
+            off += n
+        self._ring_join(t, err)
+
+    def _ring_step_gather(self, send_mv: memoryview, recv_mv: memoryview):
+        """One pipelined gather step: stream out while receiving straight
+        into the destination region (recv_into — zero-copy)."""
+        t, err = self._ring_send_async(send_mv)
+        self._ring_recv_into(recv_mv)
+        self._ring_join(t, err)
+
+    def _prep_ring_work(self, arr: np.ndarray, op: ReduceOp):
+        flat = arr.reshape(-1)
+        # MEAN matches hub np.mean semantics: float64 accumulate and a
+        # float result for integer inputs (also dodges overflow), f32
+        # intermediates for f16 (np.mean does the same; a raw f16 add
+        # chain loses whole units at a few thousand)
+        if op == ReduceOp.MEAN and not np.issubdtype(arr.dtype,
+                                                     np.floating):
+            work = flat.astype(np.float64)
+        elif op == ReduceOp.MEAN and arr.dtype == np.float16:
+            work = flat.astype(np.float32)
+        else:
+            work = flat.copy()
+        combine = getattr(
+            np, _NUMPY_REDUCE[ReduceOp.SUM if op == ReduceOp.MEAN
+                              else ReduceOp(op)])
+        return work, combine
+
+    def _ring_scratch(self, work: np.ndarray, bounds: list[int]):
+        maxel = max((bounds[i + 1] - bounds[i] for i in range(len(bounds) - 1)),
+                    default=0)
+        n = min(maxel, self._PIPE_BYTES // work.itemsize)
+        return np.empty(max(n, 1), work.dtype)
+
+    def _ring_reduce_scatter_phase(self, work, bounds, combine, scratch,
+                                   delta: int):
+        """w-1 pipelined reduce steps; with delta=0 rank r ends holding
+        reduced chunk r+1 (the allreduce schedule), with delta=-1 it ends
+        holding chunk r (the reducescatter schedule)."""
+        w = self.world_size
+        wv = memoryview(work).cast("B")
+        isz = work.itemsize
+
+        def mv(i):
+            i %= w
+            return wv[bounds[i] * isz:bounds[i + 1] * isz]
+
+        def el(i):
+            i %= w
+            return work[bounds[i]:bounds[i + 1]]
+
+        for step in range(w - 1):
+            send_i = self.rank - step + delta
+            recv_i = send_i - 1
+            self._ring_step_reduce(mv(send_i), el(recv_i), scratch, combine)
+
+    def _ring_allreduce_pipelined(self, arr: np.ndarray,
+                                  op: ReduceOp) -> np.ndarray:
+        from ray_tpu.collective.backends.shm_transport import split_bounds
+
+        w = self.world_size
+        work, combine = self._prep_ring_work(arr, op)
+        bounds = split_bounds(work.size, w)
+        scratch = self._ring_scratch(work, bounds)
+        self._ring_reduce_scatter_phase(work, bounds, combine, scratch, 0)
+        wv = memoryview(work).cast("B")
+        isz = work.itemsize
+
+        def mv(i):
+            i %= w
+            return wv[bounds[i] * isz:bounds[i + 1] * isz]
+
+        for step in range(w - 1):  # allgather of reduced chunks
+            self._ring_step_gather(mv(self.rank + 1 - step),
+                                   mv(self.rank - step))
+        if op == ReduceOp.MEAN:
+            work = work / w  # float result, like the hub's np.mean
+            if arr.dtype == np.float16:
+                work = work.astype(np.float16)  # f32 accumulate, f16 out
+        return work.reshape(arr.shape)
+
+    def _ring_reducescatter_pipelined(self, arr: np.ndarray,
+                                      op: ReduceOp) -> np.ndarray:
+        from ray_tpu.collective.backends.shm_transport import split_bounds
+
+        w = self.world_size
+        work, combine = self._prep_ring_work(arr, op)
+        # hub semantics: np.array_split along axis 0 — row blocks are
+        # contiguous element ranges in C order
+        rows = arr.shape[0] if arr.ndim else 1
+        rowsz = arr.size // rows if rows else 0
+        rb = split_bounds(rows, w)
+        bounds = [r * rowsz for r in rb]
+        scratch = self._ring_scratch(work, bounds)
+        self._ring_reduce_scatter_phase(work, bounds, combine, scratch, -1)
+        res = work[bounds[self.rank]:bounds[self.rank + 1]]
+        if op == ReduceOp.MEAN:
+            res = res / w
+            if arr.dtype == np.float16:
+                res = res.astype(np.float16)  # f32 accumulate, f16 out
+        return res.reshape((rb[self.rank + 1] - rb[self.rank],)
+                           + arr.shape[1:]).copy()
+
+    def _ring_allgather_pipelined(self, arr: np.ndarray):
+        """Block-rotation allgather over uniform-shape contributions
+        (the caller's meta round guarantees uniformity)."""
+        w = self.world_size
+        n = arr.nbytes
+        out = np.empty(w * arr.size, arr.dtype)
+        ov = memoryview(out).cast("B")
+        ov[self.rank * n:(self.rank + 1) * n] = memoryview(arr).cast("B")
+
+        def mv(i):
+            i %= w
+            return ov[i * n:(i + 1) * n]
+
+        for step in range(w - 1):
+            self._ring_step_gather(mv(self.rank - step),
+                                   mv(self.rank - step - 1))
+        return [out[i * arr.size:(i + 1) * arr.size].reshape(arr.shape)
+                for i in range(w)]
+
+    def _ring_broadcast_pipelined(self, arr: np.ndarray,
+                                  src_rank: int) -> np.ndarray:
+        """Pipelined relay chain src → src+1 → … → src-1: each slice is
+        forwarded the moment it lands, so after the w-hop fill the whole
+        chain streams concurrently. Acyclic per slice — no deadlock."""
+        w = self.world_size
+        out = arr if self.rank == src_rank else np.empty_like(arr)
+        ov = memoryview(out).cast("B")
+        do_recv = self.rank != src_rank
+        do_send = (self.rank + 1) % w != src_rank
+        total, off = out.nbytes, 0
+        while off < total:
+            n = min(self._PIPE_BYTES, total - off)
+            if do_recv:
+                self._ring_recv_into(ov[off:off + n])
+            if do_send:
+                self._ring_next.sendall(ov[off:off + n])
+            off += n
+        # fresh writable result on every rank/tier, like the hub
+        return out.copy() if out is arr else out
+
+    # ---- collectives (routed) ----
+
+    def _run_routed(self, arr: np.ndarray, shm_need: int, shm_fn, ring_fn,
+                    hub_fn):
+        """One route/fallback/poison dispatch for the uniform-geometry
+        collectives (allgather is bespoke: its geometry may be ragged).
+        shm_fn(transport), ring_fn(pipelined: bool), hub_fn()."""
+        for tr in self._route(arr):
+            if tr == Transport.SHM.value:
+                t = self._ensure_shm(shm_need)
+                if t is None:
+                    self._forced_unavailable(tr)
+                    continue
+                return self._shm_op(lambda: shm_fn(t))
+            if tr in (Transport.RING.value, Transport.RING_UNPIPELINED.value):
+                if not self._ring_op(self._ensure_ring):
+                    self._forced_unavailable(tr)
+                    continue
+                pipelined = tr == Transport.RING.value
+                return self._ring_op(lambda: ring_fn(pipelined))
+            return hub_fn()
+        raise RuntimeError("no collective transport available")
+
     def allreduce(self, arr: np.ndarray, op: ReduceOp = ReduceOp.SUM):
         arr = np.ascontiguousarray(arr)
-        if (arr.nbytes >= self.RING_MIN_BYTES and self.world_size > 2
-                and not self._destroyed):
-            if self._ensure_ring():  # collective all-or-nothing setup
-                try:
-                    return self._ring_allreduce(arr, ReduceOp(op))
-                except Exception:
-                    # abort-not-hang invariant: surface the failure (the
-                    # SGD layer resizes); the broken ring never reused.
-                    # Any exception mid-ring (transport OR dtype/shape
-                    # mismatch) leaves peers desynced — always tear down.
-                    self._ring_teardown()
-                    raise
-        reply, data = self._collective(
-            "allreduce", {**_arr_meta(arr), "op": op.value}, arr.tobytes())
-        return _arr_from(reply["meta"], data)
+        op = ReduceOp(op)
+
+        def hub():
+            reply, data = self._collective(
+                "allreduce", {**_arr_meta(arr), "op": op.value},
+                arr.tobytes())
+            return _arr_from(reply["meta"], data)
+
+        return self._run_routed(
+            arr, self._shm_need(arr, op),
+            lambda t: t.allreduce(arr, op),
+            lambda pipelined: (self._ring_allreduce_pipelined(arr, op)
+                               if pipelined else
+                               self._ring_allreduce(arr, op)),
+            hub)
 
     def reduce(self, arr: np.ndarray, dst_rank: int = 0,
                op: ReduceOp = ReduceOp.SUM):
@@ -488,36 +1034,83 @@ class HostGroup:
 
     def broadcast(self, arr: np.ndarray, src_rank: int = 0):
         arr = np.ascontiguousarray(arr)
-        payload = arr.tobytes() if self.rank == src_rank else b""
-        meta = {**_arr_meta(arr), "src": src_rank}
-        reply, data = self._collective("broadcast", meta, payload)
-        return _arr_from(reply["meta"], data)
+
+        def hub():
+            payload = arr.tobytes() if self.rank == src_rank else b""
+            meta = {**_arr_meta(arr), "src": src_rank}
+            reply, data = self._collective("broadcast", meta, payload)
+            return _arr_from(reply["meta"], data)
+
+        return self._run_routed(
+            arr, self._shm_need(arr, None),
+            lambda t: t.broadcast(arr, src_rank),
+            lambda pipelined: self._ring_broadcast_pipelined(arr, src_rank),
+            hub)
 
     def allgather(self, arr: np.ndarray) -> list[np.ndarray]:
+        # allgather is the one op whose per-rank GEOMETRY may
+        # legitimately differ, so local-size routing can diverge (ragged
+        # sizes straddling RING_MIN_BYTES). Every rank therefore opens
+        # with the SAME metadata-only hub round and routes on the union:
+        # fast tiers only for uniform shapes, the hub (which supports
+        # ragged gathers natively) otherwise. One extra control
+        # round-trip, paid once, instead of per-tier probing — and no
+        # route divergence is possible.
         arr = np.ascontiguousarray(arr)
-        reply, data = self._collective("allgather", _arr_meta(arr),
-                                       arr.tobytes())
-        if "payloads" in reply:  # rank-0 local path
-            return [_arr_from(m, p)
-                    for m, p in zip(reply["metas"], reply["payloads"])]
-        out, offset = [], 0
-        for m, size in zip(reply["metas"], reply["sizes"]):
-            out.append(_arr_from(m, data[offset:offset + size]))
-            offset += size
-        return out
+        if self.world_size == 1 or self._destroyed:
+            return self._hub_allgather(arr)
+        metas = self._hub_allgather_meta(arr)
+        uniform = all(m == metas[0] for m in metas[1:])
+        for tr in self._route(arr) if uniform else [Transport.HUB.value]:
+            if tr == Transport.SHM.value:
+                t = self._ensure_shm(self._shm_need(arr, None))
+                if t is None:
+                    self._forced_unavailable(tr)
+                    continue
+                out = self._shm_op(lambda: t.allgather(arr))
+                if out is not None:
+                    return out
+                continue  # defense-in-depth: shm saw ragged metas
+            if tr in (Transport.RING.value, Transport.RING_UNPIPELINED.value):
+                if not self._ring_op(self._ensure_ring):
+                    self._forced_unavailable(tr)
+                    continue
+                return self._ring_op(
+                    lambda: self._ring_allgather_pipelined(arr))
+            return self._hub_allgather(arr)
+        # pinned non-hub transport exhausted (e.g. forced shm + ragged):
+        # the hub is the only tier that can express it
+        return self._hub_allgather(arr)
 
     def reducescatter(self, arr: np.ndarray, op: ReduceOp = ReduceOp.SUM):
         arr = np.ascontiguousarray(arr)
-        reply, data = self._collective(
-            "reducescatter", {**_arr_meta(arr), "op": op.value},
-            arr.tobytes())
-        return _arr_from(reply["meta"], data)
+        op = ReduceOp(op)
+
+        def hub():
+            reply, data = self._collective(
+                "reducescatter", {**_arr_meta(arr), "op": op.value},
+                arr.tobytes())
+            return _arr_from(reply["meta"], data)
+
+        return self._run_routed(
+            arr, self._shm_need(arr, op),
+            lambda t: t.reducescatter(arr, op),
+            lambda pipelined: self._ring_reducescatter_pipelined(arr, op),
+            hub)
 
     def barrier(self):
         self._collective("barrier", {}, b"")
 
+    # ---- p2p ----
+    # The hub mailbox always carries the rendezvous/control message;
+    # payloads above RING_MIN_BYTES go peer-direct (one rank-to-rank
+    # connection) instead of double-copying through rank 0.
+
     def send(self, arr: np.ndarray, dst_rank: int, tag: int = 0):
         arr = np.ascontiguousarray(arr)
+        if (arr.nbytes >= self.RING_MIN_BYTES and self.world_size > 1
+                and dst_rank != self.rank and not self._destroyed):
+            return self._send_direct(arr, dst_rank, tag)
         if self.rank == 0:
             self._state.post(0, dst_rank, tag, _arr_meta(arr), arr.tobytes())
             return
@@ -526,20 +1119,123 @@ class HostGroup:
                   arr.tobytes())
         _recv_msg(self._sock)  # ack
 
+    def _send_direct(self, arr: np.ndarray, dst_rank: int, tag: int):
+        """Post the rendezvous control message to the hub mailbox, then
+        serve the payload from a background thread — send() keeps the
+        hub path's buffered semantics (returns without waiting for the
+        receiver, so symmetric send/send-then-recv/recv patterns can't
+        deadlock). The payload is snapshotted first, so mutating the
+        tensor after send() returns cannot corrupt the transfer. The
+        listener has NO deadline of its own: like a hub mailbox entry,
+        the buffered payload stays claimable until the receiver takes it
+        or the group is destroyed (destroy() closes the listener, which
+        frees the thread and the snapshot) — recv-side timeouts still
+        bound every blocking reader, so there is no expiry cliff at the
+        RING_MIN_BYTES threshold."""
+        arr = arr.copy()
+        listener = socket.socket()
+        listener.bind(("127.0.0.1", 0))
+        listener.listen(1)
+        key = (dst_rank, tag)
+        with self._p2p_lock:
+            stale = self._p2p_direct.pop(key, None)
+            self._p2p_direct[key] = listener
+        if stale is not None:
+            try:  # overwrite the unclaimed predecessor, like the mailbox
+                stale.close()  # (an in-flight transfer keeps its conn fd)
+            except Exception:
+                pass
+        port = listener.getsockname()[1]
+        ctrl = {**_arr_meta(arr), "peer_direct": f"127.0.0.1:{port}"}
+
+        def _serve():
+            conn = None
+            try:
+                conn, _ = listener.accept()  # until taken/overwritten/
+                conn.settimeout(self._timeout)  # destroyed
+                conn.sendall(memoryview(arr).cast("B"))
+                conn.recv(1)  # receiver ack bounds arr's lifetime
+            except OSError:
+                pass  # abort-not-hang: the receiver sees a short read
+            finally:
+                if conn is not None:
+                    conn.close()
+                listener.close()
+                with self._p2p_lock:
+                    if self._p2p_direct.get(key) is listener:
+                        del self._p2p_direct[key]
+
+        t = threading.Thread(target=_serve, daemon=True)
+        t.start()
+        try:
+            if self.rank == 0:
+                self._state.post(0, dst_rank, tag, ctrl, b"")
+            else:
+                _send_msg(self._sock, {"kind": "p2p_send", "dst": dst_rank,
+                                       "tag": tag, "meta": ctrl})
+                _recv_msg(self._sock)  # hub ack
+        except BaseException:
+            listener.close()  # unblocks the serve thread
+            raise
+
     def recv(self, src_rank: int, tag: int = 0) -> np.ndarray:
         if self.rank == 0:
-            meta, data = self._state.take(src_rank, 0, tag)
-            return _arr_from(meta, data)
-        _send_msg(self._sock, {"kind": "p2p_recv", "src": src_rank,
-                               "tag": tag})
-        reply, data = _recv_msg(self._sock)
-        return _arr_from(reply["meta"], data)
+            meta, data = self._state.take(src_rank, 0, tag,
+                                          timeout=self._timeout)
+        else:
+            _send_msg(self._sock, {"kind": "p2p_recv", "src": src_rank,
+                                   "tag": tag})
+            reply, data = _recv_msg(self._sock)
+            if "error" in reply:
+                raise TimeoutError(reply["error"])
+            meta = reply["meta"]
+        if meta and meta.get("peer_direct"):
+            return self._recv_direct(meta)
+        return _arr_from(meta, data)
+
+    def _recv_direct(self, meta: dict) -> np.ndarray:
+        host, port = meta["peer_direct"].rsplit(":", 1)
+        out = np.empty(meta["shape"], np.dtype(meta["dtype"]))
+        try:
+            sock = socket.create_connection((host, int(port)),
+                                            timeout=self._timeout)
+        except OSError as e:
+            raise TimeoutError(
+                f"peer-direct recv: sender unreachable: {e}") from e
+        try:
+            sock.settimeout(self._timeout)
+            mv = memoryview(out).cast("B")
+            got, n = 0, out.nbytes
+            while got < n:
+                r = sock.recv_into(mv[got:], n - got)
+                if not r:
+                    raise TimeoutError(  # abort-not-hang: peer died
+                        "peer-direct sender disconnected mid-transfer")
+                got += r
+            sock.sendall(b"\x01")
+        finally:
+            sock.close()
+        return out
 
     def destroy(self):
         if self._destroyed:
             return
         self._destroyed = True
         self._ring_teardown()
+        with self._p2p_lock:
+            pending = list(self._p2p_direct.values())
+            self._p2p_direct.clear()
+        for listener in pending:
+            try:
+                listener.close()  # frees the serve thread + snapshot
+            except Exception:
+                pass
+        if self._shm is not None:
+            try:
+                self._shm.close()
+            except Exception:
+                pass
+            self._shm = None
         if self.rank == 0 and self.world_size > 1:
             try:
                 self._listener.close()
@@ -547,10 +1243,11 @@ class HostGroup:
                 pass
             from ray_tpu.experimental import internal_kv
 
-            try:
-                internal_kv._kv_del(self._key)
-            except Exception:
-                pass
+            for key in [self._key, *self._shm_keys]:
+                try:
+                    internal_kv._kv_del(key)
+                except Exception:
+                    pass
         if self._sock is not None:
             try:
                 self._sock.close()

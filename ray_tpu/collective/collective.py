@@ -11,7 +11,7 @@ from typing import Any
 
 import numpy as np
 
-from ray_tpu.collective.types import Backend, ReduceOp
+from ray_tpu.collective.types import Backend, ReduceOp, Transport
 
 
 class GroupManager:
@@ -23,7 +23,8 @@ class GroupManager:
         self._groups: dict[str, Any] = {}
 
     def create_group(self, group_name: str, world_size: int, rank: int,
-                     backend: Backend, timeout: float = 60.0):
+                     backend: Backend, timeout: float = 60.0,
+                     transport: str = "auto"):
         backend = Backend(backend)
         if backend == Backend.AUTO:
             backend = Backend.XLA if world_size == 1 else Backend.HOST
@@ -33,7 +34,8 @@ class GroupManager:
         if backend == Backend.HOST:
             from ray_tpu.collective.backends.host_backend import HostGroup
 
-            group = HostGroup(group_name, world_size, rank, timeout=timeout)
+            group = HostGroup(group_name, world_size, rank, timeout=timeout,
+                              transport=Transport(transport).value)
         else:
             from ray_tpu.parallel import multihost
 
@@ -84,12 +86,15 @@ _manager = GroupManager()
 def init_collective_group(world_size: int, rank: int,
                           backend: str = "host",
                           group_name: str = "default",
-                          timeout: float = 60.0):
+                          timeout: float = 60.0,
+                          transport: str = "auto"):
     """Initialize this process's membership in a collective group
     (reference: collective.py:93). Call from inside each participating
-    actor/task with its rank."""
+    actor/task with its rank. `transport` pins the HOST data plane to
+    one tier (hub/ring/ring_unpipelined/shm); "auto" routes per op."""
     return _manager.create_group(group_name, world_size, rank,
-                                 Backend(backend), timeout=timeout)
+                                 Backend(backend), timeout=timeout,
+                                 transport=transport)
 
 
 def create_collective_group(actors, world_size: int, ranks: list[int],

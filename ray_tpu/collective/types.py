@@ -22,6 +22,31 @@ class Backend(str, enum.Enum):
     AUTO = "auto"
 
 
+class Transport(str, enum.Enum):
+    """HOST-backend data-plane tiers (selected per op by payload size and
+    node placement; pin one with HostGroup(transport=...) or the
+    RAY_TPU_COLLECTIVE_TRANSPORT env var — tests and the perf A/B do).
+
+    HUB — star topology through rank 0's socket; latency-optimal for
+          control-sized tensors, carries every op kind.
+    RING — direct rank-to-rank TCP ring, chunk-pipelined and zero-copy;
+          the bandwidth path for large tensors across nodes.
+    RING_UNPIPELINED — the pre-pipelining ring ALLREDUCE, preserved as
+          the control arm of the perf A/B. Allreduce-only: the other
+          collectives never had an unpipelined ring, so under this pin
+          they run the pipelined ring data plane.
+    SHM — one mmap'd tmpfs segment per group when every rank shares a
+          node: collectives become pure memory traffic.
+    AUTO — shm when node-local, else ring, else hub.
+    """
+
+    AUTO = "auto"
+    HUB = "hub"
+    RING = "ring"
+    RING_UNPIPELINED = "ring_unpipelined"
+    SHM = "shm"
+
+
 class ReduceOp(str, enum.Enum):
     SUM = "sum"
     PRODUCT = "product"

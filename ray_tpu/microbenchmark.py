@@ -161,10 +161,85 @@ def main(seconds_per_case: float = 2.0) -> list[dict]:
     timeit("n:n actor calls async", actors_async, multiplier=100,
            results=results)
 
+    _collective_bench(results)
+
     _serve_qps(results)
 
     ray_tpu.shutdown()
     return results
+
+
+def _collective_bench(results: list[dict], nbytes: int = 16 * 1024 * 1024,
+                      world: int = 4, windows: int = 5):
+    """Host collective data-plane A/B: one 16MB float32 allreduce across
+    4 single-node ranks per window, every transport forced in turn
+    inside the SAME window (interleaved — a box-load swing hits all arms
+    equally), median of N windows, GB/s/rank. `ring_unpipelined` is the
+    preserved pre-pipelining control arm; the small-hub case guards
+    control-plane latency against regressions from the routing layer."""
+    from ray_tpu.collective import collective as col
+
+    @ray_tpu.remote(num_cpus=0)
+    class BenchRank(col.CollectiveActorMixin):
+        def timed_allreduce(self, transport, n_elems):
+            import time as _t
+
+            import numpy as _np
+
+            from ray_tpu.collective import collective as C
+
+            group = C._manager.get_group("bench_col")
+            arr = _np.ones(n_elems, _np.float32)
+            group.barrier()  # hub-direct: lines ranks up, never routed
+            group.force_transport = transport
+            t0 = _t.perf_counter()
+            group.allreduce(arr)
+            return _t.perf_counter() - t0
+
+        def teardown(self):
+            from ray_tpu.collective import collective as C
+
+            C.destroy_collective_group("bench_col")  # rank 0 unlinks
+            return True                              # the shm segment
+
+    ranks = [BenchRank.remote() for _ in range(world)]
+    col.create_collective_group(ranks, world, list(range(world)),
+                                backend="host", group_name="bench_col")
+    cases = ["shm", "ring", "ring_unpipelined", "hub"]
+    for tr in cases:  # warm at FULL size: segment sized+faulted in, ring
+        ray_tpu.get(   # built, hub buffers grown — no setup in the windows
+            [r.timed_allreduce.remote(tr, nbytes // 4) for r in ranks],
+            timeout=300)
+    samples: dict[str, list[float]] = {tr: [] for tr in cases}
+    small: list[float] = []
+    for _ in range(windows):
+        for tr in cases:
+            ts = ray_tpu.get(
+                [r.timed_allreduce.remote(tr, nbytes // 4) for r in ranks],
+                timeout=300)
+            samples[tr].append(max(ts))  # slowest rank bounds the op
+        ts = ray_tpu.get(
+            [r.timed_allreduce.remote("hub", 256) for r in ranks],
+            timeout=120)
+        small.append(max(ts))
+    for tr in cases:
+        med = float(np.median(samples[tr]))
+        gbps = nbytes / med / 1e9
+        print(f"collective_allreduce_{tr} 16MB/4-rank GB/s/rank "
+              f"{gbps:.3f} (median of {windows})")
+        results.append({
+            "name": f"collective_allreduce_{tr}", "per_second": 1.0 / med,
+            "gb_s_per_rank": round(gbps, 4),
+            "sd": float(np.std(samples[tr])),
+            "trials": [round(t, 4) for t in samples[tr]]})
+    med = float(np.median(small))
+    print(f"collective_allreduce_hub_small (1KB) per second {1 / med:.1f}")
+    results.append({"name": "collective_allreduce_hub_small",
+                    "per_second": 1.0 / med, "sd": float(np.std(small)),
+                    "trials": [round(t, 5) for t in small]})
+    ray_tpu.get([r.teardown.remote() for r in ranks], timeout=60)
+    for r in ranks:
+        ray_tpu.kill(r)
 
 
 def _serve_qps(results: list[dict]):

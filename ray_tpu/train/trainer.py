@@ -63,6 +63,31 @@ class TrainWorker(CollectiveActorMixin):
         self.operator.load_state_dict(state)
         return True
 
+    def sync_state(self, src_rank: int = 0):
+        """Collectively broadcast the full training state from src_rank
+        over the group's data plane (shm segment / pipelined ring for
+        large payloads) instead of the driver pushing world_size pickled
+        copies. Every rank must call this."""
+        import numpy as np
+
+        from ray_tpu.collective import collective as col
+
+        group = col._manager.get_group(self._group_name)
+        if self._rank == src_rank:
+            blob = np.frombuffer(
+                pickle.dumps(self.operator.state_dict()), np.uint8)
+            size = np.array([blob.size], np.int64)
+        else:
+            blob = None
+            size = np.zeros(1, np.int64)
+        size = group.broadcast(size, src_rank)  # geometry first: all
+        if self._rank != src_rank:              # ranks pass equal shapes
+            blob = np.empty(int(size[0]), np.uint8)
+        out = group.broadcast(blob, src_rank)
+        if self._rank != src_rank:
+            self.operator.load_state_dict(pickle.loads(out.tobytes()))
+        return True
+
     def shutdown(self):
         ray_tpu.exit_actor()
 
@@ -129,8 +154,21 @@ class Trainer:
                     timeout=self._setup_timeout)
         self._active_workers = num_workers
         if self._last_state is not None:
-            ray_tpu.get([w.load_state_dict.remote(self._last_state)
-                         for w in self.workers], timeout=self._setup_timeout)
+            if (num_workers > 1 and self._backend == "host"
+                    and not self._config.get("multihost")):
+                # Weight broadcast rides the collective data plane: the
+                # driver ships ONE copy to rank 0; the group's shm/ring
+                # transport fans it out node-locally (the elastic-resize
+                # restore used to pickle the state num_workers times).
+                ray_tpu.get(
+                    self.workers[0].load_state_dict.remote(self._last_state),
+                    timeout=self._setup_timeout)
+                ray_tpu.get([w.sync_state.remote(0) for w in self.workers],
+                            timeout=self._setup_timeout)
+            else:
+                ray_tpu.get([w.load_state_dict.remote(self._last_state)
+                             for w in self.workers],
+                            timeout=self._setup_timeout)
 
     def _kill_workers(self):
         for w in self.workers:

@@ -172,7 +172,10 @@ def test_host_ring_allreduce_large(ray_start_shared):
             from ray_tpu.collective import collective as C
 
             group = C._manager.get_group("ring_test")
-            # big odd-sized tensor -> ring path (pads internally)
+            # pin the ring: on a single node auto-routing prefers the
+            # shm segment (test_collective_transports covers the tiers)
+            group.force_transport = "ring"
+            # big odd-sized tensor -> ring path
             big = np.full(50_001, float(self.rank + 1), np.float32)
             out = group.allreduce(big, ReduceOp.SUM)
             expect = sum(range(1, self.world + 1))
