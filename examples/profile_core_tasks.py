@@ -1,0 +1,287 @@
+"""Per-hop profile of the core task round trip.
+
+Builds the rate ladder the task-throughput gap analysis needs (PERF.md
+"Core task path"), every step measured in THIS process within one
+window, so the decomposition
+
+    submit -> lease/dispatch -> execute -> reply -> get
+
+can be read against the same-box calibrations:
+
+  1. python loop + raw socketpair echo  — interpreter + syscall floor
+  2. rpc echo (same loop / cross-thread) — the frame codec + asyncio floor
+  3. put+get                             — memstore/serialization floor,
+                                           no RPC, no scheduling
+  4. submit-only                         — driver-side cost of .remote()
+                                           (spec build + bookkeeping +
+                                           coalesced io-loop handoff)
+  5. task sync RTT                       — full round trip, one at a time
+  6. tasks async (pipelined)             — full path at depth, where
+                                           lease pipelining + reply
+                                           coalescing should dominate
+  7. actor call sync RTT                 — the no-lease control: same
+                                           wire/exec path, no raylet
+  8. cProfile of the driver during the async window, tottime by layer
+  9. churn counters per task             — loop wakeups, frames, socket
+                                           flushes, executor hops
+
+Run:  JAX_PLATFORMS=cpu python examples/profile_core_tasks.py [--quick]
+"""
+
+import cProfile
+import io
+import json
+import os
+import pstats
+import socket
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))  # runnable as `python examples/...`
+
+QUICK = "--quick" in sys.argv
+WINDOW = 0.3 if QUICK else 1.0
+REPS = 3
+
+
+def median(xs):
+    xs = sorted(xs)
+    return xs[len(xs) // 2]
+
+
+def rate(fn, seconds=WINDOW, reps=REPS, per_call=1):
+    fn()  # warm
+    rates = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        n = 0
+        while time.perf_counter() - t0 < seconds:
+            fn()
+            n += 1
+        rates.append(n * per_call / (time.perf_counter() - t0))
+    return median(rates)
+
+
+# -- step 1: calibrations ---------------------------------------------------
+
+def calibrations():
+    def py_loop():
+        n = 0
+        for _ in range(10_000):
+            n += 1
+        return n
+
+    loop_rate = rate(py_loop, per_call=10_000)
+
+    a, b = socket.socketpair()
+    done = threading.Event()
+
+    def echo():
+        while not done.is_set():
+            try:
+                d = b.recv(64)
+                if not d:
+                    return
+                b.sendall(d)
+            except OSError:
+                return
+
+    threading.Thread(target=echo, daemon=True).start()
+
+    def roundtrip():
+        a.sendall(b"x")
+        a.recv(64)
+
+    sock_rate = rate(roundtrip)
+    done.set()
+    a.close()
+    b.close()
+    return loop_rate, sock_rate
+
+
+# -- step 2: rpc codec floor ------------------------------------------------
+
+def rpc_floor():
+    import asyncio
+
+    from ray_tpu._private import rpc
+
+    out = {}
+
+    async def same_loop():
+        server = rpc.Server({"ping": lambda conn, d: "pong"}, name="prof")
+        port = await server.start_tcp()
+        conn = await rpc.connect(f"127.0.0.1:{port}")
+        for _ in range(20):
+            await conn.call("ping")
+        rates = []
+        for _ in range(REPS):
+            t0 = time.perf_counter()
+            n = 0
+            while time.perf_counter() - t0 < WINDOW:
+                await conn.call("ping")
+                n += 1
+            rates.append(n / (time.perf_counter() - t0))
+        await conn.close()
+        await server.close()
+        return median(rates)
+
+    out["same_loop"] = asyncio.run(same_loop())
+
+    io_thread = rpc.EventLoopThread(name="prof-io")
+
+    async def setup():
+        server = rpc.Server({"ping": lambda conn, d: "pong"}, name="prof2")
+        port = await server.start_tcp()
+        return await rpc.connect(f"127.0.0.1:{port}")
+
+    conn = io_thread.run(setup())
+    out["cross_thread"] = rate(lambda: io_thread.run(conn.call("ping")))
+    io_thread.stop()
+    return out
+
+
+# -- steps 3-7: the task ladder ---------------------------------------------
+
+def main():
+    ladder = {}
+    loop_rate, sock_rate = calibrations()
+    ladder["calibration_python_loop_per_s"] = round(loop_rate)
+    ladder["calibration_socketpair_echo_per_s"] = round(sock_rate, 1)
+    floor = rpc_floor()
+    ladder["rpc_echo_same_loop_per_s"] = round(floor["same_loop"], 1)
+    ladder["rpc_echo_cross_thread_per_s"] = round(floor["cross_thread"], 1)
+
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu._private import stats
+
+    ray_tpu.init()
+
+    arr = np.zeros(100, dtype=np.int64)
+
+    ladder["put_get_per_s"] = round(
+        rate(lambda: ray_tpu.get(ray_tpu.put(arr))), 1)
+
+    @ray_tpu.remote
+    def small_task():
+        return b"ok"
+
+    ray_tpu.get(small_task.remote())
+
+    # submit-only: driver-side cost of .remote() — refs are drained after
+    # each timed window so queue depth can't grow without bound
+    def submit_burst():
+        refs = [small_task.remote() for _ in range(100)]
+        submit_burst.refs = refs
+
+    def submit_window():
+        t0 = time.perf_counter()
+        n = 0
+        while time.perf_counter() - t0 < WINDOW:
+            submit_burst()
+            n += 100
+        r = n / (time.perf_counter() - t0)
+        ray_tpu.get(submit_burst.refs, timeout=120)
+        return r
+
+    submit_burst()
+    ray_tpu.get(submit_burst.refs, timeout=120)
+    ladder["submit_only_per_s"] = round(
+        median([submit_window() for _ in range(REPS)]), 1)
+
+    ladder["task_sync_per_s"] = round(
+        rate(lambda: ray_tpu.get(small_task.remote())), 1)
+
+    def tasks_async():
+        ray_tpu.get([small_task.remote() for _ in range(100)], timeout=120)
+
+    # counter snapshot around a counted async run (before the profiled
+    # window so the profiler doesn't distort the per-task hop counts)
+    before = stats.snapshot()
+    n_counted = 0
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < WINDOW:
+        tasks_async()
+        n_counted += 100
+    after = stats.snapshot()
+
+    def delta(name):
+        return (after.get(name, {}).get("value", 0)
+                - before.get(name, {}).get("value", 0))
+
+    done = delta("core.tasks_completed_total") or 1
+    ladder["driver_churn_per_task"] = {
+        "loop_wakeups": round(delta("rpc.loop_wakeups_total") / done, 2),
+        "frames_sent": round(delta("rpc.frames_sent_total") / done, 2),
+        "socket_flushes": round(delta("rpc.socket_flushes_total") / done, 2),
+        "lease_requests": round(delta("core.lease_requests_total") / done, 3),
+    }
+
+    prof = cProfile.Profile()
+    prof.enable()
+    async_rates = []
+    for _ in range(REPS):
+        t0 = time.perf_counter()
+        n = 0
+        while time.perf_counter() - t0 < WINDOW:
+            tasks_async()
+            n += 100
+        async_rates.append(n / (time.perf_counter() - t0))
+    prof.disable()
+    ladder["tasks_async_per_s"] = round(median(async_rates), 1)
+
+    @ray_tpu.remote
+    class Actor:
+        def small_value(self):
+            return b"ok"
+
+    a = Actor.remote()
+    ray_tpu.get(a.small_value.remote())
+    ladder["actor_sync_per_s"] = round(
+        rate(lambda: ray_tpu.get(a.small_value.remote())), 1)
+
+    # worker-side executor hops per executed task, via the raylet's
+    # merged metrics (Count metrics sum across worker processes)
+    metrics = ray_tpu.cluster_metrics()
+    for snap in metrics["raylets"].values():
+        executed = snap.get("core.tasks_executed_total", {}).get("value", 0)
+        hops = snap.get("core.exec_hops_total", {}).get("value", 0)
+        if executed:
+            ladder["worker_exec_hops_per_task"] = round(hops / executed, 2)
+            break
+
+    report, layers = summarize_profile(prof)
+    ladder["driver_async_tottime_by_layer_s"] = layers
+
+    print(report)
+    print(json.dumps(ladder, indent=1))
+    ray_tpu.shutdown()
+
+
+def summarize_profile(prof):
+    """Top functions + tottime grouped by layer (file path)."""
+    buf = io.StringIO()
+    st = pstats.Stats(prof, stream=buf)
+    st.sort_stats("cumulative").print_stats(25)
+    layers = {"core_worker": 0.0, "rpc": 0.0, "memstore": 0.0,
+              "serialization": 0.0, "remote_function": 0.0, "ids": 0.0,
+              "common": 0.0, "asyncio/selector": 0.0, "other": 0.0}
+    for (fn, _line, _name), (cc, nc, tt, ct, callers) in st.stats.items():
+        for key in layers:
+            if key in fn.replace("\\", "/"):
+                layers[key] += tt
+                break
+        else:
+            if "asyncio" in fn or "selectors" in fn:
+                layers["asyncio/selector"] += tt
+            else:
+                layers["other"] += tt
+    return buf.getvalue(), {k: round(v, 3) for k, v in layers.items()}
+
+
+if __name__ == "__main__":
+    main()

@@ -59,6 +59,19 @@ class Config:
     # Max in-flight lease-reused tasks pushed to one worker
     # (reference: direct_task_transport.h max_tasks_in_flight_per_worker).
     max_tasks_in_flight_per_worker: int = 10
+    # Lease pre-warm: max leases asked for in one batched
+    # request_worker_lease RPC (soft target is ceil(queue / in-flight
+    # cap), clamped here; reference: pipelined lease requests in
+    # direct_task_transport.h).
+    max_lease_batch: int = 4
+    # While ≥1 lease is working a key, extra lease requests are SOFT
+    # (granted from idle workers only, never spawning); they escalate to
+    # hard — may spawn a worker — once the queue has waited this long.
+    lease_escalation_s: float = 1.0
+    # Idle leases are returned to the raylet after this grace (single
+    # shared reaper; also bounds how long a drained-queue prewarm lease
+    # can strand a worker).
+    lease_idle_grace_s: float = 0.25
     # Initial worker-pool size per node; workers are also started on demand.
     # -1 = auto (min(num_cpus, 8)). Prestarting matters on TPU hosts: every
     # Python start pays the jax/plugin import cost, so cold workers are slow.

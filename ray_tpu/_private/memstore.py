@@ -66,7 +66,11 @@ class MemoryStore:
                     fired.extend(entry.callbacks)
                 entry.callbacks = None
             self._cv.notify_all()
-        for cb in fired:  # outside the lock: callbacks may re-enter
+        self._fire(fired)
+
+    @staticmethod
+    def _fire(callbacks) -> None:
+        for cb in callbacks:  # outside the lock: callbacks may re-enter
             try:
                 cb()
             except Exception:
@@ -78,18 +82,43 @@ class MemoryStore:
                 logging.getLogger("ray_tpu").exception(
                     "memstore ready-callback failed")
 
-    def add_ready_callback(self, object_id: ObjectID, cb) -> None:
+    def add_ready_callback(self, object_id: ObjectID, cb,
+                           create: bool = True) -> bool:
         """Invoke cb() once the entry becomes ready — immediately if it
         already is. The async-get primitive: no thread parks per waiter
-        (reference analog: memory_store.h GetAsync)."""
+        (reference analog: memory_store.h GetAsync). A `delete` of a
+        pending entry ALSO fires its callbacks (the waiter re-checks
+        `get_if_ready`, sees not-found, and maps that to object loss), so
+        an owner dropping an object can never strand a callback waiter.
+
+        With create=False, a missing entry is NOT re-created (the caller
+        races entry deletion and must not resurrect a released object);
+        returns False and does not register in that case."""
         with self._lock:
-            entry = self._entries.setdefault(object_id, _Entry())
+            if create:
+                entry = self._entries.setdefault(object_id, _Entry())
+            else:
+                entry = self._entries.get(object_id)
+                if entry is None:
+                    return False
             if not entry.ready:
                 if entry.callbacks is None:
                     entry.callbacks = []
                 entry.callbacks.append(cb)
-                return
+                return True
         cb()
+        return True
+
+    def remove_ready_callback(self, object_id: ObjectID, cb) -> None:
+        """Forget a pending ready-callback (waiter gave up — timeout or
+        disconnected client); no-op if it already fired or never existed."""
+        with self._lock:
+            entry = self._entries.get(object_id)
+            if entry is not None and entry.callbacks:
+                try:
+                    entry.callbacks.remove(cb)
+                except ValueError:
+                    pass
 
     def put_in_plasma(self, object_id: ObjectID) -> None:
         self.put(object_id, IN_PLASMA)
@@ -147,7 +176,12 @@ class MemoryStore:
 
     def delete(self, object_id: ObjectID) -> None:
         with self._lock:
-            self._entries.pop(object_id, None)
+            entry = self._entries.pop(object_id, None)
+            fired = entry.callbacks if entry is not None else None
+            if entry is not None:
+                entry.callbacks = None
+        if fired:
+            self._fire(fired)
 
     def size(self) -> int:
         with self._lock:

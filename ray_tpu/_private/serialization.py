@@ -86,7 +86,12 @@ class _Pickler(cloudpickle.Pickler):
         if isinstance(obj, jax.Array):
             arr = _to_host(obj)
             return (_rebuild_jax_array, (arr,))
-        return NotImplemented
+        # Delegate to cloudpickle's reducer, NOT NotImplemented: cloudpickle
+        # implements by-value pickling of local/interactively-defined
+        # functions and classes through reducer_override, so returning
+        # NotImplemented here silently downgraded task args to stock
+        # pickle (locally-defined functions inside args failed to ship).
+        return super().reducer_override(obj)
 
 
 def _rebuild_jax_array(np_arr):

@@ -16,12 +16,25 @@ class ActorMethod:
         self._handle = handle
         self._method_name = method_name
         self._num_returns = num_returns
+        # static spec prefix cached per (handle, method, core worker) —
+        # see CoreWorker.make_actor_task_template
+        self._template = None
+        self._template_cw = None
 
     def __call__(self, *args, **kwargs):
         raise TypeError(
             f"Actor method {self._method_name!r} cannot be called directly; "
             f"use .{self._method_name}.remote()."
         )
+
+    def __getstate__(self):
+        # ActorMethods can be captured in closures shipped to other
+        # processes; the template cache references this process's
+        # CoreWorker and must not travel.
+        state = self.__dict__.copy()
+        state["_template"] = None
+        state["_template_cw"] = None
+        return state
 
     def remote(self, *args, **kwargs):
         return self._remote(args, kwargs, {})
@@ -38,15 +51,29 @@ class ActorMethod:
     def _remote(self, args, kwargs, opts):
         cw = global_state.require_core_worker()
         num_returns = opts.get("num_returns", self._num_returns)
-        refs = cw.submit_actor_task(
-            self._handle._actor_id.binary(),
-            fn_id=self._handle._cls_id,
-            name=f"{self._handle._class_name}.{self._method_name}",
-            method_name=self._method_name,
-            args=args,
-            kwargs=kwargs,
-            num_returns=num_returns,
-        )
+        if not opts and not getattr(cw, "_legacy", False):
+            if self._template is None or self._template_cw is not cw:
+                self._template = cw.make_actor_task_template(
+                    self._handle._actor_id.binary(),
+                    fn_id=self._handle._cls_id,
+                    name=f"{self._handle._class_name}.{self._method_name}",
+                    method_name=self._method_name,
+                    num_returns=num_returns,
+                )
+                self._template_cw = cw
+            refs = cw.submit_actor_task(
+                self._handle._actor_id.binary(), args=args, kwargs=kwargs,
+                template=self._template)
+        else:
+            refs = cw.submit_actor_task(
+                self._handle._actor_id.binary(),
+                fn_id=self._handle._cls_id,
+                name=f"{self._handle._class_name}.{self._method_name}",
+                method_name=self._method_name,
+                args=args,
+                kwargs=kwargs,
+                num_returns=num_returns,
+            )
         if num_returns == 0:
             return None
         if num_returns == 1:
@@ -67,7 +94,13 @@ class ActorHandle:
         # remotely; other underscore names are not exposed as actor methods.
         if name.startswith("_") and not name.startswith("__ray_"):
             raise AttributeError(name)
-        return ActorMethod(self, name, self._method_num_returns.get(name, 1))
+        method = ActorMethod(self, name, self._method_num_returns.get(name, 1))
+        # Cache on the instance so repeated `handle.method` lookups skip
+        # __getattr__ (and keep the method's cached spec template alive);
+        # __reduce__ serializes explicit state only, so the cache never
+        # travels.
+        self.__dict__[name] = method
+        return method
 
     def __repr__(self):
         return f"ActorHandle({self._class_name}, {self._actor_id.hex()[:12]})"

@@ -398,6 +398,7 @@ class GcsServer:
             "spec": spec,
             "state": PENDING_CREATION,
             "address": "",
+            "task_channel": "",
             "node_id": None,
             "worker_id": None,
             "name": name,
@@ -484,6 +485,10 @@ class GcsServer:
             return
         rec["state"] = ALIVE
         rec["address"] = reply["worker_address"]
+        # same-node direct task channel of the hosting worker ("" when
+        # unavailable; owners on other nodes can't reach it and fall
+        # back to the rpc address)
+        rec["task_channel"] = reply.get("task_channel") or ""
         rec["worker_id"] = reply["worker_id"]
         await self._publish_actor(rec)
 
@@ -533,6 +538,8 @@ class GcsServer:
             "num_restarts": rec["num_restarts"],
             "max_restarts": rec["max_restarts"],
             "death_cause": rec["death_cause"],
+            "task_channel": (rec.get("task_channel", "")
+                             if rec["state"] == ALIVE else ""),
             "class_name": rec["spec"]["name"],
         }
 

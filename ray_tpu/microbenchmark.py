@@ -18,7 +18,9 @@ def timeit(name: str, fn, multiplier: int = 1, seconds: float = 2.0,
            results: list | None = None, trials: int = 3):
     """reference: ray_microbenchmark_helpers.py:timeit — N>=3 repetitions,
     MEDIAN reported (this box is 1 time-shared core: a single scheduler
-    hiccup skews a mean; the median survives one bad window)."""
+    hiccup skews a mean; the median survives one bad window). Cases whose
+    trial spread exceeds 50% of the median are flagged high_variance —
+    read those numbers as window noise, not signal."""
     # warmup
     fn()
     trials = max(3, trials)
@@ -33,12 +35,59 @@ def timeit(name: str, fn, multiplier: int = 1, seconds: float = 2.0,
         rates.append(count * multiplier / dt)
     med = float(np.median(rates))
     sd = float(np.std(rates))
+    flagged = bool(med > 0 and sd > 0.5 * med)
     print(f"{name} per second {med:.2f} +- {sd:.2f} "
-          f"(median of {trials})")
+          f"(median of {trials})"
+          + ("  [HIGH VARIANCE: sd > 50% of median]" if flagged else ""))
     if results is not None:
-        results.append({"name": name, "per_second": med, "sd": sd,
-                        "trials": [round(r, 2) for r in rates]})
+        row = {"name": name, "per_second": med, "sd": sd,
+               "trials": [round(r, 2) for r in rates]}
+        if flagged:
+            row["high_variance"] = True
+        results.append(row)
     return med
+
+
+def timeit_ab(name: str, arms: dict, multiplier: int = 1,
+              seconds_per_window: float = 0.7, windows: int = 3,
+              results: list | None = None):
+    """Paired interleaved A/B: every arm runs once inside EACH window
+    (so a box-load swing hits all arms equally), median of N windows per
+    arm. `arms` maps suffix -> (setup, fn): setup() flips the process
+    into that arm (e.g. the legacy task path) before its slice runs."""
+    rates: dict[str, list] = {suffix: [] for suffix in arms}
+    for suffix, (setup, fn) in arms.items():
+        setup()
+        fn()  # warm this arm
+    for _ in range(windows):
+        for suffix, (setup, fn) in arms.items():
+            setup()
+            start = time.perf_counter()
+            count = 0
+            while time.perf_counter() - start < seconds_per_window:
+                fn()
+                count += 1
+            rates[suffix].append(
+                count * multiplier / (time.perf_counter() - start))
+    # leave the process in the FIRST (default) arm
+    next(iter(arms.values()))[0]()
+    out = {}
+    for suffix, rr in rates.items():
+        med = float(np.median(rr))
+        sd = float(np.std(rr))
+        full = name if not suffix else f"{name} ({suffix})"
+        flagged = bool(med > 0 and sd > 0.5 * med)
+        print(f"{full} per second {med:.2f} +- {sd:.2f} "
+              f"(median of {windows} interleaved windows)"
+              + ("  [HIGH VARIANCE]" if flagged else ""))
+        if results is not None:
+            row = {"name": full, "per_second": med, "sd": sd,
+                   "trials": [round(r, 2) for r in rr]}
+            if flagged:
+                row["high_variance"] = True
+            results.append(row)
+        out[suffix] = med
+    return out
 
 
 def calibrate(results: list) -> None:
@@ -116,6 +165,30 @@ def main(seconds_per_case: float = 2.0) -> list[dict]:
     results.append({"name": "single client put gigabytes",
                     "per_second": gb_s, "sd": 0.0})
 
+    from ray_tpu._private import global_state
+
+    def _arm(legacy: bool):
+        """Flip the driver between the optimized task path and the
+        preserved round-7 control (RAY_TPU_TASK_LEGACY semantics) —
+        spec caching, batched/soft lease prewarm, shared lease reaper
+        vs per-call rebuilds, one-at-a-time hard leases, per-push grace
+        timers. Worker-side changes (coalesced reply delivery, gated
+        profile flush) are active in BOTH arms; see PERF.md round 8."""
+
+        def setup():
+            cw = global_state.get_core_worker()
+            if cw is not None:
+                cw._legacy = legacy
+                # each arm builds its own leases: a lease granted to the
+                # other arm differs structurally (no direct task channel
+                # on legacy leases) and must not leak across windows
+                cw._io.run(cw._return_all_leases(), timeout=30)
+
+        return setup
+
+    AB = lambda fn: {"": (_arm(False), fn),  # noqa: E731
+                     "legacy-path control": (_arm(True), fn)}
+
     @ray_tpu.remote
     def small_task():
         return b"ok"
@@ -123,12 +196,31 @@ def main(seconds_per_case: float = 2.0) -> list[dict]:
     def task_sync():
         ray_tpu.get(small_task.remote())
 
-    timeit("single client tasks sync", task_sync, results=results)
+    timeit_ab("single client tasks sync", AB(task_sync), results=results)
 
     def tasks_async():
         ray_tpu.get([small_task.remote() for _ in range(100)])
 
-    timeit("single client tasks async", tasks_async, multiplier=100,
+    timeit_ab("single client tasks async", AB(tasks_async),
+              multiplier=100, results=results)
+
+    @ray_tpu.remote
+    class TaskClient:
+        """Client actor driving its own task fan-out (BASELINE.md 'multi
+        client' rows use independent client processes)."""
+
+        def batch(self, fn, n):
+            import ray_tpu as rt
+
+            rt.get([fn.remote() for _ in range(n)])
+            return n
+
+    clients = [TaskClient.remote() for _ in range(2)]
+
+    def multi_client_tasks():
+        ray_tpu.get([c.batch.remote(small_task, 50) for c in clients])
+
+    timeit("multi client tasks async", multi_client_tasks, multiplier=100,
            results=results)
 
     @ray_tpu.remote
@@ -141,7 +233,7 @@ def main(seconds_per_case: float = 2.0) -> list[dict]:
     def actor_sync():
         ray_tpu.get(a.small_value.remote())
 
-    timeit("1:1 actor calls sync", actor_sync, results=results)
+    timeit_ab("1:1 actor calls sync", AB(actor_sync), results=results)
 
     def actor_async():
         ray_tpu.get([a.small_value.remote() for _ in range(100)])
@@ -149,17 +241,59 @@ def main(seconds_per_case: float = 2.0) -> list[dict]:
     timeit("1:1 actor calls async", actor_async, multiplier=100,
            results=results)
 
+    @ray_tpu.remote
+    class AsyncActor:
+        async def small_value(self):
+            return b"ok"
+
+    aa = AsyncActor.remote()
+    ray_tpu.get(aa.small_value.remote())  # warm the async loop
+
+    def async_actor_async():
+        ray_tpu.get([aa.small_value.remote() for _ in range(100)])
+
+    timeit("1:1 async-actor calls async", async_actor_async,
+           multiplier=100, results=results)
+
     n_actors = 4
     actors = [Actor.remote() for _ in range(n_actors)]
 
-    def actors_async():
+    def actors_1n_async():
         refs = []
         for actor in actors:
             refs.extend(actor.small_value.remote() for _ in range(25))
         ray_tpu.get(refs)
 
-    timeit("n:n actor calls async", actors_async, multiplier=100,
+    # NOTE: this single-driver fan-out carried the label "n:n actor
+    # calls async" through round 7; it is 1:n-shaped (one client, n
+    # server actors) and is now labeled to match BASELINE.md column
+    # definitions. The true n:n row below drives the same targets from
+    # n concurrent CLIENT actors.
+    timeit("1:n actor calls async", actors_1n_async, multiplier=100,
            results=results)
+
+    @ray_tpu.remote
+    class CallerClient:
+        def __init__(self, targets):
+            self.targets = targets
+
+        def fan(self, calls_per_target):
+            import ray_tpu as rt
+
+            refs = []
+            for t in self.targets:
+                refs.extend(t.small_value.remote()
+                            for _ in range(calls_per_target))
+            rt.get(refs)
+            return len(refs)
+
+    callers = [CallerClient.remote(actors) for _ in range(2)]
+
+    def actors_nn_async():
+        ray_tpu.get([c.fan.remote(13) for c in callers])
+
+    timeit("n:n actor calls async", actors_nn_async,
+           multiplier=2 * n_actors * 13, results=results)
 
     _collective_bench(results)
 

@@ -45,6 +45,7 @@ class WorkerHandle:
         self.lease_resources: ResourceSet | None = None
         self.lease_pg: tuple[bytes, int] | None = None
         self.flavor: str = "cpu"  # "cpu" | "tpu" — which env it spawned with
+        self.task_channel: str = ""  # same-node direct task UDS ("" = none)
 
 
 class Raylet:
@@ -290,6 +291,7 @@ class Raylet:
         if kind == "worker":
             worker = WorkerHandle(d["worker_id"], d["address"], d["pid"], conn)
             worker.flavor = d.get("flavor", "cpu")
+            worker.task_channel = d.get("task_channel") or ""
             self._starting_procs = [(p, f) for p, f in self._starting_procs
                                     if p.pid != d["pid"]]
             self.workers[d["worker_id"]] = worker
@@ -481,11 +483,50 @@ class Raylet:
                     return info["address"]
         return None
 
+    def _pop_idle_now(self, tpu: bool):
+        """Pop an idle worker if one exists RIGHT NOW — no wait, no spawn
+        (the grant path for soft/prewarm lease requests and for the tail
+        of a batched grant)."""
+        pool = self.idle_tpu if tpu else self.idle
+        return pool.pop() if pool else None
+
     async def h_request_worker_lease(self, conn, d):
+        """Grant worker leases. Plain form (no `count`): one lease,
+        waiting on worker startup if needed — unchanged round-7 behavior.
+        Batched form (`count`=N): grant up to N leases in ONE round trip
+        from capacity that is idle now; only a hard request with zero
+        idle workers waits (and possibly spawns) for a single worker. A
+        `soft` request never spawns and never queues — a dry idle pool
+        returns an empty grant list immediately, so owner-side lease
+        pre-warm for bursts of tiny tasks cannot spawn-storm the node."""
         spec = d["spec"]
-        acquired = self._try_acquire(spec)
-        if acquired is not None:
-            return await self._grant_lease(spec, acquired)
+        batched = "count" in d
+        count = max(1, int(d.get("count", 1)))
+        soft = bool(d.get("soft"))
+        tpu = self._needs_tpu(spec)
+        grants: list[dict] = []
+        while len(grants) < count:
+            acquired = self._try_acquire(spec)
+            if acquired is None:
+                break
+            res, pg_key = acquired
+            worker = self._pop_idle_now(tpu)
+            if worker is None:
+                if soft or grants:
+                    # soft never spawns; a batch never blocks its
+                    # already-granted leases behind worker startup
+                    self._release(res, pg_key)
+                    break
+                try:
+                    worker = await self._pop_worker(tpu=tpu)
+                except Exception:
+                    self._release(res, pg_key)
+                    raise
+            grants.append(self._lease_reply(worker, res, pg_key))
+        if grants:
+            return {"grants": grants} if batched else grants[0]
+        if soft:
+            return {"grants": []}
         key = self._bundle_key(spec)
         if key is not None and self._find_bundle(key) is None:
             addr = await self._pg_spillback(key)
@@ -509,7 +550,10 @@ class Raylet:
                 return {"spillback": addr, "hops": hops + 1}
         fut = asyncio.get_running_loop().create_future()
         self.pending_leases.append((spec, fut))
-        return await fut
+        result = await fut
+        if batched and "spillback" not in result:
+            return {"grants": [result]}
+        return result
 
     @staticmethod
     def _needs_tpu(spec) -> bool:
@@ -522,6 +566,9 @@ class Raylet:
         except Exception:
             self._release(res, pg_key)
             raise
+        return self._lease_reply(worker, res, pg_key)
+
+    def _lease_reply(self, worker, res, pg_key) -> dict:
         self._lease_seq += 1
         self.m_leases_granted.inc()
         lease_id = self._lease_seq.to_bytes(8, "big")
@@ -533,6 +580,7 @@ class Raylet:
             "lease_id": lease_id,
             "worker_id": worker.worker_id,
             "worker_address": worker.address,
+            "task_channel": worker.task_channel,
         }
 
     async def h_return_worker(self, conn, d):
@@ -606,7 +654,9 @@ class Raylet:
             if not worker.conn.closed:
                 self._push_worker(worker)
             raise
-        return {"worker_address": worker.address, "worker_id": worker.worker_id}
+        return {"worker_address": worker.address,
+                "worker_id": worker.worker_id,
+                "task_channel": worker.task_channel}
 
     async def h_kill_actor_worker(self, conn, d):
         worker = self.workers.get(d["worker_id"])
@@ -808,7 +858,12 @@ class Raylet:
     async def _raylet_conn(self, address: str) -> rpc.Connection:
         conn = self._raylet_conns.get(address)
         if conn is None or conn.closed:
-            conn = await rpc.connect(address, name=f"raylet->{address}")
+            conn = await rpc.connect(
+                rpc.prefer_uds(address, os.path.join(self.session_dir,
+                                                     "sock"),
+                               local_ips=("127.0.0.1",
+                                          self.config.node_ip_address)),
+                name=f"raylet->{address}")
             self._raylet_conns[address] = conn
         return conn
 
@@ -1219,7 +1274,8 @@ class Raylet:
 
     async def run(self, port: int = 0, ready_file: str | None = None):
         actual = await self.server.start_tcp(
-            host=self.config.bind_host, port=port)
+            host=self.config.bind_host, port=port,
+            uds_dir=os.path.join(self.session_dir, "sock"))
         self.address = f"{self.config.node_ip_address}:{actual}"
 
         async def _gcs_session(conn):

@@ -21,6 +21,11 @@ class RemoteFunction:
         self._accelerator_type = accelerator_type
         self._pickled = None
         self._fn_id = None
+        # cached static spec prefix for the default-options hot path,
+        # rebuilt if the core worker changed (re-init) — see
+        # CoreWorker.make_task_template
+        self._template = None
+        self._template_cw = None
         self.__doc__ = fn.__doc__
 
     def __call__(self, *args, **kwargs):
@@ -28,6 +33,15 @@ class RemoteFunction:
             f"Remote function {self._name} cannot be called directly; use "
             f"{self._name}.remote()."
         )
+
+    def __getstate__(self):
+        # A RemoteFunction can travel inside task args / actor state; the
+        # cached spec template holds this process's CoreWorker (sockets,
+        # threads) and must never be pickled with it.
+        state = self.__dict__.copy()
+        state["_template"] = None
+        state["_template_cw"] = None
+        return state
 
     def options(self, **opts):
         parent = self
@@ -64,6 +78,24 @@ class RemoteFunction:
             self._pickled = cloudpickle.dumps(self._function)
         fn_id = cw.export_function(self._pickled)
         self._fn_id = fn_id
+        if not opts and not getattr(cw, "_legacy", False):
+            # hot path: the whole static spec prefix (descriptor, owner,
+            # quantized resources) is built once per (function, worker)
+            # and submit pays one dict copy per call
+            if self._template is None or self._template_cw is not cw:
+                self._template = cw.make_task_template(
+                    fn_id=fn_id,
+                    name=self._name,
+                    num_returns=self._num_returns,
+                    resources=self._resources_dict(opts),
+                    max_retries=self._max_retries,
+                )
+                self._template_cw = cw
+            refs = cw.submit_task(args=args, kwargs=kwargs,
+                                  template=self._template)
+            if self._num_returns == 1:
+                return refs[0]
+            return refs
         num_returns = opts.get("num_returns", self._num_returns)
         pg = opts.get("placement_group")
         pg_id = None

@@ -225,13 +225,16 @@ def test_nodes(ray_start_shared):
 
 def test_cancel_queued_tasks(ray_start_shared):
     # Runs last in this module: its blockers occupy workers until they
-    # finish sleeping.
+    # finish sleeping. 3× blockers per worker slot so the victim stays
+    # queued well past the cancel no matter how tasks fan across leases
+    # (round 8: least-loaded fan-out spreads a burst over every live
+    # lease instead of filling one worker to its pipeline cap first).
     @ray_tpu.remote
     def busy():
         time.sleep(5)
         return "done"
 
-    blockers = [busy.remote() for _ in range(8)]
+    blockers = [busy.remote() for _ in range(24)]
     victim = busy.remote()
     time.sleep(0.5)
     ray_tpu.cancel(victim)
