@@ -34,6 +34,7 @@ import cloudpickle
 
 from ray_tpu import exceptions as exc
 from ray_tpu._private import common, global_state, rpc, serialization
+from ray_tpu._private import failpoints as _fp
 from ray_tpu._private.config import Config
 from ray_tpu._private.ids import ActorID, JobID, NodeID, ObjectID, TaskID, WorkerID
 from ray_tpu._private.memstore import IN_PLASMA, MemoryStore
@@ -121,10 +122,18 @@ class _ActorClient:
         self.state = "PENDING_CREATION"
         self.conn: rpc.Connection | None = None
         self.seq = 0
+        # Reorder-lane epoch: bumped on a connection loss to a
+        # still-ALIVE actor. The worker cannot tell whether the seq
+        # numbers lost with the connection were consumed, so the lane is
+        # poisoned — callers and the worker restart matching (epoch,
+        # seq=0) lanes instead of wedging every later call behind a seq
+        # hole nothing will ever fill.
+        self.epoch = 0
         self.queued: list[tuple[dict, list[ObjectID]]] = []
         self.subscribed = False
         self.death_cause = ""
         self.flush_scheduled = False
+        self.poll_scheduled = False
         self.inflight = 0
         self.burst_channel = True
         # same-node direct task channel of the hosting worker
@@ -152,6 +161,8 @@ class CoreWorker:
                  job_id: JobID | None = None, worker_id: WorkerID | None = None):
         self.mode = mode
         self.config = config
+        # worker/main.py sets "worker" before us; drivers land here
+        _fp.set_role(mode, only_if_unset=True)
         self.session_dir = session_dir
         self.worker_id = worker_id or WorkerID.from_random()
         self.job_id = job_id or JobID.from_int(0)
@@ -213,6 +224,14 @@ class CoreWorker:
         self.raylet: rpc.Connection | None = None
         self.gcs: rpc.Connection | None = None
         self._peer_conns: dict[str, rpc.Connection] = {}
+        # io-loop-confined per-address dial locks: without them a burst
+        # of concurrent _peer() callers (arg fetches + borrow syncs of
+        # one arriving task) each dial, and the losers' connections are
+        # silently dropped from the cache while still carrying in-flight
+        # calls — the orphaned conn+task cycles then get GC'd mid-await
+        # and the calls neither complete NOR error (observed as a
+        # permanent arg-fetch hang under the chaos sweep, seed 102)
+        self._peer_dial_locks: dict[str, asyncio.Lock] = {}
         self.server = rpc.Server(self._handlers(), name=f"cw-{mode}")
         self.address = ""
 
@@ -276,6 +295,12 @@ class CoreWorker:
             # every actor channel and resync state missed while down
             # (reference: service_based_gcs_client.h reconnection).
             async def _gcs_reconnected(conn):
+                await conn.call("subscribe", {"channel": _fp.CHANNEL})
+                # a spec armed while we were disconnected was published
+                # to nobody-here — resync from the KV like bootstrap does
+                armed = await conn.call("kv_get", {"key": _fp.KV_KEY})
+                if armed is not None:
+                    _fp.apply_kv_value(armed)
                 if self.mode == DRIVER:
                     await conn.call("subscribe",
                                     {"channel": "worker_logs"})
@@ -292,9 +317,21 @@ class CoreWorker:
 
             self.gcs = rpc.ReconnectingConnection(
                 gcs_address, name="cw->gcs", on_reconnect=_gcs_reconnected,
-                retry_timeout=self.config.gcs_reconnect_timeout_s)
+                retry_timeout=self.config.gcs_reconnect_timeout_s,
+                # a worker is spawned into a RUNNING cluster: a dead GCS
+                # at bootstrap means the cluster is gone — die fast
+                # (the raylet respawns workers if it's actually alive)
+                # instead of lingering 10s as an un-registered orphan
+                dial_timeout=(3.0 if self.mode == WORKER else 10.0))
             self.gcs.set_push_handler(self._on_gcs_push)
             await self.gcs.ensure_connected()
+            # Live fault-injection plane: failpoints armed through the
+            # internal KV reach this process via pubsub, and a process
+            # spawned AFTER the arming picks the spec up from the KV now.
+            await self.gcs.call("subscribe", {"channel": _fp.CHANNEL})
+            armed = await self.gcs.call("kv_get", {"key": _fp.KV_KEY})
+            if armed:
+                _fp.apply_kv_value(armed)
             # Duplex: the raylet sends actor-creation/kill requests back
             # over this same connection. A worker cannot function without
             # its raylet — it dies with it (reference: worker exits when
@@ -304,10 +341,18 @@ class CoreWorker:
                     logger.warning("raylet connection lost; worker exiting")
                     os._exit(1)
 
+            # Workers are spawned BY a raylet that is already listening:
+            # a refused dial here means the raylet died — fail fast
+            # (die) instead of retrying 10s as a bootstrap zombie that
+            # outlives its whole node (drivers keep the longer budget:
+            # they may race a node that is still coming up).
             self.raylet = await rpc.connect(self._maybe_uds(raylet_address),
                                             handlers=self._handlers(),
                                             on_disconnect=_raylet_lost,
-                                            name="cw->raylet")
+                                            name="cw->raylet",
+                                            timeout=(2.0
+                                                     if self.mode == WORKER
+                                                     else 10.0))
             reply = await self.raylet.call("register_client", {
                 "kind": self.mode,
                 "worker_id": self.worker_id.binary(),
@@ -966,6 +1011,14 @@ class CoreWorker:
                                < self.config.lease_escalation_s)
         if soft and now < self._soft_backoff.get(key, 0.0):
             return
+        if not soft and live and _fp.ARMED:
+            # escalation seam (soft prewarm -> hard, may-spawn request):
+            # `raise` models a lost escalation — skip this round; the
+            # retry timer re-evaluates, so liveness must survive it
+            try:
+                _fp.fire_strict("lease.escalate")
+            except _fp.FailpointError:
+                return
         self._lease_requests[key] = 1
         asyncio.ensure_future(
             self._request_leases(key, pending[0], count, soft))
@@ -973,6 +1026,10 @@ class CoreWorker:
     async def _request_leases(self, key, spec, count: int, soft: bool):
         M_LEASE_REQUESTS.inc()
         try:
+            if _fp.ARMED:
+                # lease-request seam: `raise` exercises the typed failure
+                # path (queued tasks -> WorkerCrashedError / backoff)
+                await _fp.fire_async_strict("lease.request")
             target = self.raylet
             target_addr = None  # None = local raylet
             hops = 0
@@ -1123,13 +1180,20 @@ class CoreWorker:
         if not os.path.exists(address[len("unix:"):]):
             return None
         conn = self._peer_conns.get(address)
-        if conn is None or conn.closed:
-            try:
-                conn = await rpc.connect(address, name="cw->task-channel")
-            except Exception as e:
-                logger.debug("task channel dial failed (%s); rpc path", e)
-                return None
-            self._peer_conns[address] = conn
+        if conn is not None and not conn.closed:
+            return conn
+        lock = self._peer_dial_locks.setdefault(address, asyncio.Lock())
+        async with lock:
+            conn = self._peer_conns.get(address)
+            if conn is None or conn.closed:
+                try:
+                    conn = await rpc.connect(address,
+                                             name="cw->task-channel")
+                except Exception as e:
+                    logger.debug("task channel dial failed (%s); rpc path",
+                                 e)
+                    return None
+                self._cache_peer(address, conn)
         return conn
 
     async def _push_to_lease(self, lease: _Lease, spec, key):
@@ -1142,7 +1206,12 @@ class CoreWorker:
         try:
             reply = await lease.push_conn.call("push_task", {"spec": spec})
             self._handle_task_reply(spec, reply)
-        except (rpc.ConnectionLost, rpc.RemoteError) as e:
+        except (rpc.ConnectionLost, rpc.RemoteError,
+                _fp.FailpointError) as e:
+            # FailpointError: an armed `rpc.send=raise` fires in OUR send
+            # path — the push never left; route it through the same
+            # retry/fail machinery (letting it escape would leak the
+            # inflight slot and hang the caller)
             lease.inflight -= 1
             await self._handle_push_failure(spec, key, lease, e)
             return
@@ -1365,6 +1434,11 @@ class CoreWorker:
                 info = await self.gcs.call("register_actor", {"spec": spec})
                 await self._subscribe_actor(actor_id.binary())
                 self._apply_actor_update(info)
+                # flush calls queued while registration was in flight:
+                # the ALIVE state just arrived via this REPLY — if the
+                # pubsub publish was lost (GCS crash/drop between table
+                # apply and publish), no push will ever flush them
+                await self._flush_actor_queue(client)
             except Exception as e:
                 client.state = "DEAD"
                 client.death_cause = f"registration failed: {e}"
@@ -1468,6 +1542,9 @@ class CoreWorker:
         }))
 
     async def _on_gcs_push(self, channel: str, data):
+        if channel == _fp.CHANNEL:
+            _fp.apply_kv_value(data)
+            return
         if channel.startswith("actor:"):
             self._apply_actor_update(data)
             client = self.actor_clients.get(data["actor_id"])
@@ -1591,11 +1668,23 @@ class CoreWorker:
             client.queued.clear()
             return
         if client.state != "ALIVE" or not client.address:
-            return  # wait for pubsub update
+            # Pubsub is the fast path, but a LOST publish (GCS dying
+            # between table apply and publish, a dropped subscriber conn)
+            # must not wedge the queued calls forever — poll as backstop.
+            self._schedule_actor_poll(client)
+            return  # wait for pubsub update (or the poll)
         if client.conn is None or client.conn.closed:
             try:
-                client.conn = await self._peer(client.address, fresh=True)
+                # NOT fresh: a live cached peer conn is shareable (actor
+                # ordering comes from the seq/epoch reorder lanes, not
+                # the conn), and a fresh dial would close() the cached
+                # conn under whoever else is using it (_cache_peer)
+                client.conn = await self._peer(client.address)
             except Exception:
+                # undialable while believed-ALIVE (worker died, DEAD
+                # publish possibly lost): the poll re-queries the GCS
+                # and re-drives this flush — without it nothing would
+                self._schedule_actor_poll(client)
                 return
             client.task_conn = None
         if (client.task_conn is None and client.task_channel
@@ -1614,8 +1703,47 @@ class CoreWorker:
             client.burst_channel = len(queued) < 2
         for spec, pinned in queued:
             spec["seq_no"] = client.seq
+            spec["caller_epoch"] = client.epoch
             client.seq += 1
             asyncio.ensure_future(self._push_actor_task(client, spec))
+
+    def _schedule_actor_poll(self, client: _ActorClient):
+        """Bounded (1/s, one in flight per actor) get_actor poll while
+        calls are queued on an unresolved actor state: recovers from a
+        lost ALIVE/DEAD publish instead of hanging the callers. Re-armed
+        by _flush_actor_queue until the state resolves or the queue
+        drains."""
+        if client.poll_scheduled or not client.queued or self._shutdown:
+            return
+        client.poll_scheduled = True
+
+        async def _poll():
+            await asyncio.sleep(1.0)
+            client.poll_scheduled = False
+            if self._shutdown or not client.queued:
+                return
+            # ALWAYS re-query: a believed-ALIVE state can be stale (the
+            # worker died and the DEAD publish was lost) — re-flushing
+            # against a stale address alone would dial-fail forever
+            try:
+                info = await self.gcs.call("get_actor",
+                                           {"actor_id": client.actor_id})
+            except rpc.ConnectionGaveUp as e:
+                # the control plane is PERMANENTLY gone: a 1/s poll
+                # forever would hang the queued calls — fail them typed
+                for spec, _pinned in client.queued:
+                    self._fail_task(spec, exc.ActorDiedError(
+                        ActorID(client.actor_id).hex(),
+                        f"control plane unreachable: {e}"), release=True)
+                client.queued.clear()
+                return
+            except Exception:
+                info = None
+            if info is not None:
+                self._apply_actor_update(info)
+            await self._flush_actor_queue(client)
+
+        asyncio.ensure_future(_poll())
 
     async def _push_actor_task(self, client: _ActorClient, spec):
         # same hybrid as _Lease.push_conn: channel for shallow bursts,
@@ -1626,15 +1754,37 @@ class CoreWorker:
         if conn is None or conn.closed or not client.burst_channel:
             conn = client.conn
         try:
+            if conn is None or conn.closed:
+                # a sibling push's failure handler nulled the conns (the
+                # epoch bump) before this scheduled push first ran: take
+                # the same typed failure path, never an AttributeError
+                # that would leak the inflight slot and hang the caller
+                raise rpc.ConnectionLost(
+                    "actor connection lost before push")
             reply = await conn.call("push_actor_task", {"spec": spec})
             client.inflight -= 1
             self._handle_task_reply(spec, reply)
-        except (rpc.ConnectionLost, rpc.RemoteError) as e:
+        except (rpc.ConnectionLost, rpc.RemoteError,
+                _fp.FailpointError) as e:
             client.inflight -= 1
             if isinstance(e, rpc.RemoteError) and isinstance(
                     e.exc, exc.TaskCancelledError):
                 self._fail_task(spec, e.exc, release=True)
                 return
+            if (isinstance(e, (rpc.ConnectionLost, _fp.FailpointError))
+                    and spec.get("caller_epoch", 0) == client.epoch):
+                # FailpointError (injected rpc.send=raise) also means the
+                # seq was never delivered — the lane has a hole either way
+                # First failure of this epoch: the connection died with
+                # seq numbers possibly undelivered, so the worker's
+                # reorder lane may hold a hole forever. Open a fresh
+                # (epoch, seq=0) lane — one bump per loss event (sibling
+                # in-flight failures carry the old epoch and skip this)
+                # — and drop the conns so the flush redials.
+                client.epoch += 1
+                client.seq = 0
+                client.conn = None
+                client.task_conn = None
             # Connection lost mid-flight: the task may or may not have run —
             # fail it (reference default: max_task_retries=0; in-flight
             # tasks get RayActorError on actor death). Tasks still queued
@@ -1725,8 +1875,31 @@ class CoreWorker:
         a task-channel thread: reorder state is per-caller and each
         caller pushes over exactly one path."""
         caller = spec["owner_worker_id"]
-        state = self._actor_reorder.setdefault(
-            caller, {"next": 0, "buffer": {}})
+        epoch = spec.get("caller_epoch", 0)
+        state = self._actor_reorder.get(caller)
+        if state is None or state.get("epoch", 0) < epoch:
+            # new caller, or the caller reopened its lane after a
+            # connection loss (its old seq numbers may have died with
+            # the conn — waiting for them would wedge the lane forever)
+            if state is not None:
+                # entries buffered behind the lost seq still owe their
+                # (possibly live rpc-conn) callers a reply — error them
+                # rather than dropping the completions on the floor
+                for old_spec, old_complete in state["buffer"].values():
+                    try:
+                        old_complete(self._pack_error(
+                            old_spec, exc.ActorUnavailableError(
+                                "superseded by a newer connection epoch")))
+                    except Exception:
+                        pass
+            state = self._actor_reorder[caller] = {
+                "next": 0, "buffer": {}, "epoch": epoch}
+        elif state.get("epoch", 0) > epoch:
+            # straggler from a pre-loss epoch (the owner already failed
+            # it as ActorDied): don't poison the fresh lane with it
+            complete(self._pack_error(spec, exc.ActorUnavailableError(
+                "stale actor push from a superseded connection epoch")))
+            return
         state["buffer"][spec["seq_no"]] = (spec, complete)
         while state["next"] in state["buffer"]:
             next_spec, next_complete = state["buffer"].pop(state["next"])
@@ -1871,13 +2044,48 @@ class CoreWorker:
 
         def send_msg(msg):
             data = rpc_mod._pack(msg)
-            with send_lock:
-                sock.sendall(data)
+            try:
+                if _fp.ARMED:
+                    # channel reply-writer seam: raise/drop_conn model
+                    # the completing thread dying mid-reply
+                    try:
+                        if _fp.fire("channel.reply") == "drop_conn":
+                            raise ConnectionError("channel.reply failpoint")
+                    except _fp.FailpointError as e:
+                        raise ConnectionError(str(e)) from e
+                with send_lock:
+                    sock.sendall(data)
+            except OSError:
+                # A reply that cannot be delivered must not strand the
+                # owner on a half-dead channel: shutdown() THEN close —
+                # plain close() with the serve thread concurrently
+                # blocked in recv() on the same fd defers the real close
+                # (no FIN reaches the owner, observed on gVisor), which
+                # left in-flight pushes hanging on a reply that will
+                # never come. shutdown() sends the FIN immediately, so
+                # the owner gets ConnectionLost and fails over.
+                import socket as socket_mod
+
+                try:
+                    sock.shutdown(socket_mod.SHUT_RDWR)
+                except OSError:
+                    pass
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                raise
 
         try:
             while not self._shutdown:
                 (length,) = struct_mod.unpack(">I", recv_exact(4))
                 msg = msgpack.unpackb(recv_exact(length), raw=False)
+                if _fp.ARMED:
+                    # channel reader seam: drop_conn/raise kill this
+                    # serve thread (socket closes; owner fails over to
+                    # the rpc conn), exit kills the whole worker
+                    if _fp.fire("channel.read") == "drop_conn":
+                        raise ConnectionError("channel.read failpoint")
                 _msgtype, msgid, method, data = msg
                 if method == "ping":
                     send_msg([rpc_mod.REPLY_OK, msgid, method, "pong"])
@@ -1925,7 +2133,7 @@ class CoreWorker:
                         pass
 
                 self._dispatch_exec(spec, complete_task)
-        except (ConnectionError, OSError):
+        except (ConnectionError, OSError, _fp.FailpointError):
             pass
         finally:
             try:
@@ -1954,8 +2162,28 @@ class CoreWorker:
                 complete(self._pack_error(spec, exc.TaskCancelledError(
                     spec["task_id"].hex())))
                 continue
-            if not self._dispatch_concurrent(spec, complete):
-                complete(self._execute_task(spec))
+            try:
+                if not self._dispatch_concurrent(spec, complete):
+                    complete(self._execute_task(spec))
+            except BaseException as e:
+                # The dispatcher is the worker's single execution lane; it
+                # must never die with a reply still owed (a deferred-reply
+                # push whose completing thread vanished would hang its
+                # caller FOREVER — no timeout fires on a live connection).
+                # Error the request first, then fail-stop on fatal errors
+                # so the owner's next recourse is ConnectionLost -> retry,
+                # never a half-alive worker that accepts-and-drops tasks.
+                try:
+                    complete(self._pack_error(spec, exc.TaskError(
+                        type(e).__name__, repr(e),
+                        traceback.format_exc())))
+                except Exception:
+                    pass
+                if not isinstance(e, Exception):
+                    # SystemExit/KeyboardInterrupt from task code
+                    logger.error("dispatcher hit fatal %r; worker "
+                                 "fail-stops", e)
+                    os._exit(1)
 
     def _deliver_reply(self, reply, fut, loop):
         """Resolve a push handler's future from the dispatcher thread.
@@ -2068,6 +2296,11 @@ class CoreWorker:
         self._exec_job_id = spec.get("job_id")
         self._cancel_flag = False
         try:
+            if _fp.ARMED:
+                # execution seam: `raise` surfaces as a TaskError to the
+                # owner, `exit` kills this worker mid-task (owner sees
+                # ConnectionLost -> retry or WorkerCrashedError)
+                _fp.fire_strict("worker.exec")
             args, kwargs = self._resolve_args(spec["args"])
             if spec["type"] == common.ACTOR_CREATION_TASK:
                 cls = self.fetch_function(spec["fn_id"], spec["job_id"],
@@ -2199,14 +2432,31 @@ class CoreWorker:
     # misc
     # ------------------------------------------------------------------
 
-    async def _peer(self, address: str, fresh=False) -> rpc.Connection:
-        conn = None if fresh else self._peer_conns.get(address)
-        if conn is None or conn.closed:
+    async def _peer(self, address: str) -> rpc.Connection:
+        conn = self._peer_conns.get(address)
+        if conn is not None and not conn.closed:
+            return conn
+        lock = self._peer_dial_locks.setdefault(address, asyncio.Lock())
+        async with lock:
+            conn = self._peer_conns.get(address)
+            if conn is not None and not conn.closed:
+                return conn
             conn = await rpc.connect(self._maybe_uds(address),
                                      handlers=self._handlers(),
                                      name=f"cw->{address}")
-            self._peer_conns[address] = conn
+            self._cache_peer(address, conn)
         return conn
+
+    def _cache_peer(self, address: str, conn: rpc.Connection) -> None:
+        """Install a freshly dialed peer conn, CLOSING any live one it
+        replaces: a silently dropped connection strands its in-flight
+        calls in a GC-able island (they never resume), while close()
+        errors them with ConnectionLost so every waiter takes a typed
+        failure path."""
+        old = self._peer_conns.get(address)
+        self._peer_conns[address] = conn
+        if old is not None and old is not conn and not old.closed:
+            asyncio.ensure_future(old.close())
 
     def as_future(self, ref: ObjectRef) -> concurrent.futures.Future:
         """Future resolving to the object, WITHOUT a parked thread per

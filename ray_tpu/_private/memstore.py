@@ -13,6 +13,7 @@ from __future__ import annotations
 import threading
 from typing import Any
 
+from ray_tpu._private import failpoints as _fp
 from ray_tpu._private.ids import ObjectID
 
 IN_PLASMA = object()  # sentinel value
@@ -72,6 +73,11 @@ class MemoryStore:
     def _fire(callbacks) -> None:
         for cb in callbacks:  # outside the lock: callbacks may re-enter
             try:
+                if _fp.ARMED:
+                    # ready-callback seam: `raise` models one broken
+                    # waiter (must not starve siblings or the putter);
+                    # `exit` kills the process mid-delivery
+                    _fp.fire_strict("memstore.ready_callback")
                 cb()
             except Exception:
                 # a broken waiter (cancelled future, dead loop) must not

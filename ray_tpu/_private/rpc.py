@@ -39,6 +39,7 @@ _MAX_FRAME = 1 << 31
 # Churn instrumentation (tier-1 guarded: tests assert the per-task hop
 # count stays bounded so per-call wakeups can't silently regrow).
 # A "wakeup" is one self-pipe write onto an event loop — a real syscall.
+from ray_tpu._private import failpoints as _fp
 from ray_tpu._private import stats as _stats
 
 M_LOOP_WAKEUPS = _stats.Count(
@@ -76,7 +77,13 @@ def _chaos_config():
       delay_ms     max extra latency (uniform 0..delay_ms)
       kill_conn_p  probability a send instead hard-drops the connection
                    (exercises redial/retry paths)
-    Parsed once per process; inherited by spawned runtime processes."""
+    Parsed once per process; inherited by spawned runtime processes.
+
+    Evaluation now rides the failpoints registry: the two knobs are the
+    predefined points `rpc.send.delay` / `rpc.send.drop_conn`
+    (failpoints.send_fault), sharing its seeded RNG and hit counters; the
+    deterministic registry (`RAY_TPU_FAILPOINTS`, live KV arming) layers
+    any further action onto the same `rpc.send` seam."""
     import os
 
     raw = os.environ.get("RAY_TPU_CHAOS")
@@ -114,6 +121,27 @@ class RemoteError(RpcError):
 
 class ConnectionLost(RpcError):
     pass
+
+
+class ConnectionGaveUp(ConnectionLost):
+    """A ReconnectingConnection exhausted its redial budget: the peer is
+    being treated as permanently gone. Every queued/future caller gets
+    this typed error (not a bare timeout), carrying who gave up on what."""
+
+    def __init__(self, name: str, address: str, cause: str = ""):
+        self.conn_name = name
+        self.address = address
+        self.cause = cause
+        super().__init__(
+            f"{name}: gave up redialing {address}"
+            + (f" ({cause})" if cause else ""))
+
+    def __reduce__(self):
+        # travels inside rpc error replies: a handler that hit a
+        # given-up connection must not become an unpicklable payload
+        # that tears down the receiving side's whole connection
+        return (ConnectionGaveUp,
+                (self.conn_name, self.address, self.cause))
 
 
 def _pack(msg) -> bytes:
@@ -175,6 +203,12 @@ class Connection:
         try:
             while True:
                 msg = await _read_frame(self._reader)
+                if _fp.ARMED:
+                    # inbound-frame seam: drop_conn tears this connection
+                    # down exactly as a peer reset would; raise simulates
+                    # a poisoned frame (read loop dies -> full shutdown)
+                    if await _fp.fire_async("rpc.recv") == "drop_conn":
+                        break
                 msgtype = msg[0]
                 if msgtype == REQUEST:
                     if not self._dispatch_fast(msg[1], msg[2], msg[3]):
@@ -254,6 +288,9 @@ class Connection:
         if handler is None:
             return False
         try:
+            if _fp.ARMED and _fp.fire("rpc.dispatch") == "drop_conn":
+                asyncio.ensure_future(self.close())
+                return True
             if getattr(handler, "_rpc_deferred", False):
                 handler(self, data, msgid)
                 return True
@@ -320,6 +357,22 @@ class Connection:
         burst of completions from a worker thread costs one loop wakeup."""
         if msgid is None:
             return
+        if _fp.ARMED and error is None:
+            # deferred-completion seam: `raise` models the completing
+            # thread dying AFTER execution but BEFORE delivery — the
+            # request must error, never hang; `drop_conn` drops the
+            # reply WITH its connection (the owner sees ConnectionLost);
+            # `exit` kills the process
+            try:
+                if _fp.fire("rpc.reply_deferred") == "drop_conn":
+                    try:
+                        loop_call_queue(self._loop).call(
+                            lambda: asyncio.ensure_future(self.close()))
+                    except RuntimeError:
+                        pass
+                    return
+            except _fp.FailpointError as e:
+                error, tb = e, ""
         if error is not None:
             msg = [REPLY_ERR, msgid, method,
                    [pickle.dumps(error), tb]]
@@ -353,7 +406,9 @@ class Connection:
         progress), or the frame/budget needs a writer drain."""
         if self._closed:
             raise ConnectionLost(f"connection {self.name} closed")
-        if _CHAOS is not None or self._send_lock.locked():
+        if (_CHAOS is not None or _fp.ARMED
+                or self._send_lock.locked()):
+            # armed fault tier: frames must keep their injection point
             return False
         data = _pack(msg)
         if len(data) > 65536 or self._undrained + len(data) > (1 << 20):
@@ -364,17 +419,22 @@ class Connection:
     async def _send(self, msg):
         if self._closed:
             raise ConnectionLost(f"connection {self.name} closed")
-        if _CHAOS is not None:
-            import random as _random
-
-            if (_CHAOS["kill_conn_p"]
-                    and _random.random() < _CHAOS["kill_conn_p"]):
-                await self._shutdown()
-                raise ConnectionLost(
-                    f"connection {self.name} killed by chaos injection")
-            if _random.random() < _CHAOS["delay_p"]:
-                await asyncio.sleep(
-                    _random.random() * _CHAOS["delay_ms"] / 1000.0)
+        if _CHAOS is not None or _fp.ARMED:
+            # outbound-frame seam: the legacy RAY_TPU_CHAOS knobs and the
+            # registry's `rpc.send` point evaluate together (send_fault)
+            fault = _fp.send_fault(_CHAOS)
+            if fault is not None:
+                kind, delay = fault
+                if kind == "drop_conn":
+                    await self._shutdown()
+                    raise ConnectionLost(
+                        f"connection {self.name} killed by fault injection")
+                if kind == "delay":
+                    await asyncio.sleep(delay)
+                elif kind == "raise":
+                    raise _fp.FailpointError("rpc.send")
+                elif kind == "exit":
+                    _fp._hard_exit("rpc.send")
         data = _pack(msg)
         async with self._send_lock:
             try:
@@ -531,6 +591,20 @@ class Server:
             await conn.close()
 
 
+async def dial_once(address: str, handlers: dict | None = None,
+                    on_disconnect=None, name="client") -> Connection:
+    """One dial attempt, no retry: 'unix:/path' or 'host:port'. Raises
+    the raw OS-level error; retry policy belongs to the caller."""
+    if address.startswith("unix:"):
+        reader, writer = await asyncio.open_unix_connection(address[5:])
+    else:
+        host, port = address.rsplit(":", 1)
+        reader, writer = await asyncio.open_connection(host, int(port))
+        _set_nodelay(writer)
+    return Connection(reader, writer, handlers or {},
+                      on_disconnect=on_disconnect, name=name)
+
+
 async def connect(address: str, handlers: dict | None = None,
                   on_disconnect=None, name="client",
                   timeout: float = 10.0) -> Connection:
@@ -539,14 +613,8 @@ async def connect(address: str, handlers: dict | None = None,
     last_err: Exception | None = None
     while asyncio.get_running_loop().time() < deadline:
         try:
-            if address.startswith("unix:"):
-                reader, writer = await asyncio.open_unix_connection(address[5:])
-            else:
-                host, port = address.rsplit(":", 1)
-                reader, writer = await asyncio.open_connection(host, int(port))
-                _set_nodelay(writer)
-            return Connection(reader, writer, handlers or {},
-                              on_disconnect=on_disconnect, name=name)
+            return await dial_once(address, handlers,
+                                   on_disconnect=on_disconnect, name=name)
         except (ConnectionError, FileNotFoundError, OSError) as e:
             last_err = e
             await asyncio.sleep(0.05)
@@ -565,12 +633,21 @@ class ReconnectingConnection:
     replays the call. Handlers/push-handler are re-attached automatically.
     Calls whose reply was lost mid-flight are retried, so server handlers
     reached through this wrapper must be idempotent.
+
+    Redials are paced with exponential backoff plus jitter (capped at
+    `redial_cap_s`, ~2s): after a head-node crash every raylet, worker and
+    driver redials at once, and a fixed cadence would hammer the
+    recovering server in lockstep. When the budget is exhausted the
+    wrapper gives up PERMANENTLY: `on_give_up` runs once, and every
+    queued and future caller gets the typed `ConnectionGaveUp` (never a
+    bare timeout), so callers can distinguish "peer is gone" from "my
+    call was slow".
     """
 
     def __init__(self, address: str, handlers: dict | None = None,
                  name: str = "client", on_reconnect=None,
                  retry_timeout: float = 30.0, on_give_up=None,
-                 dial_timeout: float = 10.0):
+                 dial_timeout: float = 10.0, redial_cap_s: float = 2.0):
         self.address = address
         self.name = name
         self._handlers = handlers or {}
@@ -578,6 +655,7 @@ class ReconnectingConnection:
         self._on_give_up = on_give_up
         self._retry_timeout = retry_timeout
         self._dial_timeout = dial_timeout
+        self._redial_cap = redial_cap_s
         self._conn: Connection | None = None
         self._push_handler = None
         self._dial_lock: asyncio.Lock | None = None
@@ -589,18 +667,18 @@ class ReconnectingConnection:
         if self._conn is not None and not self._conn.closed:
             return self._conn
         if self._gave_up:
-            raise ConnectionLost(f"{self.name}: gave up on {self.address}")
+            raise ConnectionGaveUp(self.name, self.address)
         if self._dial_lock is None:
             self._dial_lock = asyncio.Lock()
         async with self._dial_lock:
             if self._conn is not None and not self._conn.closed:
                 return self._conn
+            if self._gave_up:
+                raise ConnectionGaveUp(self.name, self.address)
             timeout = (self._retry_timeout if self._ever_connected
                        else self._dial_timeout)
             try:
-                conn = await connect(
-                    self.address, self._handlers, name=self.name,
-                    on_disconnect=self._lost, timeout=timeout)
+                conn = await self._redial(timeout)
             except ConnectionLost:
                 if self._ever_connected:
                     self._gave_up = True
@@ -611,6 +689,7 @@ class ReconnectingConnection:
                                 await res
                         except Exception:
                             logger.exception("%s on_give_up failed", self.name)
+                    raise ConnectionGaveUp(self.name, self.address)
                 raise
             if self._push_handler is not None:
                 conn.set_push_handler(self._push_handler)
@@ -625,6 +704,37 @@ class ReconnectingConnection:
                 except Exception:
                     logger.exception("%s on_reconnect failed", self.name)
             return conn
+
+    async def _redial(self, timeout: float) -> Connection:
+        """Dial until success or `timeout`, with exponential backoff +
+        jitter capped at redial_cap_s. Raises ConnectionLost when the
+        budget runs out."""
+        import random as _random
+
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + timeout
+        attempt = 0
+        last_err: Exception | None = None
+        while True:
+            try:
+                return await dial_once(self.address, self._handlers,
+                                       on_disconnect=self._lost,
+                                       name=self.name)
+            except (ConnectionError, FileNotFoundError, OSError) as e:
+                last_err = e
+                attempt += 1
+                remaining = deadline - loop.time()
+                if remaining <= 0:
+                    raise ConnectionLost(
+                        f"could not connect to {self.address} after "
+                        f"{attempt} attempts: {last_err}") from last_err
+                backoff = min(self._redial_cap,
+                              0.05 * (2 ** min(attempt - 1, 12)))
+                backoff *= 0.5 + _random.random()  # jitter: 50-150%
+                # never forfeit budget: clamp the sleep so a peer that
+                # comes back just inside the window still gets one
+                # final dial instead of a premature give-up
+                await asyncio.sleep(min(backoff, remaining))
 
     async def _lost(self, conn):
         # Proactive background redial so pubsub pushes resume without
@@ -654,6 +764,8 @@ class ReconnectingConnection:
             conn = await self.ensure_connected()
             try:
                 return await conn.call(method, data, timeout)
+            except ConnectionGaveUp:
+                raise  # permanent: never retry-spin on a given-up peer
             except ConnectionLost:
                 if loop.time() >= deadline:
                     raise
@@ -669,7 +781,8 @@ class ReconnectingConnection:
 
     @property
     def closed(self) -> bool:
-        # A lost underlying connection is redialable, not closed.
+        # A lost underlying connection is redialable, not closed; only a
+        # permanent give-up (ConnectionGaveUp to all callers) closes it.
         return self._gave_up
 
     async def close(self):

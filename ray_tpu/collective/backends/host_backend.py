@@ -460,7 +460,13 @@ class HostGroup:
                 try:
                     t.abort()
                 finally:
-                    t.close()
+                    # EVERY survivor unlinks, not just rank 0: if the
+                    # crash that tripped this op was rank 0 dying between
+                    # segment map and its post-fence unlink, nobody else
+                    # would ever remove the file and the tmpfs bytes leak
+                    # forever (unlink is idempotent; live mappings keep
+                    # their pages until released)
+                    t.close(unlink=True)
             self._shm_disabled = True
             self._abort_not_hang(e)
 
@@ -568,15 +574,18 @@ class HostGroup:
                                         kind="allgather_ctl_shm")
         except BaseException:
             if seg is not None:
-                seg.close()  # rank 0 unlinks; tmpfs bytes must not leak
+                # every survivor unlinks: rank 0 (the owner) may be the
+                # peer that just died mid-exchange
+                seg.close(unlink=True)
             raise
         if all(int(f[0]) for f in flags):
             try:
                 seg.barrier()  # join fence: everyone mapped before first op
             except BaseException:
                 # a peer died between the flag exchange and the fence:
-                # close (rank 0 unlinks) or the tmpfs bytes leak forever
-                seg.close()
+                # every survivor unlinks (rank 0 may BE the dead peer —
+                # its segment file must not outlive the group)
+                seg.close(unlink=True)
                 self._shm_disabled = True
                 raise
             if self.rank == 0:
@@ -1232,7 +1241,10 @@ class HostGroup:
                 pass
         if self._shm is not None:
             try:
-                self._shm.close()
+                # unlink from every rank (idempotent): rank 0 may already
+                # be gone, and group destroy is the last chance to keep
+                # the segment's tmpfs bytes from outliving the group
+                self._shm.close(unlink=True)
             except Exception:
                 pass
             self._shm = None

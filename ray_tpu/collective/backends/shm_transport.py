@@ -43,6 +43,7 @@ import time
 import msgpack
 import numpy as np
 
+from ray_tpu._private import failpoints as _fp
 from ray_tpu.collective.types import _NUMPY_REDUCE, ReduceOp
 
 _MAGIC = 0x52545053484D5347  # "RTPSHMSG"
@@ -113,6 +114,12 @@ class ShmTransport:
                slot_bytes: int, timeout: float) -> "ShmTransport":
         from ray_tpu.native.store import create_segment
 
+        if _fp.ARMED:
+            # map seam: `raise` fails this rank's mapping -> the ok-flag
+            # vote vetoes shm unanimously and the group falls back to the
+            # socket tiers; `exit` kills the rank between create and the
+            # join fence (the tmpfs-leak window the survivors must cover)
+            _fp.fire_strict("shm.map")
         seg = create_segment(name, segment_size(world_size, slot_bytes))
         _HDR.pack_into(seg.view, 0, _MAGIC, _VERSION, world_size,
                        slot_bytes, 0)
@@ -124,6 +131,8 @@ class ShmTransport:
              slot_bytes: int, timeout: float) -> "ShmTransport":
         from ray_tpu.native.store import open_segment
 
+        if _fp.ARMED:
+            _fp.fire_strict("shm.map")
         seg = open_segment(path, segment_size(world_size, slot_bytes))
         magic, version, world, slot, _ = _HDR.unpack_from(seg.view, 0)
         if (magic != _MAGIC or version != _VERSION or world != world_size
@@ -134,10 +143,14 @@ class ShmTransport:
             raise ValueError(f"segment {path} failed the rendezvous check")
         return cls(seg, world_size, rank, slot_bytes, timeout)
 
-    def close(self):
+    def close(self, unlink: bool | None = None):
+        """Release the mapping. `unlink=None` keeps the creator-only
+        default; survivors of a crashed peer pass unlink=True (idempotent)
+        so the segment file cannot outlive the group when rank 0 — the
+        owner — is the rank that died."""
         seg, self._seg, self._view = self._seg, None, None
         if seg is not None:
-            seg.close()
+            seg.close(unlink=unlink)
 
     @property
     def path(self) -> str:
@@ -172,6 +185,16 @@ class ShmTransport:
         and raises."""
         if deadline is None:
             deadline = time.monotonic() + self._timeout
+        if _fp.ARMED:
+            # barrier seam: `exit` kills this rank mid-phase (survivors
+            # must abort within the group timeout, not hang); `raise`
+            # models a rank erroring between post and fence — stamp the
+            # abort word first so peers fail fast either way
+            try:
+                _fp.fire_strict("shm.barrier")
+            except _fp.FailpointError:
+                self.abort()
+                raise
         self._seq += 1
         seq = self._seq
         struct.pack_into("<Q", self._view, _CTR0 + self.rank * _CTR_STRIDE,
@@ -267,6 +290,15 @@ class ShmTransport:
         cache-sized chunks so the accumulator stays resident across the
         w passes — ~2.5x less memory traffic than streaming the full
         stripe through RAM once per rank."""
+        if _fp.ARMED:
+            # reduce seam: a rank dying (or erroring) with its stripe
+            # half-written — peers must abort, and the poisoned segment
+            # is never reused
+            try:
+                _fp.fire_strict("shm.reduce")
+            except _fp.FailpointError:
+                self.abort()
+                raise
         if hi <= lo:
             return
         combine = getattr(np, _NUMPY_REDUCE[

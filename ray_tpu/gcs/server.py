@@ -28,6 +28,7 @@ import os
 import random
 import time
 
+from ray_tpu._private import failpoints as _fp
 from ray_tpu._private import rpc
 from ray_tpu._private.common import InsufficientResources, ResourceSet
 from ray_tpu._private.config import Config, get_config, set_config
@@ -85,6 +86,9 @@ class GcsServer:
         scheduling are re-queued; ALIVE actors keep running untouched."""
         st = self.storage
         self.kv = dict(st.table("kv"))
+        if _fp.KV_KEY in self.kv:
+            # armed failpoints survive a GCS restart with the KV
+            _fp.apply_kv_value(self.kv[_fp.KV_KEY])
         self.jobs = dict(st.table("jobs"))
         self.next_job = st.get("meta", "next_job", 1)
         now = time.monotonic()
@@ -114,6 +118,11 @@ class GcsServer:
                 len(self.placement_groups), len(self.kv))
 
     def _persist(self, table: str, key, value, sync: bool = False):
+        if _fp.ARMED:
+            # table-apply seam: `raise` fails the mutating handler (the
+            # caller sees RemoteError and retries idempotently), `delay`
+            # widens the apply->publish window a GCS crash can land in
+            _fp.fire_strict("gcs.table.apply")
         if self.storage is not None:
             self.storage.put(table, key, value, sync=sync)
 
@@ -177,6 +186,11 @@ class GcsServer:
             return False
         self.kv[key] = d["value"]
         self._persist("kv", key, d["value"])
+        if key == _fp.KV_KEY:
+            # live fault-injection arming: apply here, broadcast to every
+            # subscribed raylet/worker/driver (failpoints.arm_cluster)
+            _fp.apply_kv_value(d["value"])
+            await self.publish(_fp.CHANNEL, d["value"])
         return True
 
     async def h_kv_get(self, conn, d):
@@ -207,6 +221,15 @@ class GcsServer:
         return True
 
     async def publish(self, channel: str, data):
+        if _fp.ARMED and channel != _fp.CHANNEL:
+            # publish seam: drop_conn DROPS this publish (subscribers
+            # must survive a lost state push — e.g. the owner-side actor
+            # poll backstop); never injected on the failpoints channel
+            # itself, which must stay reliable to disarm a sweep
+            if await _fp.fire_async("gcs.publish") == "drop_conn":
+                logger.warning("gcs.publish failpoint dropped a publish "
+                               "on %r", channel)
+                return
         for conn in list(self.subscriptions.get(channel, ())):
             if conn.closed:
                 self.subscriptions[channel].discard(conn)
@@ -284,6 +307,10 @@ class GcsServer:
         return True
 
     async def h_heartbeat(self, conn, d):
+        if _fp.ARMED:
+            # heartbeat seam: `raise` makes beats fail while the conn
+            # stays up — the raylet's fail-stop window must catch it
+            await _fp.fire_async_strict("gcs.heartbeat")
         node_id = d["node_id"]
         self.last_heartbeat[node_id] = time.monotonic()
         if "available" in d and node_id in self.nodes:
@@ -999,6 +1026,7 @@ def main():
     from ray_tpu._private.log_utils import setup_process_logging
 
     setup_process_logging("gcs_server", args.log_file)
+    _fp.set_role("gcs")
     from ray_tpu._private.events import init_events
 
     init_events("GCS", "gcs",

@@ -21,10 +21,12 @@ import argparse
 import asyncio
 import logging
 import os
+import signal
 import subprocess
 import sys
 import time
 
+from ray_tpu._private import failpoints as _fp
 from ray_tpu._private import rpc
 from ray_tpu._private.common import InsufficientResources, ResourceSet
 from ray_tpu._private.config import Config, get_config, set_config
@@ -136,6 +138,7 @@ class Raylet:
                                  name="raylet")
         self.address = ""  # tcp address, set in run()
         self._raylet_conns: dict[str, rpc.Connection] = {}
+        self._raylet_dial_locks: dict[str, asyncio.Lock] = {}
         self._shutting_down = False
 
     def _handlers(self):
@@ -174,6 +177,10 @@ class Raylet:
     # ------------------------------------------------------------------
 
     def _start_worker_process(self, tpu: bool = False):
+        if _fp.ARMED:
+            # spawn seam: `raise` -> the pending lease request errors
+            # (owner maps it to WorkerCrashedError or backs off)
+            _fp.fire_strict("raylet.spawn")
         if tpu:
             self.starting_tpu += 1
         else:
@@ -308,8 +315,27 @@ class Raylet:
         return {"node_id": self.node_id.binary(), "address": self.address}
 
     async def _on_disconnect(self, conn):
+        if self._shutting_down:
+            return
+        # Lease-holder death: leases granted to this connection (a
+        # driver, or a worker that owned subtasks) are returned now —
+        # resources released, still-alive workers back in the idle pool —
+        # instead of stranding them until node teardown.
+        held = conn.context.pop("lease_ids", None)
+        if held:
+            reclaimed = 0
+            for w in list(self.workers.values()):
+                if w.lease_id in held:
+                    self._release(w.lease_resources, w.lease_pg)
+                    self._push_worker(w)
+                    reclaimed += 1
+            if reclaimed:
+                logger.warning(
+                    "lease holder disconnected; reclaimed %d leased "
+                    "worker(s)", reclaimed)
+                await self._dispatch_pending()
         worker: WorkerHandle | None = conn.context.get("worker")
-        if worker is None or self._shutting_down:
+        if worker is None:
             return
         self.workers.pop(worker.worker_id, None)
         if worker in self.idle:
@@ -500,6 +526,10 @@ class Raylet:
         returns an empty grant list immediately, so owner-side lease
         pre-warm for bursts of tiny tasks cannot spawn-storm the node."""
         spec = d["spec"]
+        if _fp.ARMED:
+            # grant seam: `raise` -> RemoteError at the owner's lease
+            # request (typed retry/fail path); `exit` kills the raylet
+            await _fp.fire_async_strict("lease.grant")
         batched = "count" in d
         count = max(1, int(d.get("count", 1)))
         soft = bool(d.get("soft"))
@@ -524,6 +554,19 @@ class Raylet:
                     raise
             grants.append(self._lease_reply(worker, res, pg_key))
         if grants:
+            if conn.closed:
+                # The holder died while we awaited worker spawn: its
+                # disconnect callback already ran, so reclaim these
+                # grants now — nobody can receive the reply or ever
+                # return the leases.
+                ids = {g["lease_id"] for g in grants}
+                for w in list(self.workers.values()):
+                    if w.lease_id in ids:
+                        self._release(w.lease_resources, w.lease_pg)
+                        self._push_worker(w)
+                await self._dispatch_pending()
+            else:
+                self._track_holder(conn, grants)
             return {"grants": grants} if batched else grants[0]
         if soft:
             return {"grants": []}
@@ -551,9 +594,33 @@ class Raylet:
         fut = asyncio.get_running_loop().create_future()
         self.pending_leases.append((spec, fut))
         result = await fut
+        if result.get("granted"):
+            if conn.closed:
+                # The holder died while its request sat in the queue:
+                # its disconnect callback already ran (empty lease set),
+                # so reclaim this grant NOW — the reply can't be
+                # delivered and nobody would ever return the lease.
+                for w in list(self.workers.values()):
+                    if w.lease_id == result["lease_id"]:
+                        self._release(w.lease_resources, w.lease_pg)
+                        self._push_worker(w)
+                        break
+                await self._dispatch_pending()
+            else:
+                self._track_holder(conn, [result])
         if batched and "spillback" not in result:
             return {"grants": [result]}
         return result
+
+    @staticmethod
+    def _track_holder(conn, grants):
+        """Remember which connection holds each lease, so a lease holder
+        that crashes (driver killed, owner worker dies mid-pipeline)
+        returns its leases instead of stranding workers+resources until
+        node death (_on_disconnect reclaims)."""
+        held = conn.context.setdefault("lease_ids", set())
+        for g in grants:
+            held.add(g["lease_id"])
 
     @staticmethod
     def _needs_tpu(spec) -> bool:
@@ -584,6 +651,11 @@ class Raylet:
         }
 
     async def h_return_worker(self, conn, d):
+        if _fp.ARMED:
+            await _fp.fire_async_strict("lease.return")
+        held = conn.context.get("lease_ids")
+        if held is not None:
+            held.discard(d["lease_id"])
         worker = None
         for w in self.workers.values():
             if w.lease_id == d["lease_id"]:
@@ -600,6 +672,10 @@ class Raylet:
         return True
 
     async def _dispatch_pending(self):
+        if _fp.ARMED:
+            # dispatch seam: `raise` leaves queued leases queued (the
+            # next return/heartbeat/bundle event re-drives the queue)
+            await _fp.fire_async_strict("raylet.dispatch")
         remaining = []
         for spec, fut in self.pending_leases:
             if fut.done():
@@ -857,14 +933,25 @@ class Raylet:
 
     async def _raylet_conn(self, address: str) -> rpc.Connection:
         conn = self._raylet_conns.get(address)
-        if conn is None or conn.closed:
-            conn = await rpc.connect(
-                rpc.prefer_uds(address, os.path.join(self.session_dir,
-                                                     "sock"),
-                               local_ips=("127.0.0.1",
-                                          self.config.node_ip_address)),
-                name=f"raylet->{address}")
-            self._raylet_conns[address] = conn
+        if conn is not None and not conn.closed:
+            return conn
+        # per-address dial lock: concurrent pulls must share ONE conn —
+        # a replaced-but-live conn would strand its in-flight calls in a
+        # GC-able island (same hang class as core_worker._peer)
+        lock = self._raylet_dial_locks.setdefault(address, asyncio.Lock())
+        async with lock:
+            conn = self._raylet_conns.get(address)
+            if conn is None or conn.closed:
+                conn = await rpc.connect(
+                    rpc.prefer_uds(address, os.path.join(self.session_dir,
+                                                         "sock"),
+                                   local_ips=("127.0.0.1",
+                                              self.config.node_ip_address)),
+                    name=f"raylet->{address}")
+                old = self._raylet_conns.get(address)
+                self._raylet_conns[address] = conn
+                if old is not None and not old.closed:
+                    asyncio.ensure_future(old.close())
         return conn
 
     async def _pull_from(self, oid: bytes, address: str):
@@ -1207,6 +1294,9 @@ class Raylet:
     # ------------------------------------------------------------------
 
     async def _handle_gcs_push(self, channel, data):
+        if channel == _fp.CHANNEL:
+            _fp.apply_kv_value(data)
+            return
         if channel == "nodes":
             node = data["node"]
             if data["event"] in ("added", "updated"):
@@ -1261,16 +1351,53 @@ class Raylet:
                 still.append((spec, fut))
         self.pending_leases = still
 
-    async def heartbeat_loop(self):
-        while True:
-            await asyncio.sleep(self.config.heartbeat_interval_s)
+    def _fail_stop(self, reason: str):
+        """Fail-stop this node: kill every worker and exit. A raylet the
+        GCS has given up on must NOT linger as a split-brain zombie that
+        still grants leases and runs tasks nobody can reach — the rest of
+        the cluster already declared this node dead and rescheduled its
+        actors (reference: raylets exit when disconnected from the GCS)."""
+        logger.error("raylet fail-stop: %s — killing %d worker(s) and "
+                     "exiting", reason, len(self.workers))
+        self._shutting_down = True
+        for w in list(self.workers.values()):
             try:
+                os.kill(w.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+        for proc, _flavor in self._starting_procs:
+            try:
+                proc.kill()
+            except OSError:
+                pass
+        os._exit(1)
+
+    async def heartbeat_loop(self):
+        interval = self.config.heartbeat_interval_s
+        window = max(self.config.gcs_reconnect_timeout_s, 2 * interval)
+        last_ok = time.monotonic()
+        while True:
+            await asyncio.sleep(interval)
+            try:
+                if _fp.ARMED:
+                    await _fp.fire_async_strict("raylet.heartbeat")
+                # Bounded per-beat: a HUNG (not dead) GCS must not park
+                # this call forever — that would stop the failure clock
+                # and leave exactly the zombie this loop exists to kill.
                 await self.gcs.call("heartbeat", {
                     "node_id": self.node_id.binary(),
                     "available": self.available.raw(),
-                })
+                }, timeout=max(2.0, 4 * interval))
+                last_ok = time.monotonic()
             except Exception:
                 logger.warning("heartbeat to GCS failed")
+                if time.monotonic() - last_ok > window:
+                    # Continuous failure past the reconnect window: the
+                    # GCS has long since declared us dead (heartbeat
+                    # timeout is far shorter) — fail-stop, don't zombie.
+                    self._fail_stop(
+                        f"heartbeats failing for >{window:.0f}s "
+                        f"(GCS reconnect window)")
 
     async def run(self, port: int = 0, ready_file: str | None = None):
         actual = await self.server.start_tcp(
@@ -1284,6 +1411,10 @@ class Raylet:
             again after every GCS restart (reference: raylet re-registers
             via service_based_gcs_client reconnection)."""
             await conn.call("subscribe", {"channel": "nodes"})
+            await conn.call("subscribe", {"channel": _fp.CHANNEL})
+            armed = await conn.call("kv_get", {"key": _fp.KV_KEY})
+            if armed:
+                _fp.apply_kv_value(armed)
             nodes = await conn.call("get_all_nodes", {})
             self.cluster_nodes = {n["node_id"]: n for n in nodes}
             await conn.call("register_node", {
@@ -1298,9 +1429,7 @@ class Raylet:
             })
 
         def _gcs_gone():
-            logger.error("GCS unreachable past reconnect timeout; raylet "
-                         "exiting (workers die with it)")
-            os._exit(1)
+            self._fail_stop("GCS unreachable past reconnect timeout")
 
         # Duplex: the GCS drives actor creation and bundle 2PC back over
         # this connection; it survives GCS restarts.
@@ -1351,6 +1480,7 @@ def main():
     from ray_tpu._private.log_utils import setup_process_logging
 
     setup_process_logging("raylet", args.log_file)
+    _fp.set_role("raylet")
     from ray_tpu._private.events import init_events
 
     init_events("RAYLET", args.node_id or "",

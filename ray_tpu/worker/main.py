@@ -19,11 +19,13 @@ def main():
     parser.add_argument("--log-file", default=None)
     args = parser.parse_args()
 
+    from ray_tpu._private import failpoints
     from ray_tpu._private.config import Config, get_config, set_config
     from ray_tpu._private.core_worker import WORKER, CoreWorker
     from ray_tpu._private.log_utils import setup_process_logging
 
     setup_process_logging("worker", args.log_file)
+    failpoints.set_role("worker")
     set_config(Config.load())
 
     # Workers default to CPU JAX so they never fight the driver for the TPU;

@@ -46,6 +46,113 @@ def scale_timeout(seconds: float) -> float:
     return seconds * _TIMEOUT_SCALE
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running tests excluded from tier-1")
+    config.addinivalue_line(
+        "markers",
+        "chaos: seeded fault-injection sweep (slow tier). Runs with "
+        "`pytest -m chaos`; a failure logs its seed — replay it "
+        "deterministically with RAY_TPU_CHAOS_SEED=<seed>.")
+
+
+# ---------------------------------------------------------------------------
+# leak check: no orphaned runtime processes, no leaked /dev/shm segments
+# ---------------------------------------------------------------------------
+# Timed-out/crashed tests used to leave gcs/raylet/worker orphans that
+# poisoned every later test and benchmark on this box (gVisor benches have
+# bitten on orphan cleanup before). Enforced per test: anything the test
+# spawned must be gone once it no longer holds a cluster.
+
+_RUNTIME_CMD_MARKS = ("ray_tpu.worker.main", "ray_tpu.raylet.raylet",
+                      "ray_tpu.gcs.server")
+
+
+def _runtime_procs() -> dict:
+    """pid -> cmdline of live ray_tpu runtime processes (zombies excluded:
+    their /proc cmdline reads empty)."""
+    procs = {}
+    for pid in os.listdir("/proc"):
+        if not pid.isdigit():
+            continue
+        try:
+            with open(f"/proc/{pid}/cmdline", "rb") as f:
+                cmd = f.read().decode(errors="replace").replace("\0", " ")
+        except OSError:
+            continue
+        if any(mark in cmd for mark in _RUNTIME_CMD_MARKS):
+            procs[int(pid)] = cmd.strip()
+    return procs
+
+
+def _colseg_files() -> set:
+    """Live collective shm segment files (tmpfs bytes a crashed rank can
+    leak). Object-store arenas are session-lifetime by design and are NOT
+    counted here."""
+    import glob
+
+    found = set()
+    # segment_dir() puts in-cluster segments BESIDE the store arena:
+    # /dev/shm/ray_tpu/<session>/objects/colseg (dirname of store_root =
+    # <session>/objects/<node8>); bare groups use /dev/shm/ray_tpu_colseg
+    for pattern in ("/dev/shm/ray_tpu_colseg/*",
+                    "/dev/shm/ray_tpu/*/objects/colseg/*",
+                    "/dev/shm/ray_tpu/*/colseg/*"):
+        found.update(glob.glob(pattern))
+    return found
+
+
+@pytest.fixture(autouse=True)
+def leak_check(request):
+    """After each test: if the test no longer holds a cluster, every
+    runtime process and collective shm segment it created must be gone.
+    Leaked processes are killed (so one bad test can't poison the run)
+    and the test FAILS, naming them."""
+    if os.environ.get("RAY_TPU_NO_LEAK_CHECK"):
+        yield
+        return
+    import signal
+    import time
+
+    before_procs = set(_runtime_procs())
+    before_segs = _colseg_files()
+    yield
+    from ray_tpu._private import global_state
+
+    if global_state.get_core_worker() is not None:
+        return  # a (module-scoped) cluster is legitimately still up
+    # Covers the slowest legitimate death (only ever waited out when
+    # something is still dying — the loop exits as soon as the diff is
+    # clean): a worker spawned just before teardown pays its jax import
+    # (~2s) plus fast-fail dials to the dead gcs/raylet, and force-kill
+    # paths (actor kill grace) add a couple seconds on a loaded box.
+    deadline = time.monotonic() + scale_timeout(20)
+    leaked = {}
+    while True:
+        leaked = {pid: cmd for pid, cmd in _runtime_procs().items()
+                  if pid not in before_procs}
+        leaked_segs = _colseg_files() - before_segs
+        if (not leaked and not leaked_segs) or time.monotonic() > deadline:
+            break
+        time.sleep(0.25)
+    for pid in leaked:
+        try:
+            os.kill(pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+    for path in leaked_segs:
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+    assert not leaked, (
+        f"test leaked {len(leaked)} orphaned runtime process(es) "
+        f"(now killed): {leaked}")
+    assert not leaked_segs, (
+        f"test leaked /dev/shm collective segment(s) (now removed): "
+        f"{sorted(leaked_segs)}")
+
+
 @pytest.fixture
 def ray_start_regular():
     import ray_tpu

@@ -1,0 +1,604 @@
+"""Failpoints: deterministic fault injection at every cross-process seam.
+
+Tier-1 part — semantic checks of the registry (grammar, predicates,
+seeded determinism), the rpc-seam behaviors (send/recv/deferred-reply
+faults surface as TYPED errors, never hangs), the redial backoff +
+typed give-up, the shm abort/unlink hardening, and live mid-run arming
+through the internal KV.
+
+Slow/chaos part (`pytest -m chaos`) — the seeded kill-schedule sweep:
+for each seed, a schedule of kills/faults is drawn over the
+rpc/channel/lease/shm/GCS failpoints and task/actor/collective/serve
+workloads run under it. The invariant asserted everywhere: every
+workload either completes CORRECTLY or raises a TYPED error
+(WorkerCrashedError / ActorDiedError / ActorUnavailableError /
+ObjectLostError / TaskError / TimeoutError) within its deadline — no
+hangs, no silent corruption; the conftest leak-check adds no orphaned
+processes and no leaked shm segments. A failing seed replays exactly:
+RAY_TPU_CHAOS_SEED=<seed> pytest -m chaos tests/test_failpoints.py
+"""
+
+import asyncio
+import os
+import random
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import exceptions as exc
+from ray_tpu._private import failpoints as fp
+from ray_tpu._private import rpc
+from tests.conftest import scale_timeout
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    fp.reset()
+    yield
+    fp.reset()
+
+
+# ---------------------------------------------------------------------------
+# registry semantics
+# ---------------------------------------------------------------------------
+
+def test_parse_grammar():
+    specs = fp.parse("worker.exec=exit(nth=3,role=worker); "
+                     "rpc.send=delay(p=0.25,ms=15);x.y=raise(once)")
+    assert specs["worker.exec"].action == "exit"
+    assert specs["worker.exec"].nth == 3
+    assert specs["worker.exec"].role == "worker"
+    assert specs["rpc.send"].p == 0.25
+    assert specs["rpc.send"].ms == 15.0
+    assert specs["x.y"].once
+    # round-trips through spec_text (what arm_cluster ships)
+    again = fp.parse(";".join(s.spec_text() for s in specs.values()))
+    assert {n: vars(s) for n, s in again.items()} == {
+        n: vars(s) for n, s in specs.items()}
+    with pytest.raises(ValueError):
+        fp.parse("a.b=explode")
+    with pytest.raises(ValueError):
+        fp.parse("a.b=raise(banana=1)")
+    with pytest.raises(ValueError):
+        fp.parse("justaname")
+
+
+def test_predicates_nth_once_off():
+    fp.arm("t.nth", "raise", nth=3)
+    fired = []
+    for _ in range(5):
+        try:
+            fp.fire("t.nth")
+        except fp.FailpointError:
+            fired.append(fp.hits("t.nth"))
+    assert fired == [3]  # exactly the 3rd hit
+
+    fp.arm("t.once", "drop_conn", once=True)
+    assert fp.fire("t.once") == "drop_conn"
+    assert fp.fire("t.once") is None
+
+    fp.arm("t.off", "off")
+    assert not fp.armed("t.off")
+    assert fp.fire("t.off") is None
+
+
+def test_role_gating_and_counters():
+    old_role = fp.get_role()
+    try:
+        fp.set_role("driver")
+        fp.arm("t.role", "raise", role="worker")
+        assert fp.fire("t.role") is None  # wrong role: never fires
+        fp.set_role("worker")
+        with pytest.raises(fp.FailpointError):
+            fp.fire("t.role")
+        snap = fp.snapshot()
+        assert snap["t.role"]["fired"] == 1
+    finally:
+        fp.set_role(old_role)
+
+
+def test_probability_deterministic_with_seed(monkeypatch):
+    monkeypatch.setattr(fp, "_seed", "1234")
+
+    def draw_pattern():
+        fp.set_role("driver")  # reseeds from (_seed, role)
+        fp.arm("t.p", "drop_conn", p=0.5)
+        pattern = [fp.fire("t.p") is not None for _ in range(64)]
+        fp.disarm("t.p")
+        return pattern
+
+    first, second = draw_pattern(), draw_pattern()
+    assert first == second  # replayable from the seed
+    assert any(first) and not all(first)  # p actually filters
+
+
+def test_delay_action_sleeps():
+    fp.arm("t.delay", "delay", ms=30)
+    t0 = time.monotonic()
+    assert fp.fire("t.delay") is None
+    assert time.monotonic() - t0 >= 0.025
+
+
+def test_legacy_chaos_rides_the_registry():
+    """RAY_TPU_CHAOS's knobs are the predefined rpc.send.delay /
+    rpc.send.drop_conn points: evaluated by failpoints.send_fault, with
+    hits visible in the same registry snapshot."""
+    act = fp.send_fault({"kill_conn_p": 1.0, "delay_p": 0.0,
+                         "delay_ms": 10.0})
+    assert act == ("drop_conn", 0.0)
+    kind, delay = fp.send_fault({"kill_conn_p": 0.0, "delay_p": 1.0,
+                                 "delay_ms": 10.0})
+    assert kind == "delay" and 0 <= delay <= 0.010
+    snap = fp.snapshot()
+    assert snap["rpc.send.drop_conn"]["fired"] == 1
+    assert snap["rpc.send.delay"]["fired"] == 1
+    # and the registry's own rpc.send point layers on top
+    fp.arm("rpc.send", "raise")
+    assert fp.send_fault(None) == ("raise", 0.0)
+
+
+# ---------------------------------------------------------------------------
+# rpc seams: faults surface typed, never hang
+# ---------------------------------------------------------------------------
+
+def test_rpc_send_and_recv_failpoints():
+    async def main():
+        server = rpc.Server({"echo": lambda conn, d: d}, name="fp-srv")
+        port = await server.start_tcp()
+        client = rpc.ReconnectingConnection(
+            f"127.0.0.1:{port}", name="fp-cli", retry_timeout=15)
+        assert await client.call("echo", 1, timeout=10) == 1
+
+        # send seam: drop_conn on the 2nd frame -> redial + replay wins
+        fp.arm("rpc.send", "drop_conn", once=True)
+        for i in range(5):
+            assert await client.call("echo", i, timeout=10) == i
+        assert fp.snapshot()["rpc.send"]["fired"] == 1
+
+        # recv seam: the reading side drops the connection; the caller
+        # sees ConnectionLost (typed), then recovery by redial
+        fp.reset()
+        fp.arm("rpc.recv", "drop_conn", once=True)
+        for i in range(5):
+            assert await client.call("echo", i, timeout=10) == i
+        await client.close()
+        await server.close()
+
+    asyncio.run(asyncio.wait_for(main(), scale_timeout(60)))
+
+
+def test_deferred_reply_completer_death_errors_request():
+    """A deferred handler whose completing thread dies must ERROR the
+    in-flight request — a live connection never times out on its own, so
+    a dropped completion would hang the caller forever."""
+
+    async def main():
+        def work(conn, data, msgid):
+            threading.Thread(
+                target=conn.reply_deferred,
+                args=(msgid, "work", "finished"), daemon=True).start()
+
+        work._rpc_deferred = True
+        server = rpc.Server({"work": work}, name="def-srv")
+        port = await server.start_tcp()
+        conn = await rpc.connect(f"127.0.0.1:{port}", name="def-cli")
+
+        assert await conn.call("work", None, timeout=10) == "finished"
+        fp.arm("rpc.reply_deferred", "raise", once=True)
+        with pytest.raises(rpc.RemoteError) as ei:
+            await conn.call("work", None, timeout=10)
+        assert isinstance(ei.value.exc, fp.FailpointError)
+        # disarmed (once): the seam heals
+        assert await conn.call("work", None, timeout=10) == "finished"
+        await conn.close()
+        await server.close()
+
+    asyncio.run(asyncio.wait_for(main(), scale_timeout(60)))
+
+
+def test_reconnect_backoff_and_typed_give_up(monkeypatch):
+    """Redials back off exponentially (not a fixed 50ms hammer), and
+    exhausting the budget surfaces ConnectionGaveUp — a typed error — to
+    every queued caller and every later caller."""
+    dials = []
+    real_dial = rpc.dial_once
+
+    async def counting_dial(address, *a, **kw):
+        dials.append(asyncio.get_running_loop().time())
+        return await real_dial(address, *a, **kw)
+
+    monkeypatch.setattr(rpc, "dial_once", counting_dial)
+
+    async def main():
+        server = rpc.Server({"echo": lambda conn, d: d}, name="bo-srv")
+        port = await server.start_tcp()
+        client = rpc.ReconnectingConnection(
+            f"127.0.0.1:{port}", name="bo-cli", retry_timeout=2.0)
+        gave_up = []
+        client._on_give_up = lambda: gave_up.append(1)
+        assert await client.call("echo", 1, timeout=10) == 1
+        await server.close()
+        dials.clear()
+
+        async def one(i):
+            try:
+                await client.call("echo", i)
+                return None
+            except rpc.ConnectionLost as e:
+                return e
+
+        results = await asyncio.gather(*[one(i) for i in range(3)])
+        assert all(isinstance(r, rpc.ConnectionGaveUp) for r in results), \
+            results
+        assert gave_up == [1]  # on_give_up ran exactly once
+        # future callers get the same typed error immediately
+        with pytest.raises(rpc.ConnectionGaveUp):
+            await client.call("echo", 99)
+        # backoff: a 2s budget at fixed 50ms cadence would be ~40 dials;
+        # exponential backoff keeps it far below
+        assert 1 <= len(dials) <= 12, len(dials)
+        await client.close()
+
+    asyncio.run(asyncio.wait_for(main(), scale_timeout(60)))
+
+
+# ---------------------------------------------------------------------------
+# memstore + shm seams
+# ---------------------------------------------------------------------------
+
+def test_memstore_callback_failpoint_isolated():
+    """An injected ready-callback failure is contained: sibling
+    callbacks still fire and the putter survives."""
+    from ray_tpu._private.ids import ObjectID
+    from ray_tpu._private.memstore import MemoryStore
+
+    store = MemoryStore()
+    oid = ObjectID(b"z" * 24)
+    store.open(oid)
+    fired = []
+    store.add_ready_callback(oid, lambda: fired.append(1))
+    store.add_ready_callback(oid, lambda: fired.append(2))
+    fp.arm("memstore.ready_callback", "raise", nth=1)
+    store.put(oid, b"v")  # must not raise into the putter
+    assert fired == [2]  # first callback lost to the fault, second fine
+
+
+def _mk_shm_pair(tmp_path, timeout=0.4):
+    from ray_tpu.collective.backends.shm_transport import ShmTransport
+
+    cookie = os.urandom(16)
+    name = f"fp_test_{cookie.hex()[:8]}.seg"
+    t0 = ShmTransport.create(name, cookie, 2, 0, 4096, timeout)
+    t1 = ShmTransport.open(t0.path, cookie, 2, 1, 4096, timeout)
+    return t0, t1
+
+
+def test_shm_survivor_unlinks_after_owner_death(tmp_path):
+    """Rank 0 dying between segment map and unlink must not leak tmpfs:
+    the survivor times out within the group deadline (typed), and its
+    teardown unlinks the file."""
+    t0, t1 = _mk_shm_pair(tmp_path)
+    path = t0.path
+    # rank 0 "dies": never posts, never closes (no unlink happens)
+    t0._seg = None  # drop without close, like a SIGKILL would
+    deadline = time.monotonic() + scale_timeout(5)
+    with pytest.raises(TimeoutError):
+        t1.barrier(deadline=time.monotonic() + 0.4)
+    assert time.monotonic() < deadline
+    t1.close(unlink=True)  # the hardened survivor path (host_backend)
+    assert not os.path.exists(path)
+
+
+def test_shm_barrier_failpoint_aborts_peers(tmp_path):
+    """A rank erroring at the barrier seam stamps the abort word: the
+    peer fails fast with TimeoutError instead of waiting out its full
+    deadline; the segment is poisoned and unlinked."""
+    t0, t1 = _mk_shm_pair(tmp_path, timeout=scale_timeout(5))
+    path = t0.path
+    fp.arm("shm.barrier", "raise", nth=1)
+    with pytest.raises(fp.FailpointError):
+        t0.barrier()  # injected rank dies at the seam (abort stamped)
+    t_start = time.monotonic()
+    with pytest.raises(TimeoutError):
+        t1.barrier()  # peer aborts fast, not at its deadline
+    assert time.monotonic() - t_start < scale_timeout(4)
+    t0.close(unlink=True)
+    t1.close(unlink=True)
+    assert not os.path.exists(path)
+
+
+def test_shm_map_failpoint_fails_cleanly(tmp_path):
+    from ray_tpu.collective.backends.shm_transport import ShmTransport
+
+    fp.arm("shm.map", "raise", once=True)
+    with pytest.raises(fp.FailpointError):
+        ShmTransport.create("fp_map_fail.seg", os.urandom(16), 2, 0,
+                            4096, 1.0)
+    # nothing was created at the would-be path
+    from ray_tpu.native.store.segment import segment_dir
+
+    assert not os.path.exists(os.path.join(segment_dir(),
+                                           "fp_map_fail.seg"))
+
+
+# ---------------------------------------------------------------------------
+# cluster-level: live arming + crash-retry (tier-1, kept lean)
+# ---------------------------------------------------------------------------
+
+def test_live_kv_arming_mid_run():
+    """Arm a point mid-run through the internal KV: the GCS applies and
+    broadcasts it; a WORKER process (spawned before the arming) fires it;
+    disarming heals the cluster."""
+    ray_tpu.init(num_cpus=2)
+    try:
+        @ray_tpu.remote
+        def sq(x):
+            return x * x
+
+        assert ray_tpu.get(sq.remote(3), timeout=scale_timeout(60)) == 9
+        fp.arm_cluster("worker.exec=raise(nth=1,role=worker)")
+        saw_injected = False
+        deadline = time.monotonic() + scale_timeout(60)
+        while time.monotonic() < deadline and not saw_injected:
+            try:
+                ray_tpu.get(sq.remote(3), timeout=scale_timeout(30))
+            except exc.TaskError as e:
+                assert "failpoint" in str(e).lower(), e
+                saw_injected = True
+        assert saw_injected, "armed failpoint never fired in a worker"
+        fp.disarm_cluster()
+        assert ray_tpu.get(sq.remote(5), timeout=scale_timeout(60)) == 25
+    finally:
+        fp.reset()
+        ray_tpu.shutdown()
+
+
+def test_worker_killed_at_failpoint_surfaces_typed(monkeypatch):
+    """Every worker hard-dies at its first task (env-armed before init):
+    a zero-retry task must surface WorkerCrashedError — typed, within
+    its deadline, no hang — and the cluster must stay serviceable."""
+    monkeypatch.setenv(fp.ENV_VAR, "worker.exec=exit(nth=1,role=worker)")
+    fp.configure(os.environ[fp.ENV_VAR])  # driver side (role-gated off)
+    ray_tpu.init(num_cpus=2)
+    try:
+        @ray_tpu.remote(max_retries=0)
+        def doomed():
+            return "never"
+
+        with pytest.raises(exc.WorkerCrashedError):
+            ray_tpu.get(doomed.remote(), timeout=scale_timeout(120))
+
+        # with retries, the crash is absorbed: each retry lands on a
+        # fresh worker which dies at ITS first task, until retries or
+        # the failpoint's nth window runs out -> typed either way
+        @ray_tpu.remote(max_retries=3)
+        def survivor():
+            return "ok"
+
+        try:
+            ray_tpu.get(survivor.remote(), timeout=scale_timeout(120))
+        except exc.WorkerCrashedError:
+            pass  # typed exhaustion is acceptable; a hang is not
+    finally:
+        fp.reset()
+        ray_tpu.shutdown()
+
+
+def test_lease_holder_death_returns_leases():
+    """A lease holder whose connection dies must give its leases back:
+    the raylet releases the resources and returns still-alive workers to
+    the idle pool, instead of stranding them until node teardown."""
+    ray_tpu.init(num_cpus=2)
+    try:
+        import ray_tpu.api as api_mod
+        from ray_tpu._private import common, global_state
+
+        cw = global_state.require_core_worker()
+
+        @ray_tpu.remote
+        def one():
+            return 1
+
+        assert ray_tpu.get(one.remote(), timeout=scale_timeout(60)) == 1
+        addr = api_mod._global_node.raylet_address
+
+        async def scenario():
+            conn = await rpc.connect(addr, name="doomed-owner")
+            await conn.call("register_client", {
+                "kind": "driver", "worker_id": b"o" * 16,
+                "address": "127.0.0.1:1", "pid": 0, "flavor": "cpu",
+                "task_channel": ""})
+            spec = common.make_task_spec(
+                task_id=b"t" * 20, job_id=b"\x00" * 4, name="hog",
+                fn_id=b"f" * 16, owner_addr="127.0.0.1:1",
+                resources={"CPU": 2})
+            reply = await conn.call("request_worker_lease",
+                                    {"spec": spec}, timeout=60)
+            assert reply.get("granted"), reply
+            probe = await rpc.connect(addr, name="probe")
+            info = await probe.call("cluster_info", {})
+            assert info["available"].get("CPU", 0) == 0  # all leased out
+            await conn.close()  # the lease holder dies
+            deadline = time.monotonic() + scale_timeout(20)
+            freed = 0
+            while time.monotonic() < deadline:
+                info = await probe.call("cluster_info", {})
+                freed = info["available"].get("CPU", 0)
+                if freed == info["total"].get("CPU"):
+                    break
+                await asyncio.sleep(0.1)
+            await probe.close()
+            assert freed == info["total"].get("CPU"), (
+                "raylet did not reclaim the dead holder's lease")
+
+        cw._io.run(scenario(), timeout=scale_timeout(90))
+        # the pool stays serviceable afterwards
+        assert ray_tpu.get(one.remote(), timeout=scale_timeout(60)) == 1
+    finally:
+        ray_tpu.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# seeded chaos sweep (slow tier: pytest -m chaos)
+# ---------------------------------------------------------------------------
+
+# Schedule menu: (spec template, which layer it kills). nth is drawn per
+# seed so the kill lands mid-workload, deterministically.
+_MENU = [
+    ("worker.exec=exit(nth={n},role=worker)", "worker"),
+    ("rpc.dispatch=exit(nth={n},role=worker)", "rpc"),
+    ("channel.read=drop_conn(nth={n},role=worker)", "channel"),
+    ("channel.reply=drop_conn(nth={n},role=worker)", "channel"),
+    ("rpc.reply_deferred=raise(nth={n},role=worker)", "rpc"),
+    ("lease.grant=raise(nth={n},role=raylet)", "lease"),
+    ("lease.return=raise(nth={n},role=raylet)", "lease"),
+    ("raylet.spawn=raise(nth={n},role=raylet)", "lease"),
+    ("gcs.table.apply=raise(nth={n},role=gcs)", "gcs"),
+    ("gcs.publish=drop_conn(nth={n},role=gcs)", "gcs"),
+]
+
+# Typed errors a faulted workload may legitimately surface (the ISSUE
+# invariant). GetTimeoutError is deliberately NOT here: with these
+# deadlines it means the workload hung.
+_TYPED = (exc.WorkerCrashedError, exc.ActorDiedError,
+          exc.ActorUnavailableError, exc.ObjectLostError,
+          exc.NodeDiedError, exc.TaskError, exc.TaskCancelledError)
+
+_SEEDS = ([int(os.environ["RAY_TPU_CHAOS_SEED"])]
+          if os.environ.get("RAY_TPU_CHAOS_SEED")
+          else [101, 102, 103, 104, 105])
+
+
+def _run_or_typed(label, seed, thunk):
+    """Run one workload: correct result or typed error; a hang fails."""
+    try:
+        thunk()
+    except exc.GetTimeoutError:
+        pytest.fail(f"[chaos seed={seed}] {label} HUNG past its deadline "
+                    f"(replay: RAY_TPU_CHAOS_SEED={seed})")
+    except _TYPED as e:
+        print(f"[chaos seed={seed}] {label}: typed failure {type(e).__name__}")
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+@pytest.mark.parametrize("seed", _SEEDS)
+def test_chaos_kill_schedule_sweep(seed, monkeypatch):
+    rng = random.Random(seed)
+    picks = rng.sample(_MENU, k=2)
+    spec = ";".join(t.format(n=rng.randint(2, 5)) for t, _ in picks)
+    print(f"[chaos] seed={seed} schedule={spec!r} "
+          f"(replay: RAY_TPU_CHAOS_SEED={seed})")
+    monkeypatch.setenv(fp.SEED_ENV, str(seed))
+    budget = scale_timeout(120)
+    ray_tpu.init(num_cpus=2)
+    try:
+        # Arm through the live KV plane AFTER the cluster is up: the
+        # nth counters then tick on workload traffic (deterministic
+        # mid-run kills), not on bootstrap chatter.
+        fp.arm_cluster(spec)
+        # --- tasks: fan-out -> fan-in with dependencies ---
+        @ray_tpu.remote
+        def square(x):
+            return x * x
+
+        @ray_tpu.remote
+        def total(*parts):
+            return sum(parts)
+
+        def tasks():
+            refs = [square.remote(i) for i in range(12)]
+            got = ray_tpu.get(total.remote(*refs), timeout=budget)
+            assert got == sum(i * i for i in range(12)), \
+                f"SILENT CORRUPTION: {got}"
+
+        _run_or_typed("tasks", seed, tasks)
+
+        # --- actor: ordered calls on a restartable actor ---
+        @ray_tpu.remote(max_restarts=1)
+        class Counter:
+            def __init__(self):
+                self.n = 0
+
+            def add(self, k):
+                self.n += k
+                return self.n
+
+        def actor():
+            c = Counter.remote()
+            last = 0
+            for k in range(1, 9):
+                last = ray_tpu.get(c.add.remote(k), timeout=budget)
+            assert last == sum(range(1, 9)), f"SILENT CORRUPTION: {last}"
+
+        _run_or_typed("actor", seed, actor)
+
+        # --- serve: handle path (router/loop-queue seams) ---
+        from ray_tpu import serve
+
+        def serve_wl():
+            client = serve.start()
+            try:
+                client.create_backend("fp_double", lambda x: x * 2)
+                client.create_endpoint("fp_ep", backend="fp_double")
+                handle = client.get_handle("fp_ep")
+                out = ray_tpu.get([handle.remote(i) for i in range(6)],
+                                  timeout=budget)
+                assert out == [i * 2 for i in range(6)], \
+                    f"SILENT CORRUPTION: {out}"
+            finally:
+                client.shutdown()
+
+        _run_or_typed("serve", seed, serve_wl)
+    finally:
+        fp.reset()
+        ray_tpu.shutdown()
+
+    # --- collective: shm group with a seed-chosen barrier fault ---
+    # (in-process ranks; a faulted rank must abort its peer within the
+    # group timeout and the segment must not leak)
+    import numpy as np
+
+    fp.configure(f"shm.barrier=raise(nth={rng.randint(2, 6)})")
+    try:
+        from ray_tpu.collective.backends.shm_transport import ShmTransport
+
+        cookie = os.urandom(16)
+        t0 = ShmTransport.create(f"chaos_{seed}_{cookie.hex()[:6]}.seg",
+                                 cookie, 2, 0, 1 << 16, scale_timeout(10))
+        t1 = ShmTransport.open(t0.path, cookie, 2, 1, 1 << 16,
+                               scale_timeout(10))
+        path = t0.path
+        data = [np.arange(64, dtype=np.float32),
+                np.arange(64, dtype=np.float32) * 2]
+        results = [None, None]
+
+        def rank(i, t):
+            from ray_tpu.collective.types import ReduceOp
+
+            try:
+                for _ in range(4):
+                    results[i] = t.allreduce(data[i], ReduceOp.SUM)
+            except (TimeoutError, fp.FailpointError) as e:
+                results[i] = e
+
+        threads = [threading.Thread(target=rank, args=(i, t))
+                   for i, t in enumerate((t0, t1))]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=scale_timeout(30))
+        assert not any(th.is_alive() for th in threads), \
+            f"[chaos seed={seed}] collective rank HUNG"
+        for r in results:
+            ok = (isinstance(r, (TimeoutError, fp.FailpointError))
+                  or (r is not None and not isinstance(r, Exception)
+                      and np.allclose(r, data[0] + data[1])))
+            assert ok, f"[chaos seed={seed}] collective bad outcome: {r!r}"
+        t0.close(unlink=True)
+        t1.close(unlink=True)
+        assert not os.path.exists(path), "leaked shm segment"
+    finally:
+        fp.reset()
