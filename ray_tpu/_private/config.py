@@ -36,6 +36,41 @@ class Config:
     # Admission control: concurrent inbound object transfers per raylet
     # (reference: pull_manager.h bounded active pulls).
     max_concurrent_object_pulls: int = 4
+    # Work-stealing unit for multi-source striped pulls: each source
+    # streams ranges of this size off a shared queue, so a slow source
+    # naturally ends up transferring fewer bytes and a dead one's
+    # remaining ranges are resumed by survivors (Hoplite-style
+    # multi-source fetch).
+    object_transfer_stripe_size: int = 8 * 1024**2
+    # Max sources striped across in one pull (extra directory entries are
+    # kept as failover spares).
+    max_pull_sources: int = 4
+    # Sender-side transfer pin lease: an object being served to a puller
+    # is protected from free/eviction for this long past the last
+    # activity, so a dead puller cannot pin the arena forever
+    # (reference: the pinned_objects set in object_manager.h, bounded
+    # here by time instead of by connection liveness alone).
+    transfer_pin_ttl_s: float = 20.0
+    # A pull whose GCS directory lookup stays EMPTY for this long (no
+    # node claims a copy) propagates typed object loss to its waiters
+    # instead of spinning the lookup forever.
+    pull_no_location_timeout_s: float = 10.0
+    # Per-socket IO timeout on the bulk transfer channel (recv/send of
+    # one chunk): a stalled peer mid-stream surfaces as a socket timeout
+    # and the remaining ranges fail over to other sources.
+    bulk_transfer_io_timeout_s: float = 30.0
+
+    # --- locality-aware scheduling ---
+    # Weigh lease targets by resident plasma-arg bytes (GCS object
+    # directory): a task whose args live on another node is leased there
+    # instead of pulling the args here (reference: lease_policy.h
+    # locality-aware lease targeting). Spillback/queueing still apply on
+    # the target.
+    locality_aware_leasing: bool = True
+    # Only redirect when the best remote node holds at least this many
+    # MORE resident arg bytes than the local node (small args are cheaper
+    # to move than the task round trip).
+    locality_min_arg_bytes: int = 1024 * 1024
     # Spill directory ("" = session dir /spill).
     object_spilling_path: str = ""
     # Spill when store usage exceeds this fraction.

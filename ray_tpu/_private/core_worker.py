@@ -586,8 +586,12 @@ class CoreWorker:
             ok = self._io.run(self.raylet.call(
                 "wait_object_local",
                 {"object_id": object_id.binary(), "timeout": probe}))
-            if ok:
+            if ok is True:
                 continue
+            # ok is False (probe timeout) or "lost" (the raylet's pull
+            # saw an EMPTY directory past its deadline and propagated
+            # typed loss — skip further probe cycles and go straight to
+            # the location re-check + lineage recovery below)
             try:
                 locations = self._io.run(self.gcs.call(
                     "get_object_locations",

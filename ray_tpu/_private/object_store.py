@@ -87,6 +87,10 @@ class LocalObjectStore:
         except FileNotFoundError:
             return None
 
+    # files-backend reads are already zero-copy mmaps with explicit
+    # close(); the native backend's get_raw contract maps onto get()
+    get_raw = get
+
     def size_of(self, object_id: ObjectID) -> int:
         return os.stat(self._path(object_id)).st_size
 

@@ -41,10 +41,18 @@ class Gauge(Metric):
 
     def __init__(self, name: str, description: str = ""):
         self._value = 0.0
+        self._lock = threading.Lock()
         super().__init__(name, description)
 
     def set(self, value: float):
         self._value = float(value)
+
+    def add(self, delta: float):
+        """Thread-safe relative update — for gauges tracking a live count
+        (e.g. in-flight transfer chunks) incremented/decremented from
+        many worker threads."""
+        with self._lock:
+            self._value += delta
 
     def snapshot(self):
         return {"type": "gauge", "value": self._value}

@@ -126,7 +126,11 @@ class Dashboard:
             out.append({"node_id": n["node_id"].hex()[:12],
                         "num_objects": info["num_local_objects"],
                         "store_used_bytes": info["store_used"],
-                        "num_workers": info["num_workers"]})
+                        "num_workers": info["num_workers"],
+                        # bulk transfer plane (raylet/transfer.py):
+                        # cumulative pull bytes, striped pulls, live
+                        # in-flight chunks and sender-side pins
+                        "transfer": info.get("transfer", {})})
         return out
 
     async def logs(self, node: str | None = None, file: str | None = None,

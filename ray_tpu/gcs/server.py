@@ -59,8 +59,11 @@ class GcsServer:
         self.named_actors: dict[tuple[str, str], bytes] = {}
         self.jobs: dict[bytes, dict] = {}
         self.next_job = 1
-        # object_id -> set of node_ids
-        self.object_locations: dict[bytes, set[bytes]] = {}
+        # object_id -> {"nodes": set of node_ids, "size": bytes} — the
+        # object directory (reference: object_directory.h). Sizes feed
+        # the raylets' locality-aware lease targeting; multiple nodes
+        # feed multi-source striped pulls.
+        self.object_locations: dict[bytes, dict] = {}
         self.placement_groups: dict[bytes, dict] = {}
         self.server = rpc.Server(self._handlers(), on_disconnect=self._on_disconnect,
                                  name="gcs")
@@ -166,6 +169,7 @@ class GcsServer:
             "add_object_location": self.h_add_object_location,
             "remove_object_location": self.h_remove_object_location,
             "get_object_locations": self.h_get_object_locations,
+            "get_object_locations_batch": self.h_get_object_locations_batch,
             "create_placement_group": self.h_create_placement_group,
             "remove_placement_group": self.h_remove_placement_group,
             "get_placement_group": self.h_get_placement_group,
@@ -246,6 +250,10 @@ class GcsServer:
             "node_id": node_id,
             "address": d["address"],  # raylet rpc address
             "object_manager_address": d.get("object_manager_address", d["address"]),
+            # bulk object data-plane listener (raylet/transfer.py); ""
+            # when the node runs without one (peers fall back to the
+            # legacy chunked rpc pull)
+            "bulk_address": d.get("bulk_address", ""),
             "resources": d["resources"],  # raw quantized dict
             "hostname": d.get("hostname", ""),
             "is_head": d.get("is_head", False),
@@ -361,8 +369,12 @@ class GcsServer:
         for actor_id, rec in list(self.actors.items()):
             if rec.get("node_id") == node_id and rec["state"] in (ALIVE, PENDING_CREATION):
                 await self._on_actor_interrupted(actor_id, f"node died ({reason})")
-        for oid, nodes in list(self.object_locations.items()):
-            nodes.discard(node_id)
+        for oid, rec in list(self.object_locations.items()):
+            rec["nodes"].discard(node_id)
+            if not rec["nodes"]:
+                # no copy left anywhere: pulls waiting on this object
+                # hit the empty-directory deadline and fail typed
+                del self.object_locations[oid]
 
     async def heartbeat_checker(self):
         cfg = self.config
@@ -688,20 +700,36 @@ class GcsServer:
 
     # ---- object directory ----
     async def h_add_object_location(self, conn, d):
-        locs = self.object_locations.setdefault(d["object_id"], set())
-        locs.add(d["node_id"])
+        rec = self.object_locations.setdefault(
+            d["object_id"], {"nodes": set(), "size": 0})
+        rec["nodes"].add(d["node_id"])
+        if d.get("size"):
+            rec["size"] = int(d["size"])
         return True
 
     async def h_remove_object_location(self, conn, d):
-        locs = self.object_locations.get(d["object_id"])
-        if locs:
-            locs.discard(d["node_id"])
-            if not locs:
+        rec = self.object_locations.get(d["object_id"])
+        if rec:
+            rec["nodes"].discard(d["node_id"])
+            if not rec["nodes"]:
                 del self.object_locations[d["object_id"]]
         return True
 
     async def h_get_object_locations(self, conn, d):
-        return list(self.object_locations.get(d["object_id"], ()))
+        rec = self.object_locations.get(d["object_id"])
+        return list(rec["nodes"]) if rec else []
+
+    async def h_get_object_locations_batch(self, conn, d):
+        """Locations + sizes for a set of objects in one round trip —
+        feeds the raylets' locality-aware lease targeting (arg-byte
+        weighting) and multi-source pull planning."""
+        out = {}
+        for oid in d["object_ids"]:
+            rec = self.object_locations.get(oid)
+            if rec:
+                out[oid] = {"nodes": list(rec["nodes"]),
+                            "size": rec["size"]}
+        return out
 
     # ---- placement groups ----
     async def h_create_placement_group(self, conn, d):
@@ -1011,8 +1039,9 @@ class GcsServer:
 
 def _node_public(info):
     return {k: info.get(k) for k in (
-        "node_id", "address", "object_manager_address", "resources",
-        "hostname", "is_head", "state", "labels", "tpu_slice")}
+        "node_id", "address", "object_manager_address", "bulk_address",
+        "resources", "hostname", "is_head", "state", "labels",
+        "tpu_slice")}
 
 
 def main():

@@ -300,7 +300,185 @@ def main(seconds_per_case: float = 2.0) -> list[dict]:
     _serve_qps(results)
 
     ray_tpu.shutdown()
+
+    _cross_node_bench(results)
     return results
+
+
+def _cross_node_bench(results: list[dict], windows: int = 5):
+    """Cross-node object pull A/B (needs real raylet process boundaries,
+    so it runs on its own cluster_utils cluster AFTER the single-node
+    suite). Per size, each window times ONE pull per arm — streaming
+    bulk-channel pull vs the preserved round-8 stop-and-wait fetch_chunk
+    control (set_transfer_mode flips the puller raylet live, so the arms
+    interleave inside the same windows) — median of N windows. Also: a
+    2-source striped pull, and the control-plane probe: peer_ping RTTs
+    over the shared raylet<->raylet CONTROL connection while a 64MB pull
+    is in flight (legacy chunks head-of-line-block that conn; streaming
+    must leave it idle)."""
+    from ray_tpu._private import global_state
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+    try:
+        _cross_node_bench_body(results, windows, cluster)
+    finally:
+        # a failed assert/timeout must not orphan the gcs/raylet
+        # children (orphans poison every later benchmark on this box)
+        cw = global_state.get_core_worker()
+        if cw is not None:
+            cw.shutdown()
+        cluster.shutdown()
+
+
+def _cross_node_bench_body(results: list[dict], windows: int, cluster):
+    import asyncio
+
+    src_b = cluster.add_node(num_cpus=1, resources={"srcb": 1})
+    src_c = cluster.add_node(num_cpus=1, resources={"srcc": 1})
+    cw = cluster.connect_driver()
+    head = cw.raylet
+
+    def rcall(method, data, timeout=180.0):
+        return cw._io.run(head.call(method, data), timeout=timeout)
+
+    def set_mode(legacy):
+        rcall("set_transfer_mode", {"legacy": legacy})
+
+    def pull(oid, free_after=True) -> float:
+        t0 = time.perf_counter()
+        ok = rcall("wait_object_local", {"object_id": oid, "timeout": 150})
+        dt = time.perf_counter() - t0
+        assert ok is True, f"pull did not complete: {ok!r}"
+        if free_after:
+            rcall("free_objects", {"object_ids": [oid]})
+        return dt
+
+    @ray_tpu.remote(num_cpus=1, resources={"srcb": 1})
+    def produce(nbytes):
+        import numpy as _np
+
+        return _np.arange(nbytes, dtype=_np.uint8)
+
+    @ray_tpu.remote(num_cpus=1, resources={"srcc": 1})
+    def touch(arr):
+        return int(arr.nbytes)
+
+    def wait_locations(oid, n):
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if len(cw._io.run(cw.gcs.call(
+                    "get_object_locations", {"object_id": oid}))) >= n:
+                return
+            time.sleep(0.1)
+        raise TimeoutError("object location never registered")
+
+    refs = {}
+    for mb in (1, 16, 64):
+        refs[mb] = produce.remote(mb * 1024 * 1024)
+        wait_locations(refs[mb].id().binary(), 1)
+
+    def record(name, rates, nbytes):
+        med = float(np.median(rates))
+        sd = float(np.std(rates))
+        gb_s = med * nbytes / 1e9
+        flagged = bool(med > 0 and sd > 0.5 * med)
+        print(f"{name} per second {med:.2f} ({gb_s:.3f} GB/s, median of "
+              f"{len(rates)})" + ("  [HIGH VARIANCE]" if flagged else ""))
+        row = {"name": name, "per_second": med, "sd": sd,
+               "gb_s": round(gb_s, 4),
+               "trials": [round(r, 3) for r in rates]}
+        if flagged:
+            row["high_variance"] = True
+        results.append(row)
+
+    for mb in (1, 16, 64):
+        oid = refs[mb].id().binary()
+        for legacy in (False, True):  # warm both arms' connections
+            set_mode(legacy)
+            pull(oid)
+        rates: dict[bool, list] = {False: [], True: []}
+        for _ in range(windows):
+            for legacy in (False, True):  # interleaved within the window
+                set_mode(legacy)
+                rates[legacy].append(1.0 / pull(oid))
+        record(f"cross_node_pull {mb}MB", rates[False], mb * 1024 * 1024)
+        record(f"cross_node_pull {mb}MB (legacy-path control)",
+               rates[True], mb * 1024 * 1024)
+    set_mode(None)
+
+    # --- 1src vs 2src striped pull (64MB), PAIRED interleaved: the
+    # second source's directory entry is removed for the 1src slice of
+    # each window and restored for the 2src slice, so a box-load swing
+    # hits both sides equally (the arms' trial spread on this shared
+    # 2-core host is wider than the striping delta — unpaired medians
+    # are noise).
+    nbytes = 64 * 1024 * 1024
+    oid = refs[64].id().binary()
+    assert ray_tpu.get(touch.remote(refs[64]), timeout=300) > 0
+    wait_locations(oid, 2)
+
+    def set_second_source(present: bool):
+        method = ("add_object_location" if present
+                  else "remove_object_location")
+        data = {"object_id": oid, "node_id": src_c.node_id.binary()}
+        if present:
+            data["size"] = nbytes
+        cw._io.run(cw.gcs.call(method, data))
+
+    striped0 = rcall("get_metrics", {}).get(
+        "raylet.pulls_striped_total", {}).get("value", 0)
+    for present in (False, True):  # warm both shapes
+        set_second_source(present)
+        pull(oid)
+    rates1, rates2 = [], []
+    for _ in range(max(windows, 7)):
+        set_second_source(False)
+        rates1.append(1.0 / pull(oid))
+        set_second_source(True)
+        rates2.append(1.0 / pull(oid))
+    striped = rcall("get_metrics", {}).get(
+        "raylet.pulls_striped_total", {}).get("value", 0) - striped0
+    record("cross_node_pull 64MB 1src (paired)", rates1, nbytes)
+    record("cross_node_pull 64MB 2src", rates2, nbytes)
+    results[-1]["striped_pulls"] = striped
+
+    # --- control-plane RTT during a 64MB bulk pull ---
+    # peer_ping rides the head raylet's shared control connection to the
+    # source — exactly where legacy bulk frames also travel.
+    async def ping_during_pull(oid):
+        lats = []
+        pull_fut = asyncio.ensure_future(head.call(
+            "wait_object_local", {"object_id": oid, "timeout": 150}))
+        await asyncio.sleep(0.005)  # let the pull get going
+        while not pull_fut.done():
+            lats.append(await head.call("peer_ping",
+                                        {"address": src_b.address}))
+        assert (await pull_fut) is True
+        await head.call("free_objects", {"object_ids": [oid]})
+        return lats
+
+    oid = refs[16].id().binary()  # single-source (B) object
+    for legacy, suffix in ((False, ""), (True, " (legacy-path control)")):
+        set_mode(legacy)
+        lats: list[float] = []
+        for _ in range(windows):
+            lats.extend(cw._io.run(ping_during_pull(oid), timeout=300))
+        name = f"cross_node_pull control ping during 16MB pull{suffix}"
+        if not lats:
+            # pull outraced every ping this window: no row (NaN would
+            # make MICROBENCH.json invalid JSON for strict parsers)
+            print(f"{name}: no pings completed during the pull; skipped")
+            continue
+        p99 = float(np.percentile(lats, 99))
+        p50 = float(np.median(lats))
+        print(f"{name}: p50 {p50 * 1e3:.2f}ms p99 {p99 * 1e3:.2f}ms "
+              f"({len(lats)} pings)")
+        results.append({"name": name, "per_second": 1.0 / p99,
+                        "sd": 0.0, "p99_ms": round(p99 * 1e3, 3),
+                        "p50_ms": round(p50 * 1e3, 3),
+                        "samples": len(lats)})
+    set_mode(None)
 
 
 def _collective_bench(results: list[dict], nbytes: int = 16 * 1024 * 1024,

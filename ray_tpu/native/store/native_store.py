@@ -127,6 +127,32 @@ class _PinnedBlock:
                 pass
 
 
+class _RawBuffer:
+    """Arena view whose pin is released by an explicit close() (see
+    NativeObjectStore.get_raw). Double-close safe."""
+
+    __slots__ = ("view", "size", "_store", "_oid", "_offset", "_closed")
+
+    def __init__(self, store, oid: bytes, offset: int, view: memoryview,
+                 size: int):
+        self.view = view
+        self.size = size
+        self._store = store
+        self._oid = oid
+        self._offset = offset
+        self._closed = False
+
+    def close(self):
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self.view.release()
+        except (BufferError, ValueError):
+            pass
+        self._store._release(self._oid, self._offset)
+
+
 class NativeObjectStore:
     """LocalObjectStore-compatible backend over the C++ arena."""
 
@@ -214,6 +240,24 @@ class NativeObjectStore:
             raw.release()
             self._release(oid, off.value)
         return _ArenaBuffer(memoryview(data), size.value)
+
+    def get_raw(self, object_id: ObjectID) -> "_RawBuffer | None":
+        """Pinned zero-copy read with EXPLICIT lifetime: the returned
+        buffer's view aliases the arena directly and close() drops the
+        native pin by hand. For runtime-internal readers (the bulk
+        transfer server) that own the buffer for a bounded scope — the
+        view MUST NOT be touched after close(). Unlike get(), this is
+        zero-copy on every Python version: release is explicit, so no
+        PEP-688 buffer-protocol export is needed."""
+        off = ctypes.c_uint64()
+        size = ctypes.c_uint64()
+        oid = object_id.binary()
+        rc = self._lib.rts_get(self._h, oid,
+                               ctypes.byref(off), ctypes.byref(size))
+        if rc != 0:
+            return None
+        raw = self._mv[off.value:off.value + size.value]
+        return _RawBuffer(self, oid, off.value, raw, size.value)
 
     def size_of(self, object_id: ObjectID) -> int:
         # size-only: rts_get already returns it — don't materialize the

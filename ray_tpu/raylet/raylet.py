@@ -32,6 +32,7 @@ from ray_tpu._private.common import InsufficientResources, ResourceSet
 from ray_tpu._private.config import Config, get_config, set_config
 from ray_tpu._private.ids import NodeID, ObjectID
 from ray_tpu._private.object_store import make_store
+from ray_tpu.raylet import transfer
 
 logger = logging.getLogger("ray_tpu.raylet")
 
@@ -108,6 +109,9 @@ class Raylet:
             "raylet.workers_started_total", "worker processes spawned")
         self.m_objects_pulled = stats.Count(
             "raylet.objects_pulled_total", "objects pulled from peers")
+        self.m_locality_spillbacks = stats.Count(
+            "raylet.locality_spillbacks_total",
+            "lease requests redirected to the node holding their args")
         self.num_cpus = int(resources.get("CPU", os.cpu_count() or 1))
 
         # scheduling
@@ -128,6 +132,24 @@ class Raylet:
         self.spill_dir = os.path.join(session_dir, "spill")
         self._pulls_inflight: set[bytes] = set()
         self._pull_sem_obj = None
+
+        # bulk transfer data plane (raylet/transfer.py): dedicated
+        # streaming channel for object bytes, sender-side transfer pins,
+        # and the A/B switch back to the round-8 stop-and-wait path
+        self.transfer_pins = transfer.TransferPins()
+        self.bulk = transfer.BulkTransferServer(self)
+        self.bulk_address = ""
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._pull_mode_legacy: bool | None = None  # None = env-driven
+        # arg-id set -> monotonic expiry of a NO-redirect locality
+        # decision: repeated lease requests for the SAME pending task's
+        # args (the retry/escalation pattern) skip the per-request GCS
+        # directory round trip. Keyed by the args — not the scheduling
+        # key — so one small-arg call can't suppress redirects for a
+        # later call of the same function with different, remote-resident
+        # args. Positive redirects are never cached (must see fresh
+        # locations).
+        self._locality_negcache: dict[tuple, float] = {}
 
         # cluster view (from GCS pubsub)
         self.cluster_nodes: dict[bytes, dict] = {}
@@ -169,6 +191,9 @@ class Raylet:
             "fetch_chunk": self.h_fetch_chunk,
             "push_hint": self.h_push_hint,
             "push_objects_to": self.h_push_objects_to,
+            "transfer_done": self.h_transfer_done,
+            "set_transfer_mode": self.h_set_transfer_mode,
+            "peer_ping": self.h_peer_ping,
             "ping": lambda conn, d: "pong",
         }
 
@@ -317,6 +342,13 @@ class Raylet:
     async def _on_disconnect(self, conn):
         if self._shutting_down:
             return
+        # A legacy puller's transfer pins die with its connection (the
+        # TTL sweep is only the backstop for pullers that wedge without
+        # closing); deferred frees they were blocking run now.
+        freeable = self.transfer_pins.release_token(
+            self._legacy_pin_token(conn))
+        if freeable:
+            await self._complete_deferred_frees(freeable)
         # Lease-holder death: leases granted to this connection (a
         # driver, or a worker that owned subtasks) are returned now —
         # resources released, still-alive workers back in the idle pool —
@@ -478,6 +510,74 @@ class Raylet:
         avail[node_id].subtract(need)  # so N picks don't dogpile one slot
         return self.cluster_nodes[node_id]["address"]
 
+    async def _locality_spillback(self, spec) -> str | None:
+        """Weigh lease targets by resident plasma-arg bytes from the GCS
+        object directory (reference: lease_policy.h locality-aware lease
+        targeting; extends the h_push_objects_to *hint* into actual
+        placement). Returns the address of a remote node holding at
+        least locality_min_arg_bytes MORE of this task's args than we
+        do, provided its total resources can ever run the task — else
+        None (normal local grant / spillback applies)."""
+        cfg = self.config
+        if (not cfg.locality_aware_leasing or self.gcs is None
+                or len(self.cluster_nodes) <= 1
+                or spec.get("pg_id") is not None):
+            return None
+        arg_ids = [a["id"] for a in spec.get("args") or []
+                   if a.get("kind") == "ref" and a.get("plasma")]
+        if not arg_ids:
+            return None
+        if all(a in self.local_objects for a in arg_ids):
+            # every arg is resident HERE: no remote node can hold more
+            # bytes than us, so skip the directory round trip on the
+            # lease critical path (the steady state once tasks follow
+            # their data)
+            return None
+        key = tuple(arg_ids)
+        now = time.monotonic()
+        if self._locality_negcache.get(key, 0) > now:
+            return None
+        if _fp.ARMED:
+            # locality-targeting seam: `raise` models a failed directory
+            # lookup — placement falls back to the normal local path
+            try:
+                await _fp.fire_async_strict("lease.locality_target")
+            except _fp.FailpointError:
+                return None
+        try:
+            recs = await self.gcs.call("get_object_locations_batch",
+                                       {"object_ids": arg_ids})
+        except Exception:
+            return None
+        by_node: dict[bytes, int] = {}
+        for rec in (recs or {}).values():
+            size = max(1, int(rec.get("size") or 0))
+            for node_id in rec.get("nodes") or []:
+                by_node[node_id] = by_node.get(node_id, 0) + size
+        if not by_node:
+            return None
+        me = self.node_id.binary()
+        need = ResourceSet.from_raw(spec["resources"])
+        best, best_bytes = None, by_node.get(me, 0)
+        for node_id, nbytes in by_node.items():
+            if node_id == me:
+                continue
+            info = self.cluster_nodes.get(node_id)
+            if info is None or not need.is_subset_of(
+                    ResourceSet.from_raw(info["resources"])):
+                continue
+            if nbytes > best_bytes:
+                best, best_bytes = node_id, nbytes
+        if (best is None or best_bytes - by_node.get(me, 0)
+                < cfg.locality_min_arg_bytes):
+            if len(self._locality_negcache) > 1024:
+                self._locality_negcache = {
+                    k: v for k, v in self._locality_negcache.items()
+                    if v > now}
+            self._locality_negcache[key] = now + 2.0
+            return None
+        return self.cluster_nodes[best]["address"]
+
     def _warn_infeasible(self, spec):
         shape = tuple(sorted(spec.get("resources", {}).items()))
         if shape not in self._warned_infeasible:
@@ -533,6 +633,18 @@ class Raylet:
         batched = "count" in d
         count = max(1, int(d.get("count", 1)))
         soft = bool(d.get("soft"))
+        hops = int(d.get("hops", 0))
+        if hops == 0 and not soft:
+            # Locality-aware lease targeting (reference: lease_policy.h):
+            # a task whose plasma args are resident on another node is
+            # leased THERE — moving the task to the data instead of the
+            # data to the task. First hop only, so a redirected request
+            # can still queue/spill on the target without ping-pong.
+            addr = await self._locality_spillback(spec)
+            if addr is not None:
+                self.m_spillbacks.inc()
+                self.m_locality_spillbacks.inc()
+                return {"spillback": addr, "hops": 1}
         tpu = self._needs_tpu(spec)
         grants: list[dict] = []
         while len(grants) < count:
@@ -575,7 +687,6 @@ class Raylet:
             addr = await self._pg_spillback(key)
             if addr is not None:
                 return {"spillback": addr}
-        hops = int(d.get("hops", 0))
         if not self._feasible_ever(spec):
             addr = self._pick_spillback(spec)
             if addr is not None:
@@ -818,6 +929,9 @@ class Raylet:
     async def h_notify_object_sealed(self, conn, d):
         oid = d["object_id"]
         size = d["size"]
+        # a deferral recorded against this id's PREVIOUS incarnation must
+        # not delete the fresh copy when the old transfer's pins drop
+        self.transfer_pins.cancel_deferred_free(oid)
         self.local_objects[oid] = {"size": size, "pinned": True, "spilled": None}
         self.store_used += size
         await self._wake_object_waiters(oid)
@@ -826,11 +940,7 @@ class Raylet:
         # (remote pulls retry until the directory catches up anyway).
         if self.gcs is not None:
             async def _register():
-                try:
-                    await self.gcs.call("add_object_location", {
-                        "object_id": oid, "node_id": self.node_id.binary()})
-                except Exception:
-                    pass
+                await self._register_location(oid, size)
                 try:
                     await self._maybe_spill()
                 except Exception:
@@ -844,12 +954,30 @@ class Raylet:
             await self._maybe_spill()
         return True
 
+    async def _register_location(self, oid: bytes, size: int):
+        """Record this node as a holder of `oid` (with its size) in the
+        GCS object directory — best-effort: remote pulls retry their
+        lookups until the directory catches up."""
+        if self.gcs is None:
+            return
+        try:
+            await self.gcs.call("add_object_location", {
+                "object_id": oid, "node_id": self.node_id.binary(),
+                "size": size})
+        except Exception:
+            pass
+
     async def _wake_object_waiters(self, oid: bytes):
         for fut in self.object_waiters.pop(oid, []):
             if not fut.done():
                 fut.set_result(True)
 
     async def h_wait_object_local(self, conn, d):
+        """Returns True once the object is local, False on `timeout`, or
+        the string \"lost\" when the pull declared typed loss (the GCS
+        directory stayed empty past pull_no_location_timeout_s) — the
+        caller maps \"lost\" onto recovery/ObjectLostError instead of
+        re-probing."""
         oid = d["object_id"]
         timeout = d.get("timeout") or None
         rec = self.local_objects.get(oid)
@@ -862,12 +990,10 @@ class Raylet:
         asyncio.create_task(self._pull_object(oid))
         if timeout:
             try:
-                await asyncio.wait_for(asyncio.shield(fut), timeout)
+                return await asyncio.wait_for(asyncio.shield(fut), timeout)
             except asyncio.TimeoutError:
                 return False
-        else:
-            await fut
-        return True
+        return await fut
 
     @property
     def _pull_sem(self) -> asyncio.Semaphore:
@@ -880,8 +1006,12 @@ class Raylet:
         return self._pull_sem_obj
 
     async def _pull_object(self, oid: bytes, hint_addr: str | None = None):
-        """Pull one object from a remote node (reference: pull_manager.h:26 +
-        object_manager chunked Push). Retries while waiters exist.
+        """Pull one object from remote nodes (reference: pull_manager.h:26
+        admission + object_manager chunked transfer; streaming/striping in
+        raylet/transfer.py). Retries while waiters exist, with exponential
+        backoff between directory lookups; a directory that stays EMPTY
+        past pull_no_location_timeout_s propagates typed loss to the
+        h_wait_object_local waiters instead of spinning forever.
         `hint_addr`: a node known to hold the object (push path) — tried
         immediately with NO GCS location lookup; on failure falls back to
         the normal lookup/retry loop so a concurrent demand waiter
@@ -894,42 +1024,72 @@ class Raylet:
                 try:
                     async with self._pull_sem:
                         if oid not in self.local_objects:
-                            await self._pull_from(oid, hint_addr)
+                            await self._pull_any(oid, [hint_addr])
                     return
                 except Exception as e:
                     logger.warning("hinted pull of %s from %s failed: %s",
                                    oid[:6].hex(), hint_addr, e)
+            empty_since: float | None = None
+            backoff = 0.05
             while oid not in self.local_objects and oid in self.object_waiters:
                 try:
                     locations = await self.gcs.call(
                         "get_object_locations", {"object_id": oid})
                 except Exception:
-                    locations = []
+                    locations = None  # GCS hiccup: not evidence of loss
                 addresses = []
-                for node_id in locations:
+                for node_id in locations or ():
                     if node_id == self.node_id.binary():
                         continue
                     info = self.cluster_nodes.get(node_id)
                     if info is not None:
                         addresses.append(info["address"])
-                pulled = False
-                for address in addresses:
+                if addresses:
+                    empty_since = None
                     try:
                         async with self._pull_sem:
                             if oid in self.local_objects:
-                                pulled = True
                                 break
-                            await self._pull_from(oid, address)
-                        pulled = True
+                            await self._pull_any(oid, addresses)
                         break
                     except Exception as e:
-                        logger.warning("pull of %s from %s failed: %s",
-                                       oid[:6].hex(), address, e)
-                if pulled:
-                    break
-                await asyncio.sleep(0.2)
+                        logger.warning("pull of %s failed: %s",
+                                       oid[:6].hex(), e)
+                elif locations is not None and not locations:
+                    # NOBODY claims a copy. Give the directory a bounded
+                    # window (a seal's registration is async), then fail
+                    # the waiters typed so _read_plasma stops burning
+                    # probe cycles on an object that is simply gone.
+                    now = time.monotonic()
+                    if empty_since is None:
+                        empty_since = now
+                    elif (now - empty_since
+                          > self.config.pull_no_location_timeout_s):
+                        self._fail_object_waiters(oid)
+                        return
+                else:
+                    # copies registered on nodes we can't see (yet), or
+                    # the GCS lookup failed: keep retrying, but don't
+                    # run the loss clock
+                    empty_since = None
+                await asyncio.sleep(backoff)
+                backoff = min(backoff * 2, 2.0)
         finally:
             self._pulls_inflight.discard(oid)
+
+    def _fail_object_waiters(self, oid: bytes):
+        """Typed loss: wake every h_wait_object_local waiter with the
+        \"lost\" sentinel (the owner-side _read_plasma maps it onto its
+        recovery/ObjectLostError path instead of re-probing)."""
+        waiters = self.object_waiters.pop(oid, [])
+        for fut in waiters:
+            if not fut.done():
+                fut.set_result("lost")
+        if waiters:
+            logger.warning(
+                "object %s has no registered location after %.1fs; "
+                "declared lost to %d waiter(s)", oid[:6].hex(),
+                self.config.pull_no_location_timeout_s, len(waiters))
 
     async def _raylet_conn(self, address: str) -> rpc.Connection:
         conn = self._raylet_conns.get(address)
@@ -954,14 +1114,94 @@ class Raylet:
                     asyncio.ensure_future(old.close())
         return conn
 
-    async def _pull_from(self, oid: bytes, address: str):
+    def _use_legacy_pull(self) -> bool:
+        """RAY_TPU_PULL_LEGACY=1 (or set_transfer_mode) re-enables the
+        round-8 stop-and-wait fetch_chunk pull path — the control arm of
+        the cross_node_pull microbenchmark's interleaved A/B."""
+        if self._pull_mode_legacy is not None:
+            return self._pull_mode_legacy
+        return os.environ.get("RAY_TPU_PULL_LEGACY", "") not in ("", "0")
+
+    def _bulk_addr(self, address: str) -> str | None:
+        """Map a peer raylet's control address to its bulk channel
+        (advertised via the GCS node table), preferring the same-node UDS
+        twin. None when the peer predates/disabled the bulk plane."""
+        for info in self.cluster_nodes.values():
+            if info.get("address") == address:
+                bulk = info.get("bulk_address")
+                if not bulk:
+                    return None
+                return rpc.prefer_uds(
+                    bulk, os.path.join(self.session_dir, "sock"),
+                    local_ips=("127.0.0.1", self.config.node_ip_address))
+        return None
+
+    async def _pull_any(self, oid: bytes, addresses: list[str]):
+        """Pull `oid` given candidate holder control addresses: the
+        streaming bulk plane (striped across every source with a bulk
+        channel) by default, the legacy one-source-at-a-time chunked rpc
+        path under RAY_TPU_PULL_LEGACY or when no source serves a bulk
+        channel."""
+        if not self._use_legacy_pull():
+            bulk = [b for b in (self._bulk_addr(a) for a in addresses) if b]
+            if bulk:
+                try:
+                    await self._pull_streaming(oid, bulk)
+                    return
+                except Exception as e:
+                    # advertised-but-unreachable bulk channels (firewalled
+                    # ephemeral port, half-up peer) must degrade to the
+                    # control-path pull for THIS attempt, not hang the
+                    # retry loop on streaming forever
+                    logger.warning(
+                        "streaming pull of %s failed (%s); falling back "
+                        "to the control-path pull", oid[:6].hex(), e)
+        last: Exception | None = None
+        for address in addresses:
+            try:
+                await self._pull_from_legacy(oid, address)
+                return
+            except Exception as e:
+                logger.warning("pull of %s from %s failed: %s",
+                               oid[:6].hex(), address, e)
+                last = e
+        raise last if last is not None else KeyError(
+            f"no source for {oid[:6].hex()}")
+
+    async def _pull_streaming(self, oid: bytes, bulk_addresses: list[str]):
+        """One streaming pull over the bulk data plane, striped across
+        the sources (transfer.streaming_pull) on an executor thread so
+        the raylet loop keeps serving heartbeats/leases."""
+        cfg = self.config
+        object_id = ObjectID(oid)
+        loop = asyncio.get_running_loop()
+        size = await loop.run_in_executor(None, lambda: transfer.streaming_pull(
+            oid, object_id, self.store, bulk_addresses,
+            chunk=cfg.object_transfer_chunk_size,
+            stripe=cfg.object_transfer_stripe_size,
+            max_sources=cfg.max_pull_sources,
+            io_timeout=cfg.bulk_transfer_io_timeout_s))
+        self._pulled_local(oid, size)
+        await self._wake_object_waiters(oid)
+
+    async def _pull_from_legacy(self, oid: bytes, address: str):
+        """Round-8 control arm: one fetch_chunk request-response at a
+        time over the shared raylet<->raylet CONTROL connection — pays a
+        full RTT per chunk, a bytes() copy out of the arena plus a pickle
+        frame per chunk, and head-of-line-blocks control RPCs behind the
+        bulk frames (quantified in PERF.md round 9)."""
         conn = await self._raylet_conn(address)
         info = await conn.call("object_info", {"object_id": oid})
         if info is None:
             raise KeyError("remote no longer has object")
         size = info["size"]
         object_id = ObjectID(oid)
-        buf = self.store.create(object_id, size)
+        try:
+            buf = self.store.create(object_id, size)
+        except FileExistsError:
+            # stale .build from an abandoned pull (files backend)
+            self.store.abort(object_id)
+            buf = self.store.create(object_id, size)
         try:
             offset = 0
             chunk = self.config.object_transfer_chunk_size
@@ -970,6 +1210,7 @@ class Raylet:
                     "object_id": oid, "offset": offset,
                     "size": min(chunk, size - offset)})
                 buf.view[offset : offset + len(data)] = data
+                transfer.M_PULL_BYTES.inc(len(data))
                 offset += len(data)
             buf.close()
             self.store.seal(object_id)
@@ -977,10 +1218,29 @@ class Raylet:
             buf.close()
             self.store.abort(object_id)
             raise
-        self.local_objects[oid] = {"size": size, "pinned": False, "spilled": None}
+        finally:
+            # release the sender-side transfer pin promptly (the shared
+            # control conn never closes, so TTL would otherwise be the
+            # only release)
+            try:
+                await conn.notify("transfer_done", {"object_id": oid})
+            except Exception:
+                pass  # TTL sweep is the backstop
+        self._pulled_local(oid, size)
+        await self._wake_object_waiters(oid)
+
+    def _pulled_local(self, oid: bytes, size: int):
+        """Bookkeeping for a completed pull: the copy is resident here,
+        and the GCS directory learns about it (background — remote
+        lookups retry anyway) so later pulls can stripe across us and
+        locality-aware leasing can weigh this node."""
+        self.transfer_pins.cancel_deferred_free(oid)  # fresh incarnation
+        self.local_objects[oid] = {"size": size, "pinned": False,
+                                   "spilled": None}
         self.store_used += size
         self.m_objects_pulled.inc()
-        await self._wake_object_waiters(oid)
+        if self.gcs is not None:
+            asyncio.create_task(self._register_location(oid, size))
 
     async def h_push_hint(self, conn, d):
         """Proactive transfer start (the PushManager analog, reference:
@@ -1010,27 +1270,80 @@ class Raylet:
                 logger.debug("push hint to %s failed: %s", target, e)
         return True
 
+    def _legacy_pin_token(self, conn):
+        return ("rpc", id(conn))
+
     async def h_object_info(self, conn, d):
-        rec = self.local_objects.get(d["object_id"])
+        """Legacy-path transfer registration: reports size AND takes a
+        transfer pin (TTL-leased, refreshed by each fetch_chunk) so the
+        object can't be freed/evicted between the puller's chunks — the
+        old mid-pull KeyError race."""
+        oid = d["object_id"]
+        if _fp.ARMED:
+            await _fp.fire_async_strict("transfer.register")
+        rec = self.local_objects.get(oid)
         if rec is None:
             return None
         if rec["spilled"]:
-            await self._restore_spilled(d["object_id"])
+            await self._restore_spilled(oid)
+        self.transfer_pins.pin(oid, self._legacy_pin_token(conn),
+                               self.config.transfer_pin_ttl_s)
         return {"size": rec["size"]}
 
     async def h_fetch_chunk(self, conn, d):
-        object_id = ObjectID(d["object_id"])
-        rec = self.local_objects.get(d["object_id"])
+        from ray_tpu import exceptions as exc
+
+        oid = d["object_id"]
+        object_id = ObjectID(oid)
+        rec = self.local_objects.get(oid)
         if rec is not None and rec["spilled"]:
             # spilled between the puller's object_info and this chunk
-            await self._restore_spilled(d["object_id"])
+            await self._restore_spilled(oid)
+        if rec is not None:
+            # refresh the transfer-pin lease for this puller
+            self.transfer_pins.pin(oid, self._legacy_pin_token(conn),
+                                   self.config.transfer_pin_ttl_s)
         buf = self.store.get(object_id)
         if buf is None:
-            raise KeyError(f"object {object_id.hex()[:12]} not local")
+            # typed (a puller fails over to another source / retries the
+            # directory) instead of the old raw KeyError
+            raise exc.ObjectLostError(object_id.hex())
         try:
             return bytes(buf.view[d["offset"] : d["offset"] + d["size"]])
         finally:
             buf.close()
+
+    async def h_transfer_done(self, conn, d):
+        """Legacy puller announces its transfer finished: release the
+        pin NOW instead of waiting out the TTL lease — the raylet<->raylet
+        control connection the pin is keyed to is cached indefinitely, so
+        disconnect-release never fires for this path, and a TTL-only
+        release would block frees/spill of the object for
+        transfer_pin_ttl_s after every pull."""
+        freeable = self.transfer_pins.unpin(d["object_id"],
+                                            self._legacy_pin_token(conn))
+        if freeable:
+            await self._complete_deferred_frees(freeable)
+        return True
+
+    async def h_set_transfer_mode(self, conn, d):
+        """A/B switch for the pull path (microbench + tests): `legacy`
+        True forces the round-8 stop-and-wait fetch_chunk path for this
+        raylet's future pulls, False forces streaming, absent reverts to
+        the RAY_TPU_PULL_LEGACY env default."""
+        self._pull_mode_legacy = (bool(d["legacy"]) if "legacy" in d
+                                  and d["legacy"] is not None else None)
+        return {"legacy": self._use_legacy_pull()}
+
+    async def h_peer_ping(self, conn, d):
+        """Round-trip a ping to `address` over THIS raylet's shared
+        raylet<->raylet CONTROL connection — the one legacy bulk pulls
+        also ride. The cross_node_pull bench uses it to measure
+        control-plane head-of-line blocking during a bulk transfer."""
+        t0 = time.monotonic()
+        peer = await self._raylet_conn(d["address"])
+        await peer.call("ping", {})
+        return time.monotonic() - t0
 
     async def h_get_logs(self, conn, d):
         """Node-local log access — the per-node dashboard-agent role
@@ -1077,7 +1390,7 @@ class Raylet:
         for oid, rec in list(self.local_objects.items()):
             if self.store_used <= limit:
                 break
-            if not rec["spilled"]:
+            if not rec["spilled"] and not self.transfer_pins.pinned(oid):
                 await self._spill_one(oid, rec)
         return True
 
@@ -1088,26 +1401,50 @@ class Raylet:
         return True
 
     async def h_free_objects(self, conn, d):
-        freed = 0
         for oid in d["object_ids"]:
-            rec = self.local_objects.pop(oid, None)
-            if rec is None:
+            # atomic check-and-defer: a registered transfer defers the
+            # free until the last pin drops or its TTL lease lapses (the
+            # _reap_loop sweep completes it); the one-step form cannot
+            # race a concurrent last-unpin into a stranded deferral
+            if self.transfer_pins.defer_free_if_pinned(oid):
                 continue
-            if rec["spilled"]:
-                try:
-                    os.unlink(rec["spilled"])
-                except FileNotFoundError:
-                    pass
-            else:
-                freed += self.store.delete(ObjectID(oid))
-            if self.gcs is not None:
-                try:
-                    await self.gcs.call("remove_object_location", {
-                        "object_id": oid, "node_id": self.node_id.binary()})
-                except Exception:
-                    pass
-        self.store_used = max(0, self.store_used - freed)
+            await self._free_one(oid)
         return True
+
+    async def _free_one(self, oid: bytes):
+        rec = self.local_objects.pop(oid, None)
+        if rec is None:
+            return
+        freed = 0
+        if rec["spilled"]:
+            try:
+                os.unlink(rec["spilled"])
+            except FileNotFoundError:
+                pass
+        else:
+            freed = self.store.delete(ObjectID(oid))
+        self.store_used = max(0, self.store_used - freed)
+        if self.gcs is not None:
+            try:
+                await self.gcs.call("remove_object_location", {
+                    "object_id": oid, "node_id": self.node_id.binary()})
+            except Exception:
+                pass
+
+    async def _complete_deferred_frees(self, oids):
+        for oid in oids:
+            await self._free_one(oid)
+
+    def complete_deferred_frees_threadsafe(self, oids):
+        """Entry point for bulk-channel threads whose connection teardown
+        released the last pin on a free-deferred object."""
+        if self._loop is None or not oids:
+            return
+        try:
+            asyncio.run_coroutine_threadsafe(
+                self._complete_deferred_frees(list(oids)), self._loop)
+        except RuntimeError:
+            pass
 
     async def _maybe_spill(self):
         """Spill cold unpinned objects to disk above the usage threshold
@@ -1132,6 +1469,12 @@ class Raylet:
             # under pressure (local_object_manager.h SpillObjects spills
             # pinned primaries exactly the same way)
             if rec["spilled"]:
+                continue
+            if self.transfer_pins.pinned(oid):
+                # a registered transfer is streaming this object out of
+                # the arena right now: deleting the store entry under it
+                # would abort the stream (and on the files backend orphan
+                # the mmap) — skip until the pin lease lapses
                 continue
             await self._spill_one(oid, rec)
 
@@ -1172,7 +1515,8 @@ class Raylet:
             for other, orec in list(self.local_objects.items()):
                 if self.store_used <= target:
                     break
-                if other != oid and not orec["spilled"]:
+                if (other != oid and not orec["spilled"]
+                        and not self.transfer_pins.pinned(other)):
                     await self._spill_one(other, orec)
             self.store.put_bytes(object_id, data)
         os.unlink(rec["spilled"])
@@ -1225,6 +1569,8 @@ class Raylet:
                                         "value": len(self.local_objects)}
         snap["raylet.pending_leases"] = {"type": "gauge",
                                          "value": len(self.pending_leases)}
+        snap["raylet.transfer_pins"] = {"type": "gauge",
+                                        "value": self.transfer_pins.count()}
         # fold in per-worker process metrics (user-defined metrics from
         # util/metrics.py live in worker processes)
         import asyncio
@@ -1287,6 +1633,16 @@ class Raylet:
             # Same-host drivers attach to this store directly (zero-copy).
             "session_dir": self.session_dir,
             "store_root": self.store_root,
+            "bulk_address": self.bulk_address,
+            # object transfer plane counters (dashboard /api/objects)
+            "transfer": {
+                "pull_bytes_total": transfer.M_PULL_BYTES.snapshot()["value"],
+                "pulls_striped_total":
+                    transfer.M_PULLS_STRIPED.snapshot()["value"],
+                "inflight_chunks":
+                    transfer.M_INFLIGHT_CHUNKS.snapshot()["value"],
+                "transfer_pins": self.transfer_pins.count(),
+            },
         }
 
     # ------------------------------------------------------------------
@@ -1316,6 +1672,14 @@ class Raylet:
                 await self._respill_pending()
             except Exception:
                 logger.exception("pending-lease respill failed")
+            try:
+                # expire transfer-pin leases left by dead pullers and run
+                # the frees they were deferring
+                freeable = self.transfer_pins.sweep()
+                if freeable:
+                    await self._complete_deferred_frees(freeable)
+            except Exception:
+                logger.exception("transfer-pin sweep failed")
 
     async def _respill_pending(self):
         """Queued leases get re-offered to nodes that NOW have capacity
@@ -1400,10 +1764,20 @@ class Raylet:
                         f"(GCS reconnect window)")
 
     async def run(self, port: int = 0, ready_file: str | None = None):
+        self._loop = asyncio.get_running_loop()
         actual = await self.server.start_tcp(
             host=self.config.bind_host, port=port,
             uds_dir=os.path.join(self.session_dir, "sock"))
         self.address = f"{self.config.node_ip_address}:{actual}"
+        try:
+            # bulk object data plane: sibling listener, own threads —
+            # object bytes never touch the control connection again
+            self.bulk_address = self.bulk.start(
+                self.config.bind_host, self.config.node_ip_address,
+                os.path.join(self.session_dir, "sock"))
+        except OSError as e:  # pragma: no cover - bind quirks
+            logger.warning("bulk transfer channel disabled: %s", e)
+            self.bulk_address = ""
 
         async def _gcs_session(conn):
             """(Re-)establish GCS session state: subscribe, refresh the
@@ -1420,6 +1794,7 @@ class Raylet:
             await conn.call("register_node", {
                 "node_id": self.node_id.binary(),
                 "address": self.address,
+                "bulk_address": self.bulk_address,
                 "resources": self.total.raw(),
                 "available": self.available.raw(),
                 "hostname": os.uname().nodename,
