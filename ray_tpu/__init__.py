@@ -23,8 +23,10 @@ from ray_tpu.api import (
     nodes,
     put,
     remote,
+    set_trace_sampling,
     shutdown,
     timeline,
+    trace_spans,
     wait,
 )
 from ray_tpu.object_ref import ObjectRef
@@ -49,7 +51,9 @@ __all__ = [
     "nodes",
     "put",
     "remote",
+    "set_trace_sampling",
     "shutdown",
     "timeline",
+    "trace_spans",
     "wait",
 ]

@@ -118,8 +118,12 @@ def make_task_spec(
     placement_group_id: bytes | None = None,
     bundle_index: int = -1,
     scheduling_strategy: dict | None = None,
+    trace: list | None = None,
 ) -> dict[str, Any]:
-    """TaskSpec as a msgpack-plain dict."""
+    """TaskSpec as a msgpack-plain dict. `trace` is the sampled trace
+    context [trace_id, span_id, parent_span_id, sampled] (tracing.py
+    wire format), set per-call AFTER the cached template copy — absent
+    (None) on the unsampled hot path."""
     return {
         "task_id": task_id,
         "job_id": job_id,
@@ -139,6 +143,7 @@ def make_task_spec(
         "pg_id": placement_group_id,
         "bundle_index": bundle_index,
         "strategy": scheduling_strategy,
+        "trace": trace,
     }
 
 

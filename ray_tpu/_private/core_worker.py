@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import asyncio
 import concurrent.futures
+import contextlib
 import contextvars
 import logging
 import os
@@ -35,6 +36,7 @@ import cloudpickle
 from ray_tpu import exceptions as exc
 from ray_tpu._private import common, global_state, rpc, serialization
 from ray_tpu._private import failpoints as _fp
+from ray_tpu._private import tracing
 from ray_tpu._private.config import Config
 from ray_tpu._private.ids import ActorID, JobID, NodeID, ObjectID, TaskID, WorkerID
 from ray_tpu._private.memstore import IN_PLASMA, MemoryStore
@@ -63,6 +65,26 @@ M_EXEC_HOPS = _stats.Count(
     "core.exec_hops_total", "dispatcher/executor thread handoffs")
 M_LEASE_REQUESTS = _stats.Count(
     "core.lease_requests_total", "worker-lease request RPCs issued")
+
+# Per-hop latency histograms derived from the task path (always on —
+# these, via the raylet's metric merge, are the feed the serve replica
+# autoscaler consumes; trace SPANS ride head sampling, the histograms
+# do not).
+M_QUEUE_WAIT_S = _stats.Histogram(
+    "core.task_queue_wait_s", _stats.LATENCY_BOUNDARIES_S,
+    "submit -> pushed to a leased worker")
+M_LEASE_WAIT_S = _stats.Histogram(
+    "core.task_lease_wait_s", _stats.LATENCY_BOUNDARIES_S,
+    "worker-lease request round trip")
+M_EXEC_S = _stats.Histogram(
+    "core.task_exec_s", _stats.LATENCY_BOUNDARIES_S,
+    "task execution (worker side)")
+M_REPLY_OVERHEAD_S = _stats.Histogram(
+    "core.task_reply_overhead_s", _stats.LATENCY_BOUNDARIES_S,
+    "push round trip minus worker-held time (wire + loop overhead)")
+M_E2E_S = _stats.Histogram(
+    "core.task_e2e_s", _stats.LATENCY_BOUNDARIES_S,
+    "submit -> reply handled (owner side)")
 
 
 def _legacy_task_path() -> bool:
@@ -219,6 +241,8 @@ class CoreWorker:
 
         self._profile = ProfileBuffer(component_type=mode)
         self._last_profile_flush = 0.0
+        # Trace spans (tracing.py) share this buffer/flush pipeline.
+        tracing.bind_buffer(self._profile)
 
         # connections
         self.raylet: rpc.Connection | None = None
@@ -301,6 +325,10 @@ class CoreWorker:
                 armed = await conn.call("kv_get", {"key": _fp.KV_KEY})
                 if armed is not None:
                     _fp.apply_kv_value(armed)
+                await conn.call("subscribe", {"channel": tracing.CHANNEL})
+                rate = await conn.call("kv_get", {"key": tracing.KV_KEY})
+                if rate is not None:
+                    tracing.apply_kv_value(rate)
                 if self.mode == DRIVER:
                     await conn.call("subscribe",
                                     {"channel": "worker_logs"})
@@ -332,6 +360,13 @@ class CoreWorker:
             armed = await self.gcs.call("kv_get", {"key": _fp.KV_KEY})
             if armed:
                 _fp.apply_kv_value(armed)
+            # Live trace-sampling override: same KV+pubsub plane as the
+            # failpoints, so a process spawned after the override picks
+            # it up here.
+            await self.gcs.call("subscribe", {"channel": tracing.CHANNEL})
+            rate = await self.gcs.call("kv_get", {"key": tracing.KV_KEY})
+            if rate:
+                tracing.apply_kv_value(rate)
             # Duplex: the raylet sends actor-creation/kill requests back
             # over this same connection. A worker cannot function without
             # its raylet — it dies with it (reference: worker exits when
@@ -947,10 +982,18 @@ class CoreWorker:
                 placement_group_id=placement_group,
                 bundle_index=bundle_index,
             )
+        # Trace entry point: continues an ambient trace (nested submit
+        # from a traced task) or head-samples a fresh root. The sampled
+        # wire context travels IN the spec through lease request ->
+        # raylet -> worker exec (tracing.py).
+        ctx = tracing.maybe_trace()
+        if ctx is not None:
+            spec["trace"] = tracing.to_wire(ctx)
         refs = self._make_return_refs(task_id, num_returns)
         self.submitted[task_id.binary()] = {
             "spec": spec, "pinned": pinned,
             "retries": spec["max_retries"], "cancelled": False,
+            "t0": time.time(), "trace": ctx,
         }
         M_TASKS_SUBMITTED.inc()
         self._io.submit_nowait(self._submit_async(spec))
@@ -1029,6 +1072,7 @@ class CoreWorker:
 
     async def _request_leases(self, key, spec, count: int, soft: bool):
         M_LEASE_REQUESTS.inc()
+        lease_t0 = time.time()
         try:
             if _fp.ARMED:
                 # lease-request seam: `raise` exercises the typed failure
@@ -1057,6 +1101,15 @@ class CoreWorker:
                                task_conn=await self._task_channel_conn(
                                    grant.get("task_channel")))
                 self.leases.setdefault(key, []).append(lease)
+            if grants:
+                now = time.time()
+                M_LEASE_WAIT_S.observe(now - lease_t0)
+                root = tracing.from_wire(spec.get("trace"))
+                if root is not None:
+                    tracing.record_span("task.lease_wait", lease_t0, now,
+                                        tracing.child(root),
+                                        {"name": spec.get("name", "?"),
+                                         "count": len(grants)})
             if not grants:
                 # soft miss: the idle pool is dry; stop re-asking for a
                 # beat so the raylet isn't hammered with no-op requests.
@@ -1200,6 +1253,20 @@ class CoreWorker:
                 self._cache_peer(address, conn)
         return conn
 
+    def _note_pushed(self, rec, spec):
+        """Queue-wait hop closes when the push leaves the owner: observe
+        the histogram always, record the span when the task is traced."""
+        now = time.time()
+        t0 = rec.get("t0")
+        if t0 is not None and "t_push" not in rec:
+            M_QUEUE_WAIT_S.observe(now - t0)
+            ctx = rec.get("trace")
+            if ctx is not None:
+                tracing.record_span("task.queue_wait", t0, now,
+                                    tracing.child(ctx),
+                                    {"name": spec.get("name", "?")})
+        rec["t_push"] = now
+
     async def _push_to_lease(self, lease: _Lease, spec, key):
         rec = self.submitted.get(spec["task_id"])
         if rec is None or rec["cancelled"]:
@@ -1207,6 +1274,7 @@ class CoreWorker:
             self._fail_task(spec, exc.TaskCancelledError(""), release=True)
             return
         rec["lease"] = lease
+        self._note_pushed(rec, spec)
         try:
             reply = await lease.push_conn.call("push_task", {"spec": spec})
             self._handle_task_reply(spec, reply)
@@ -1336,6 +1404,25 @@ class CoreWorker:
         task_id = spec["task_id"]
         rec = self.submitted.pop(task_id, None)
         M_TASKS_COMPLETED.inc()
+        if rec is not None:
+            now = time.time()
+            t0 = rec.get("t0")
+            if t0 is not None:
+                M_E2E_S.observe(now - t0)
+            t_push = rec.get("t_push")
+            held_s = (reply.get("held_s", reply.get("exec_s"))
+                      if isinstance(reply, dict) else None)
+            if t_push is not None and held_s is not None:
+                # durations only — clock-skew-free wire+loop overhead.
+                # held_s (not exec_s): worker-side queueing behind other
+                # in-flight pushes must not read as reply overhead.
+                M_REPLY_OVERHEAD_S.observe(max(0.0, now - t_push - held_s))
+            ctx = rec.get("trace")
+            if ctx is not None and t0 is not None:
+                # the ROOT span of this task's tree (children: queue_wait,
+                # lease_wait, raylet.lease, worker-side exec)
+                tracing.record_span("task.e2e", t0, now, ctx,
+                                    {"name": spec.get("name", "?")})
         if rec is not None and rec["pinned"]:
             self._release_pins(rec["pinned"])
         # Lineage shared by all plasma returns of this task: enough to
@@ -1471,6 +1558,10 @@ class CoreWorker:
         if not events or self.gcs is None:
             return
         try:
+            if _fp.ARMED:
+                # flush seam: `raise` models an unreachable GCS — the
+                # drained batch must requeue (bounded), never vanish
+                _fp.fire_strict("trace.flush")
             await self.gcs.notify("add_profile_events", {
                 "component_type": self._profile.component_type,
                 "component_id": self._profile.component_id,
@@ -1479,16 +1570,40 @@ class CoreWorker:
                 "events": events,
             })
         except Exception:
-            pass
+            # GCS unreachable: keep the batch for the next flush cycle.
+            # The deque bound caps memory; overflow is counted in
+            # profiling.events_dropped_total instead of lost silently.
+            self._profile.requeue(events)
+
+    async def _push_metrics_now(self):
+        """Push this process's metric snapshot to the GCS time-series
+        ring (heartbeat-piggyback analog for workers/drivers, which
+        don't heartbeat — they ride the profile flush cadence)."""
+        if self.gcs is None or self.node_id is None or self._shutdown:
+            return
+        try:
+            if _fp.ARMED:
+                _fp.fire_strict("metrics.push")
+            from ray_tpu._private import stats
+
+            await self.gcs.notify("push_metrics", {
+                "source": (f"{self.node_id.hex()[:8]}/"
+                           f"{self.mode}-{os.getpid()}"),
+                "metrics": stats.snapshot(),
+            })
+        except Exception:
+            pass  # history just misses a sample; next tick retries
 
     async def _profile_flush_loop(self):
         """Batch-push recorded spans to the GCS profile table (reference:
         profiling.h Profiler flush thread). The periodic tick is the
         fallback; task completion schedules an immediate flush so
-        timeline() right after a run sees the tail."""
+        timeline() right after a run sees the tail. Also the metrics-
+        history push cadence for this process."""
         while not self._shutdown:
             await asyncio.sleep(2.0)
             await self._flush_profile_now(force=True)
+            await self._push_metrics_now()
 
     def get_cluster_events(self, severity: str | None = None) -> list[dict]:
         """Structured events ring from the GCS (RAY_EVENT analog)."""
@@ -1498,6 +1613,18 @@ class CoreWorker:
     def get_profile_events(self) -> list[dict]:
         """All profile batches recorded cluster-wide (driver surface)."""
         return self._io.run(self.gcs.call("get_profile_events", {}))
+
+    def get_trace_spans(self, trace_id: str | None = None) -> list[dict]:
+        """Span batches from the GCS trace table, optionally filtered to
+        one trace (hex trace id)."""
+        return self._io.run(self.gcs.call(
+            "get_trace_spans", {"trace_id": trace_id}))
+
+    def get_metrics_history(self, samples: int = 0) -> dict:
+        """Per-source metric time series from the GCS ring buffers:
+        {source: {metric: [[ts, value], ...]}}."""
+        return self._io.run(self.gcs.call(
+            "get_metrics_history", {"samples": samples}))
 
     def set_resource(self, resource_name: str, capacity: float,
                      node_id: bytes | None = None):
@@ -1548,6 +1675,9 @@ class CoreWorker:
     async def _on_gcs_push(self, channel: str, data):
         if channel == _fp.CHANNEL:
             _fp.apply_kv_value(data)
+            return
+        if channel == tracing.CHANNEL:
+            tracing.apply_kv_value(data)
             return
         if channel.startswith("actor:"):
             self._apply_actor_update(data)
@@ -1632,9 +1762,13 @@ class CoreWorker:
                 args=descs,
                 num_returns=num_returns,
             )
+        ctx = tracing.maybe_trace()
+        if ctx is not None:
+            spec["trace"] = tracing.to_wire(ctx)
         refs = self._make_return_refs(task_id, num_returns)
         self.submitted[task_id.binary()] = {
-            "spec": spec, "pinned": pinned, "retries": 0, "cancelled": False}
+            "spec": spec, "pinned": pinned, "retries": 0,
+            "cancelled": False, "t0": time.time(), "trace": ctx}
 
         # seq_no is assigned at push time (not here) so a restarted actor —
         # whose reorder buffer starts from 0 again — sees a contiguous
@@ -1757,6 +1891,9 @@ class CoreWorker:
         client.inflight += 1
         if conn is None or conn.closed or not client.burst_channel:
             conn = client.conn
+        rec = self.submitted.get(spec["task_id"])
+        if rec is not None:
+            self._note_pushed(rec, spec)
         try:
             if conn is None or conn.closed:
                 # a sibling push's failure handler nulled the conns (the
@@ -1878,6 +2015,7 @@ class CoreWorker:
         which connection delivered them). Safe from the io loop AND from
         a task-channel thread: reorder state is per-caller and each
         caller pushes over exactly one path."""
+        spec.setdefault("_arrived", time.time())
         caller = spec["owner_worker_id"]
         epoch = spec.get("caller_epoch", 0)
         state = self._actor_reorder.get(caller)
@@ -1937,6 +2075,11 @@ class CoreWorker:
         return complete
 
     def _dispatch_exec(self, spec, complete):
+        # Worker-side arrival stamp (_exec_scope pops it): held_s in the
+        # reply spans arrival -> reply built, so the owner's reply-
+        # overhead histogram excludes dispatcher/arg-wait queueing even
+        # with many pushes in flight on one lease.
+        spec.setdefault("_arrived", time.time())
         if spec["type"] == common.NORMAL_TASK:
             # Resolve ref args BEFORE entering the execution lane
             # (reference: dependencies are made local before dispatch).
@@ -2263,21 +2406,60 @@ class CoreWorker:
         """
         token = _ASYNC_TASK_ID.set(TaskID(spec["task_id"]))
         try:
-            result = await method(*args, **kwargs)
-            return self._pack_returns(spec, result)
-        except BaseException as e:
-            if isinstance(e, (SystemExit, KeyboardInterrupt)):
-                raise
-            error = exc.TaskError(type(e).__name__, repr(e),
-                                  traceback.format_exc())
-            return self._pack_error(spec, error)
+            with self._exec_scope(spec) as scope:
+                try:
+                    result = await method(*args, **kwargs)
+                    reply = self._pack_returns(spec, result)
+                except BaseException as e:
+                    if isinstance(e, (SystemExit, KeyboardInterrupt)):
+                        raise
+                    error = exc.TaskError(type(e).__name__, repr(e),
+                                          traceback.format_exc())
+                    reply = self._pack_error(spec, error)
         finally:
             _ASYNC_TASK_ID.reset(token)
             self._cancelled_tasks.discard(spec["task_id"])
+        reply["exec_s"] = scope["exec_s"]
+        reply["held_s"] = scope["held_s"]
+        return reply
+
+    @contextlib.contextmanager
+    def _exec_scope(self, spec):
+        """Exec span + timing shared by the sync and async execution
+        paths. The span is the unconditional per-task profile event
+        (pre-trace behavior), upgraded to a trace-tree node when the
+        spec carries a sampled context — AMBIENT during execution so
+        anything the task submits joins the same tree. Fills
+        scope["exec_s"] (user code only) and scope["held_s"] (worker
+        arrival -> reply built, one clock — what the owner subtracts
+        from the push round trip so dispatcher queueing under pipelined
+        pushes never counts as reply-wire overhead)."""
+        sender = tracing.from_wire(spec.get("trace"))
+        exec_ctx = tracing.child(sender) if sender is not None else None
+        token = tracing.push(exec_ctx)
+        arrived = spec.pop("_arrived", None)
+        start = time.time()
+        scope = {}
+        try:
+            yield scope
+        finally:
+            end = time.time()
+            tracing.pop(token)
+            tracing.record_span("task", start, end, exec_ctx,
+                                {"name": spec.get("name", "?")})
+            M_EXEC_S.observe(end - start)
+            scope["exec_s"] = end - start
+            scope["held_s"] = end - (arrived if arrived is not None
+                                     else start)
 
     def _execute_task(self, spec) -> dict:
-        with self._profile.profile("task", {"name": spec.get("name", "?")}):
+        with self._exec_scope(spec) as scope:
             reply = self._execute_task_inner(spec)
+        if isinstance(reply, dict):
+            # lets the owner derive the reply-hop overhead from the push
+            # round trip without comparing cross-process clocks
+            reply["exec_s"] = scope["exec_s"]
+            reply["held_s"] = scope["held_s"]
         # a cancel that raced this execution leaves a marker nothing else
         # will ever consume — drop it so the set stays bounded
         self._cancelled_tasks.discard(spec["task_id"])

@@ -4,7 +4,9 @@ profile table; python/ray/state.py:946 timeline() chrome-trace export).
 
 Workers record spans into a bounded local buffer; the core worker flushes
 batches to the GCS, and `ray_tpu.timeline()` renders everything as a
-chrome://tracing / Perfetto JSON document."""
+chrome://tracing / Perfetto JSON document. Events carrying trace ids
+(tracing.py `tid`/`sid`/`psid` extra fields) additionally land in the
+GCS trace table and export with cross-process flow arrows."""
 
 from __future__ import annotations
 
@@ -12,6 +14,15 @@ import collections
 import os
 import threading
 import time
+
+from ray_tpu._private import stats as _stats
+
+# Flush failures (GCS unreachable) requeue drained events locally; only
+# events evicted by the deque bound are actually lost — and counted here
+# instead of disappearing invisibly.
+M_EVENTS_DROPPED = _stats.Count(
+    "profiling.events_dropped_total",
+    "profile/trace events dropped by the local buffer bound")
 
 
 class ProfileBuffer:
@@ -24,6 +35,8 @@ class ProfileBuffer:
     def record(self, event_type: str, start: float, end: float,
                extra: dict | None = None):
         with self._lock:
+            if len(self._events) == self._events.maxlen:
+                M_EVENTS_DROPPED.inc()
             self._events.append({
                 "event_type": event_type,
                 "start_time": start,
@@ -36,6 +49,26 @@ class ProfileBuffer:
             out = list(self._events)
             self._events.clear()
         return out
+
+    def requeue(self, events: list[dict]) -> int:
+        """Put drained-but-unflushed events back at the FRONT (a failed
+        GCS flush retries them on the next cycle). Keeps the newest
+        events when they no longer all fit; returns how many were
+        dropped (also counted in profiling.events_dropped_total)."""
+        if not events:
+            return 0
+        with self._lock:
+            space = self._events.maxlen - len(self._events)
+            dropped = max(0, len(events) - space)
+            if dropped:
+                M_EVENTS_DROPPED.inc(dropped)
+                events = events[dropped:]
+            self._events.extendleft(reversed(events))
+        return dropped
+
+    def __len__(self):
+        with self._lock:
+            return len(self._events)
 
     def profile(self, event_type: str, extra: dict | None = None):
         return _Span(self, event_type, extra)
@@ -57,22 +90,70 @@ class _Span:
         return False
 
 
-def to_chrome_trace(events: list[dict]) -> list[dict]:
+def to_chrome_trace(events: list[dict], flow: bool = True) -> list[dict]:
     """GCS profile-table rows -> chrome-trace 'X' (complete) events
-    (reference: state.py:946 timeline)."""
+    (reference: state.py:946 timeline). Span events (tracing.py: extra
+    `sid`/`psid`) additionally get flow arrows ('s'/'f' pairs keyed by
+    the child span id) so Perfetto draws the cross-process tree."""
     trace = []
+    by_sid: dict[str, dict] = {}
     for batch in events:
         pid = f"{batch['component_type']} {batch.get('node_id', b'').hex()[:8] if isinstance(batch.get('node_id'), bytes) else ''}".strip()
         for ev in batch["events"]:
-            trace.append({
+            extra = ev.get("extra_data", {})
+            tev = {
                 "cat": ev["event_type"],
-                "name": ev.get("extra_data", {}).get(
-                    "name", ev["event_type"]),
+                "name": extra.get("name", ev["event_type"]),
                 "ph": "X",
                 "ts": ev["start_time"] * 1e6,
                 "dur": (ev["end_time"] - ev["start_time"]) * 1e6,
                 "pid": pid,
                 "tid": batch["component_id"],
-                "args": ev.get("extra_data", {}),
-            })
+                "args": extra,
+            }
+            trace.append(tev)
+            sid = extra.get("sid")
+            if sid:
+                by_sid[sid] = tev
+    if flow:
+        links = []
+        for tev in trace:
+            sid = tev["args"].get("sid")
+            parent = by_sid.get(tev["args"].get("psid", ""))
+            if not sid or parent is None or parent is tev:
+                continue
+            # anchor the flow start inside the parent slice (chrome
+            # binds flow events to the enclosing slice by timestamp)
+            start_ts = min(max(tev["ts"], parent["ts"]),
+                           parent["ts"] + parent["dur"])
+            links.append({"ph": "s", "cat": "trace", "name": "span",
+                          "id": sid, "pid": parent["pid"],
+                          "tid": parent["tid"], "ts": start_ts})
+            links.append({"ph": "f", "bp": "e", "cat": "trace",
+                          "name": "span", "id": sid, "pid": tev["pid"],
+                          "tid": tev["tid"], "ts": tev["ts"]})
+        trace.extend(links)
     return trace
+
+
+def spans_to_chrome_trace(rows: list[dict], flow: bool = True) -> list[dict]:
+    """Flat GCS trace-TABLE rows (get_trace_spans) -> chrome-trace JSON:
+    regroups rows into per-process pseudo-batches and reuses
+    to_chrome_trace, so `ray-tpu trace` / `/api/trace` render one
+    trace's cross-process tree with the same flow arrows as the full
+    timeline."""
+    batches: dict[tuple, dict] = {}
+    for r in rows:
+        nid = r.get("node_id")
+        key = (r["component_type"], r["component_id"],
+               nid if isinstance(nid, bytes) else b"")
+        b = batches.get(key)
+        if b is None:
+            b = batches[key] = {"component_type": r["component_type"],
+                                "component_id": r["component_id"],
+                                "node_id": nid, "events": []}
+        b["events"].append({"event_type": r["event_type"],
+                            "start_time": r["start_time"],
+                            "end_time": r["end_time"],
+                            "extra_data": r.get("extra_data", {})})
+    return to_chrome_trace(list(batches.values()), flow=flow)

@@ -33,7 +33,8 @@ class Count(Metric):
             self._value += by
 
     def snapshot(self):
-        return {"type": "count", "value": self._value}
+        with self._lock:
+            return {"type": "count", "value": self._value}
 
 
 class Gauge(Metric):
@@ -45,7 +46,10 @@ class Gauge(Metric):
         super().__init__(name, description)
 
     def set(self, value: float):
-        self._value = float(value)
+        # Locked: an unlocked float store racing add() could be lost OR
+        # land mid-read of a snapshot (set/add/snapshot all serialize).
+        with self._lock:
+            self._value = float(value)
 
     def add(self, delta: float):
         """Thread-safe relative update — for gauges tracking a live count
@@ -55,7 +59,8 @@ class Gauge(Metric):
             self._value += delta
 
     def snapshot(self):
-        return {"type": "gauge", "value": self._value}
+        with self._lock:
+            return {"type": "gauge", "value": self._value}
 
 
 class Histogram(Metric):
@@ -77,9 +82,12 @@ class Histogram(Metric):
             self._n += 1
 
     def snapshot(self):
-        return {"type": "histogram", "boundaries": self.boundaries,
-                "counts": list(self._counts), "sum": self._sum,
-                "count": self._n}
+        # Locked: without it a snapshot can read a torn (counts, sum, n)
+        # triple while observe() is mid-update on another thread.
+        with self._lock:
+            return {"type": "histogram", "boundaries": self.boundaries,
+                    "counts": list(self._counts), "sum": self._sum,
+                    "count": self._n}
 
 
 class Registry:
@@ -108,3 +116,33 @@ def registry() -> Registry:
 
 def snapshot() -> dict:
     return _REGISTRY.snapshot()
+
+
+# Log-spaced seconds boundaries shared by the per-hop latency histograms
+# (task queue-wait/lease/exec/reply/e2e, serve router queue/e2e).
+LATENCY_BOUNDARIES_S = [0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                        0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0]
+
+
+def percentile(hist_snapshot: dict, q: float) -> float:
+    """Estimate the q-quantile (0..1) from a histogram SNAPSHOT — the
+    upper boundary of the bucket containing the quantile (how the serve
+    autoscaler reads router p99 from cluster_metrics()). Quantiles
+    landing in the unbounded overflow bucket CLAMP to the top boundary
+    (Prometheus histogram_quantile convention; inf would not survive
+    the JSON surfaces) — a reading AT the top boundary means "at least
+    this", and consumers watching for saturation should pair it with
+    the .count rate."""
+    counts = hist_snapshot.get("counts") or []
+    boundaries = hist_snapshot.get("boundaries") or []
+    total = hist_snapshot.get("count", 0)
+    if not total or not counts:
+        return 0.0
+    target = q * total
+    acc = 0
+    for i, c in enumerate(counts):
+        acc += c
+        if acc >= target:
+            return (boundaries[i] if i < len(boundaries)
+                    else boundaries[-1] if boundaries else 0.0)
+    return boundaries[-1] if boundaries else 0.0

@@ -1,0 +1,222 @@
+"""Distributed tracing — causally-linked spans across every hop.
+
+A compact trace context `(trace_id, span_id, parent_span_id, sampled)`
+is minted at every entry point (driver `.remote()`, Serve HTTP ingress,
+collective op, bulk object pull) and threaded through the existing
+seams: task spec -> lease request -> raylet grant -> worker exec ->
+reply, router -> replica, pull request -> chunk stream. Spans record
+into the process's bounded ProfileBuffer (profiling.py) alongside plain
+profile events, flush in batches to the GCS (profile table + trace
+table), and export as Perfetto/chrome-trace JSON with cross-process
+flow arrows (reference analog: the OpenTelemetry tracing hooks in
+python/ray/util/tracing — here head-sampled and zero-dependency).
+
+Head sampling: `RAY_TPU_TRACE_SAMPLE` (default 1%) at process start, or
+live cluster-wide via `ray_tpu.set_trace_sampling(rate)` — the rate
+rides the internal KV (KV_KEY) + pubsub (CHANNEL), exactly like the
+failpoints arming plane. Propagated contexts are always honored: the
+sampling decision is made once, at the trace root.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import os
+import random
+import threading
+
+KV_KEY = "ray_tpu:trace_sample"
+CHANNEL = "trace_config"
+
+_DEFAULT_RATE = 0.01
+
+
+def _env_rate() -> float:
+    raw = os.environ.get("RAY_TPU_TRACE_SAMPLE", "")
+    if not raw:
+        return _DEFAULT_RATE
+    try:
+        return min(1.0, max(0.0, float(raw)))
+    except ValueError:
+        return _DEFAULT_RATE
+
+
+_rate = _env_rate()
+_rng = random.Random()
+_lock = threading.Lock()
+_buffer = None  # ProfileBuffer this process records spans into
+
+# Ambient context: set around task execution / request handling so any
+# nested entry point (a task submitted from inside a traced task, a
+# collective op inside a traced replica call) joins the same tree.
+_CTX: contextvars.ContextVar = contextvars.ContextVar(
+    "ray_tpu_trace_ctx", default=None)
+
+
+class TraceContext:
+    """One node of a trace tree. Only sampled contexts exist — an
+    unsampled entry point yields None everywhere, so the unsampled hot
+    path carries no per-call state at all."""
+
+    __slots__ = ("trace_id", "span_id", "parent_id")
+
+    def __init__(self, trace_id: bytes, span_id: bytes,
+                 parent_id: bytes | None = None):
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+
+    def __repr__(self):
+        return (f"TraceContext({self.trace_id.hex()}, {self.span_id.hex()},"
+                f" parent={self.parent_id.hex() if self.parent_id else None})")
+
+
+def sample_rate() -> float:
+    return _rate
+
+
+def set_sample_rate(rate: float) -> None:
+    global _rate
+    _rate = min(1.0, max(0.0, float(rate)))
+
+
+def apply_kv_value(value) -> None:
+    """Apply a live override published through the GCS KV/pubsub (the
+    value is the rate as a string, e.g. b"1.0")."""
+    if value is None:
+        return
+    if isinstance(value, bytes):
+        value = value.decode(errors="replace")
+    try:
+        set_sample_rate(float(value))
+    except (TypeError, ValueError):
+        pass
+
+
+def bind_buffer(buffer) -> None:
+    """Bind this process's ProfileBuffer (core worker / raylet call this
+    at startup) so spans land in the same flush pipeline as profile
+    events."""
+    global _buffer
+    _buffer = buffer
+
+
+def _get_buffer():
+    global _buffer
+    if _buffer is None:
+        with _lock:
+            if _buffer is None:
+                from ray_tpu._private import failpoints as _fp
+                from ray_tpu._private.profiling import ProfileBuffer
+
+                _buffer = ProfileBuffer(_fp.get_role() or "process")
+    return _buffer
+
+
+def new_context() -> TraceContext:
+    """Fresh root context (unconditional — callers wanting head sampling
+    use maybe_trace)."""
+    return TraceContext(os.urandom(8), os.urandom(8))
+
+
+def child(ctx: TraceContext) -> TraceContext:
+    return TraceContext(ctx.trace_id, os.urandom(8), ctx.span_id)
+
+
+def maybe_trace() -> TraceContext | None:
+    """Entry-point mint: continue the ambient trace when one is active
+    (nested submit, traced request handler), else head-sample a fresh
+    root at the current rate. Returns None when not sampled."""
+    cur = _CTX.get()
+    if cur is not None:
+        return child(cur)
+    if _rate <= 0.0 or _rng.random() >= _rate:
+        return None
+    return new_context()
+
+
+# --- wire format -----------------------------------------------------------
+# msgpack-plain [trace_id, span_id, parent_span_id, sampled]: span_id is
+# the SENDER's span — the receiver records its spans as children of it.
+
+def to_wire(ctx: TraceContext) -> list:
+    return [ctx.trace_id, ctx.span_id, ctx.parent_id or b"", 1]
+
+
+def from_wire(wire) -> TraceContext | None:
+    if not wire:
+        return None
+    try:
+        trace_id, span_id, parent, sampled = wire
+    except (TypeError, ValueError):
+        return None
+    if not sampled:
+        return None
+    return TraceContext(bytes(trace_id), bytes(span_id),
+                        bytes(parent) or None)
+
+
+# --- ambient context -------------------------------------------------------
+
+def current() -> TraceContext | None:
+    return _CTX.get()
+
+
+def push(ctx: TraceContext | None):
+    """Set the ambient context (even to None — execution scopes shadow
+    any caller-thread leftovers); returns the reset token."""
+    return _CTX.set(ctx)
+
+
+def pop(token) -> None:
+    try:
+        _CTX.reset(token)
+    except ValueError:
+        pass  # token from another context (executor-pool reuse)
+
+
+@contextlib.contextmanager
+def use(ctx: TraceContext | None):
+    token = _CTX.set(ctx)
+    try:
+        yield ctx
+    finally:
+        pop(token)
+
+
+# --- span recording --------------------------------------------------------
+
+def record_span(name: str, start: float, end: float,
+                ctx: TraceContext | None, extra: dict | None = None) -> None:
+    """Record one span into the bound ProfileBuffer. With ctx=None this
+    degrades to a plain profile event (no trace linkage) — used by the
+    unconditional task-execution event."""
+    fields = dict(extra) if extra else {}
+    if ctx is not None:
+        fields["tid"] = ctx.trace_id.hex()
+        fields["sid"] = ctx.span_id.hex()
+        if ctx.parent_id:
+            fields["psid"] = ctx.parent_id.hex()
+    _get_buffer().record(name, start, end, fields)
+
+
+@contextlib.contextmanager
+def span(name: str, ctx: TraceContext | None, extra: dict | None = None,
+         ambient: bool = False):
+    """Context manager recording `name` over the with-block when ctx is
+    not None; `ambient=True` additionally makes ctx the current context
+    inside the block (so nested entry points join the tree)."""
+    import time
+
+    if ctx is None:
+        yield None
+        return
+    token = _CTX.set(ctx) if ambient else None
+    start = time.time()
+    try:
+        yield ctx
+    finally:
+        record_span(name, start, time.time(), ctx, extra)
+        if token is not None:
+            pop(token)

@@ -176,10 +176,42 @@ def cluster_events(severity: str | None = None) -> list[dict]:
     return global_state.require_core_worker().get_cluster_events(severity)
 
 
-def cluster_metrics() -> dict:
+def cluster_metrics(history: int | None = None) -> dict:
     """Metric snapshots from the GCS and every raylet (reference:
-    src/ray/stats/metric.h export surface)."""
-    return global_state.require_core_worker().get_cluster_metrics()
+    src/ray/stats/metric.h export surface).
+
+    With `history=N`, returns the GCS metrics time-series instead:
+    `{source: {metric: [[ts, value], ...]}}` with up to the last N
+    timestamped samples per metric (N<=0 for the full retained ring).
+    Sources are `<node>/raylet` (heartbeat-piggybacked) and
+    `<node>/<mode>-<pid>` per worker/driver (pushed on the ~2s profile
+    flush cadence); histograms appear as `.count`/`.sum`/`.p99` scalar
+    series — the serve autoscaler's feed."""
+    cw = global_state.require_core_worker()
+    if history is not None:
+        return cw.get_metrics_history(samples=history)
+    return cw.get_cluster_metrics()
+
+
+def trace_spans(trace_id: str | None = None) -> list[dict]:
+    """Flat span rows from the GCS trace table (tracing.py), optionally
+    filtered to one trace (hex trace id). Each row carries the emitting
+    process (`component_type`/`component_id`/`node_id`) and the span's
+    `tid`/`sid`/`psid` linkage in `extra_data`."""
+    return global_state.require_core_worker().get_trace_spans(trace_id)
+
+
+def set_trace_sampling(rate: float) -> None:
+    """Set the head-sampling rate for distributed tracing cluster-wide,
+    live (0.0 disables new roots, 1.0 traces everything; default is
+    `RAY_TPU_TRACE_SAMPLE`, ~1%). Rides the internal KV + pubsub plane,
+    so every connected process — and any spawned later — picks it up."""
+    from ray_tpu._private import tracing
+
+    rate = min(1.0, max(0.0, float(rate)))
+    cw = global_state.require_core_worker()
+    cw.kv_put(tracing.KV_KEY, repr(rate).encode())
+    tracing.set_sample_rate(rate)  # local apply; push also lands
 
 
 def remote(*args, **kwargs):

@@ -149,36 +149,63 @@ def _as_numpy(tensor) -> np.ndarray:
     return np.asarray(tensor)
 
 
+def _traced_op(name: str, group_name: str, fn, nbytes: int | None = None):
+    """Collective trace entry point (tracing.py): continues an ambient
+    trace (op inside a traced task/replica call) or head-samples a fresh
+    root, recording one `collective.<op>` span over the op."""
+    from ray_tpu._private import tracing
+
+    ctx = tracing.maybe_trace()
+    if ctx is None:
+        return fn()
+    extra = {"group": group_name}
+    if nbytes is not None:
+        extra["bytes"] = nbytes
+    with tracing.span(name, ctx, extra, ambient=True):
+        return fn()
+
+
 def allreduce(tensor, group_name: str = "default",
               op: ReduceOp = ReduceOp.SUM):
     group = _manager.get_group(group_name)
-    return group.allreduce(_as_numpy(tensor), op)
+    t = _as_numpy(tensor)
+    return _traced_op("collective.allreduce", group_name,
+                      lambda: group.allreduce(t, op), t.nbytes)
 
 
 def reduce(tensor, dst_rank: int = 0, group_name: str = "default",
            op: ReduceOp = ReduceOp.SUM):
     group = _manager.get_group(group_name)
-    return group.reduce(_as_numpy(tensor), dst_rank, op)
+    t = _as_numpy(tensor)
+    return _traced_op("collective.reduce", group_name,
+                      lambda: group.reduce(t, dst_rank, op), t.nbytes)
 
 
 def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
     group = _manager.get_group(group_name)
-    return group.broadcast(_as_numpy(tensor), src_rank)
+    t = _as_numpy(tensor)
+    return _traced_op("collective.broadcast", group_name,
+                      lambda: group.broadcast(t, src_rank), t.nbytes)
 
 
 def allgather(tensor, group_name: str = "default"):
     group = _manager.get_group(group_name)
-    return group.allgather(_as_numpy(tensor))
+    t = _as_numpy(tensor)
+    return _traced_op("collective.allgather", group_name,
+                      lambda: group.allgather(t), t.nbytes)
 
 
 def reducescatter(tensor, group_name: str = "default",
                   op: ReduceOp = ReduceOp.SUM):
     group = _manager.get_group(group_name)
-    return group.reducescatter(_as_numpy(tensor), op)
+    t = _as_numpy(tensor)
+    return _traced_op("collective.reducescatter", group_name,
+                      lambda: group.reducescatter(t, op), t.nbytes)
 
 
 def barrier(group_name: str = "default"):
-    _manager.get_group(group_name).barrier()
+    group = _manager.get_group(group_name)
+    _traced_op("collective.barrier", group_name, group.barrier)
 
 
 def send(tensor, dst_rank: int, group_name: str = "default", tag: int = 0):

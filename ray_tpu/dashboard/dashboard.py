@@ -9,6 +9,9 @@ Endpoints:
     /api/metrics gcs + per-raylet metric snapshots
     /api/objects per-node object store usage
     /api/timeline chrome-trace JSON of recorded profile spans
+    /api/trace   Perfetto JSON of the trace table (?trace_id= one tree)
+    /api/metrics/history per-source metric time series (?samples=N)
+    /api/events  structured cluster events ring
 """
 
 from __future__ import annotations
@@ -165,6 +168,18 @@ class Dashboard:
 
         return to_chrome_trace(await self._gcs("get_profile_events"))
 
+    async def trace(self, trace_id: str | None = None) -> list[dict]:
+        """Perfetto/chrome-trace JSON of the GCS trace table — the
+        causally-linked span trees (all traces, or one by hex id)."""
+        from ray_tpu._private.profiling import spans_to_chrome_trace
+
+        rows = await self._gcs("get_trace_spans", {"trace_id": trace_id})
+        return spans_to_chrome_trace(rows)
+
+    async def metrics_history(self, samples: int = 0) -> dict:
+        """Per-source metric time series from the GCS ring buffers."""
+        return await self._gcs("get_metrics_history", {"samples": samples})
+
     async def events(self) -> list[dict]:
         return await self._gcs("get_events")
 
@@ -187,6 +202,21 @@ class Dashboard:
         app.router.add_get("/api/objects", jroute(self.objects))
         app.router.add_get("/api/timeline", jroute(self.timeline))
         app.router.add_get("/api/events", jroute(self.events))
+
+        async def trace_handler(request):
+            return web.json_response(await self.trace(
+                trace_id=request.rel_url.query.get("trace_id")))
+
+        async def history_handler(request):
+            try:
+                samples = int(request.rel_url.query.get("samples", 0))
+            except ValueError:
+                return web.json_response(
+                    {"error": "samples must be an integer"}, status=400)
+            return web.json_response(await self.metrics_history(samples))
+
+        app.router.add_get("/api/trace", trace_handler)
+        app.router.add_get("/api/metrics/history", history_handler)
 
         async def logs_handler(request):
             q = request.rel_url.query

@@ -30,10 +30,16 @@ import time
 
 from ray_tpu._private import failpoints as _fp
 from ray_tpu._private import rpc
+from ray_tpu._private import stats as _stats
+from ray_tpu._private import tracing as _tracing
 from ray_tpu._private.common import InsufficientResources, ResourceSet
 from ray_tpu._private.config import Config, get_config, set_config
 
 logger = logging.getLogger("ray_tpu.gcs")
+
+M_TRACE_APPLY_FAILURES = _stats.Count(
+    "gcs.trace_apply_failures_total",
+    "profile/trace batches dropped by a failed trace-table apply")
 
 # Actor states (reference: src/ray/protobuf/gcs.proto ActorTableData.ActorState)
 DEPENDENCIES_UNREADY = "DEPENDENCIES_UNREADY"
@@ -79,6 +85,17 @@ class GcsServer:
 
         self.profile_events: _collections.deque = _collections.deque(
             maxlen=200_000)
+        # Trace table: flat span rows (tracing.py spans carry a `tid`
+        # trace id in extra_data) indexed out of the profile batches so
+        # one request's cross-process tree is queryable by trace id.
+        self.trace_spans: _collections.deque = _collections.deque(
+            maxlen=50_000)
+        # Metrics time series: source -> metric -> ring of [ts, value]
+        # samples, fed by raylet heartbeat piggybacks and worker/driver
+        # push_metrics notifies (~2s cadence; ~10 min of history).
+        self.metrics_history: dict[str, dict] = {}
+        self.metrics_history_samples = 300
+        self.metrics_last_push: dict[str, float] = {}
         if storage is not None:
             self._restore()
 
@@ -92,6 +109,9 @@ class GcsServer:
         if _fp.KV_KEY in self.kv:
             # armed failpoints survive a GCS restart with the KV
             _fp.apply_kv_value(self.kv[_fp.KV_KEY])
+        if _tracing.KV_KEY in self.kv:
+            # so does a live trace-sampling override
+            _tracing.apply_kv_value(self.kv[_tracing.KV_KEY])
         self.jobs = dict(st.table("jobs"))
         self.next_job = st.get("meta", "next_job", 1)
         now = time.monotonic()
@@ -177,6 +197,9 @@ class GcsServer:
             "list_placement_groups": self.h_list_placement_groups,
             "add_profile_events": self.h_add_profile_events,
             "get_profile_events": self.h_get_profile_events,
+            "get_trace_spans": self.h_get_trace_spans,
+            "push_metrics": self.h_push_metrics,
+            "get_metrics_history": self.h_get_metrics_history,
             "report_event": self.h_report_event,
             "get_events": self.h_get_events,
             "get_metrics": self.h_get_metrics,
@@ -195,6 +218,11 @@ class GcsServer:
             # subscribed raylet/worker/driver (failpoints.arm_cluster)
             _fp.apply_kv_value(d["value"])
             await self.publish(_fp.CHANNEL, d["value"])
+        elif key == _tracing.KV_KEY:
+            # live trace-sampling override (ray_tpu.set_trace_sampling):
+            # same apply-here + broadcast plane as the failpoints
+            _tracing.apply_kv_value(d["value"])
+            await self.publish(_tracing.CHANNEL, d["value"])
         return True
 
     async def h_kv_get(self, conn, d):
@@ -321,6 +349,12 @@ class GcsServer:
             await _fp.fire_async_strict("gcs.heartbeat")
         node_id = d["node_id"]
         self.last_heartbeat[node_id] = time.monotonic()
+        if "metrics" in d:
+            # heartbeat-piggybacked raylet metric sample (the raylet
+            # sends one every ~4th beat) — feed the time-series ring
+            self._ingest_metrics(
+                d.get("metrics_source")
+                or f"{node_id.hex()[:8]}/raylet", d["metrics"])
         if "available" in d and node_id in self.nodes:
             self.available[node_id] = ResourceSet.from_raw(d["available"])
             if any(r["state"] == "PENDING"
@@ -673,16 +707,109 @@ class GcsServer:
         return out[-limit:]
 
     async def h_add_profile_events(self, conn, d):
+        if _fp.ARMED:
+            # trace-table apply seam: `raise` models a failed table
+            # write — the batch is dropped HERE (counted, typed log)
+            # while the sender's requeue path stays untouched
+            try:
+                await _fp.fire_async_strict("gcs.trace_table.apply")
+            except _fp.FailpointError:
+                M_TRACE_APPLY_FAILURES.inc()
+                logger.warning("trace table apply failed (failpoint); "
+                               "dropping batch of %d events",
+                               len(d.get("events", ())))
+                return False
         self.profile_events.append({
             "component_type": d["component_type"],
             "component_id": d["component_id"],
             "node_id": d.get("node_id"),
             "events": d["events"],
         })
+        # index trace spans (events carrying a trace id) into the flat
+        # trace table so get_trace_spans can filter by trace
+        for ev in d["events"]:
+            extra = ev.get("extra_data") or {}
+            if "tid" in extra:
+                self.trace_spans.append({
+                    "component_type": d["component_type"],
+                    "component_id": d["component_id"],
+                    "node_id": d.get("node_id"),
+                    "event_type": ev["event_type"],
+                    "start_time": ev["start_time"],
+                    "end_time": ev["end_time"],
+                    "extra_data": extra,
+                })
         return True
 
     async def h_get_profile_events(self, conn, d):
         return list(self.profile_events)
+
+    async def h_get_trace_spans(self, conn, d):
+        """Flat span rows from the trace table, optionally filtered to
+        one trace (hex trace id)."""
+        tid = d.get("trace_id")
+        if isinstance(tid, bytes):
+            tid = tid.decode()
+        out = list(self.trace_spans)
+        if tid:
+            out = [s for s in out if s["extra_data"].get("tid") == tid]
+        return out
+
+    def _ingest_metrics(self, source: str, snap: dict):
+        """One timestamped sample per metric into the per-source ring.
+        Histograms flatten to scalar series (.count/.sum/.p99) so the
+        serving tier's autoscaler can read router p99 over time without
+        re-deriving bucket math."""
+        import collections as _collections
+
+        ts = time.time()
+        rings = self.metrics_history.setdefault(source, {})
+
+        def put(name, value):
+            ring = rings.get(name)
+            if ring is None:
+                ring = rings[name] = _collections.deque(
+                    maxlen=self.metrics_history_samples)
+            ring.append([ts, float(value)])
+
+        for name, m in snap.items():
+            try:
+                kind = m.get("type")
+                if kind == "histogram":
+                    put(name + ".count", m.get("count", 0))
+                    put(name + ".sum", m.get("sum", 0.0))
+                    put(name + ".p99", _stats.percentile(m, 0.99))
+                else:
+                    put(name, m.get("value", 0.0))
+            except (TypeError, ValueError, AttributeError):
+                continue  # one malformed metric must not drop the batch
+        self.metrics_last_push[source] = ts
+        # Worker/driver sources are keyed per pid and churn with jobs;
+        # nothing else removes a dead process's rings. Evict sources
+        # idle past a full retention window (~2s cadence * ring length)
+        # so the history stays bounded by live pushers, not by every
+        # process that ever pushed.
+        cutoff = ts - 2.0 * self.metrics_history_samples
+        for stale in [s for s, t in self.metrics_last_push.items()
+                      if t < cutoff]:
+            self.metrics_history.pop(stale, None)
+            self.metrics_last_push.pop(stale, None)
+
+    async def h_push_metrics(self, conn, d):
+        """Metric sample push from a worker/driver process (raylets ride
+        the heartbeat piggyback instead)."""
+        source = d.get("source") or "?"
+        self._ingest_metrics(source, d.get("metrics") or {})
+        return True
+
+    async def h_get_metrics_history(self, conn, d):
+        samples = int(d.get("samples") or 0)
+        out = {}
+        for source, rings in self.metrics_history.items():
+            out[source] = {
+                name: list(ring)[-samples:] if samples > 0 else list(ring)
+                for name, ring in rings.items()}
+        return out
 
     async def h_get_metrics(self, conn, d):
         """This process's metric registry + computed cluster gauges."""

@@ -299,6 +299,8 @@ def main(seconds_per_case: float = 2.0) -> list[dict]:
 
     _serve_qps(results)
 
+    _tracing_ab(results)
+
     ray_tpu.shutdown()
 
     _cross_node_bench(results)
@@ -554,6 +556,58 @@ def _collective_bench(results: list[dict], nbytes: int = 16 * 1024 * 1024,
         ray_tpu.kill(r)
 
 
+def _http_qps_window(pool, tls, port: int, route: str,
+                     seconds: float = 0.7) -> float:
+    """Keep-alive HTTP throughput over one timed window: 16 pooled
+    client threads, one persistent conn per (thread, port) — urllib
+    reconnects per request, which would measure TCP handshakes, not the
+    proxy. Shared by the legacy-proxy and tracing A/Bs so both rows
+    measure through the identical harness."""
+    import http.client
+
+    stop = time.perf_counter() + seconds
+
+    def worker(_):
+        conns = getattr(tls, "conns", None)
+        if conns is None:
+            conns = tls.conns = {}
+        n = 0
+        while time.perf_counter() < stop:
+            conn = conns.get(port)
+            if conn is None:
+                conn = conns[port] = http.client.HTTPConnection(
+                    "127.0.0.1", port)
+            try:
+                conn.request("GET", route)
+                conn.getresponse().read()
+            except (http.client.HTTPException, OSError):
+                conns.pop(port, None)
+                raise
+            n += 1
+        return n
+
+    t0 = time.perf_counter()
+    counts = list(pool.map(worker, range(16)))
+    return sum(counts) / (time.perf_counter() - t0)
+
+
+def _rate_rows(results: list[dict], rows, windows: int):
+    """Median/sd/high-variance row emission for the hand-rolled
+    interleaved A/Bs (timeit_ab covers the closed-loop cases)."""
+    for name, rates in rows:
+        med = float(np.median(rates))
+        sd = float(np.std(rates))
+        flagged = bool(med > 0 and sd > 0.5 * med)
+        print(f"{name} per second {med:.2f} +- {sd:.2f} "
+              f"(median of {windows} interleaved windows)"
+              + ("  [HIGH VARIANCE]" if flagged else ""))
+        row = {"name": name, "per_second": med, "sd": sd,
+               "trials": [round(r, 2) for r in rates]}
+        if flagged:
+            row["high_variance"] = True
+        results.append(row)
+
+
 def _serve_qps(results: list[dict]):
     """Serve noop throughput (reference: serve release bench, ~3-4k qps
     noop via HTTP). Measured through the handle (router batching path),
@@ -631,38 +685,12 @@ def _serve_qps(results: list[dict]):
         client._controller, "127.0.0.1", 0, False, True)
     legacy_port = ray_tpu.get(legacy.port.remote(), timeout=60)
 
-    # Keep-alive connections (urllib reconnects per request, which would
-    # measure TCP handshakes, not the proxy). One conn per (thread, port).
-    import http.client
     import threading as _threading
 
     tls = _threading.local()
 
     def http_window(port: int, seconds: float = 0.7) -> float:
-        stop = time.perf_counter() + seconds
-
-        def worker(_):
-            conns = getattr(tls, "conns", None)
-            if conns is None:
-                conns = tls.conns = {}
-            n = 0
-            while time.perf_counter() < stop:
-                conn = conns.get(port)
-                if conn is None:
-                    conn = conns[port] = http.client.HTTPConnection(
-                        "127.0.0.1", port)
-                try:
-                    conn.request("GET", "/noop")
-                    conn.getresponse().read()
-                except (http.client.HTTPException, OSError):
-                    conns.pop(port, None)
-                    raise
-                n += 1
-            return n
-
-        t0 = time.perf_counter()
-        counts = list(pool.map(worker, range(16)))
-        return sum(counts) / (time.perf_counter() - t0)
+        return _http_qps_window(pool, tls, port, "/noop", seconds)
 
     http_window(client.http_port, 0.2)  # warm both proxies' conns
     http_window(legacy_port, 0.2)
@@ -670,16 +698,81 @@ def _serve_qps(results: list[dict]):
     for _ in range(5):  # interleaved: load swings hit both sides
         opt_rates.append(http_window(client.http_port))
         leg_rates.append(http_window(legacy_port))
-    for name, rates in (("serve http noop qps", opt_rates),
-                        ("serve http noop qps (legacy-path control)",
-                         leg_rates)):
-        med = float(np.median(rates))
-        print(f"{name} per second {med:.2f} "
-              f"+- {float(np.std(rates)):.2f} (median of 5)")
-        results.append({"name": name, "per_second": med,
-                        "sd": float(np.std(rates)),
-                        "trials": [round(r, 2) for r in rates]})
+    _rate_rows(results, [("serve http noop qps", opt_rates),
+                         ("serve http noop qps (legacy-path control)",
+                          leg_rates)], windows=5)
     ray_tpu.kill(legacy)
+    pool.shutdown()
+    serve.shutdown()
+
+
+def _tracing_ab(results: list[dict]):
+    """Distributed-tracing overhead A/B (the tier-1 microbench gate in
+    test_observability reads these rows): tracing at the DEFAULT head
+    sampling rate (1%, what a cluster pays out of the box) against a
+    tracing-off control, paired-interleaved on the two rows the gate
+    watches — tasks sync and serve http qps. The sampling flip rides the
+    live KV+pubsub plane (`ray_tpu.set_trace_sampling`), so both slices
+    of each window run identical code; the only delta is maybe_trace()'s
+    rate check on every entry point plus span record/flush for the ~1%
+    sampled calls."""
+    from ray_tpu import serve
+
+    def arm(rate: float):
+        def setup():
+            ray_tpu.set_trace_sampling(rate)
+            # the pubsub flip reaches raylet/worker/proxy processes
+            # asynchronously; give it a beat before the slice starts
+            time.sleep(0.1)
+
+        return setup
+
+    TR = lambda fn: {"": (arm(0.01), fn),  # noqa: E731
+                     "tracing-off control": (arm(0.0), fn)}
+
+    @ray_tpu.remote
+    def small_task():
+        return b"ok"
+
+    def task_sync():
+        ray_tpu.get(small_task.remote())
+
+    timeit_ab("tracing A/B tasks sync", TR(task_sync), results=results)
+
+    # serve http: optimized proxy only (the legacy A/B lives in
+    # _serve_qps); the sampling rate toggles between the two slices of
+    # EACH window so box-load swings hit both arms equally.
+    client = serve.start(http=True)
+    client.create_backend("noop_tr", lambda _=None: "ok", config={
+        "num_replicas": 2, "max_batch_size": 32,
+        "batch_wait_timeout": 0.001, "max_concurrent_queries": 8})
+    client.create_endpoint("noop_tr", backend="noop_tr", route="/noop_tr")
+    handle = client.get_handle("noop_tr")
+    ray_tpu.get(handle.remote(None), timeout=60)  # warm the path
+
+    import threading as _threading
+    from concurrent.futures import ThreadPoolExecutor
+
+    pool = ThreadPoolExecutor(max_workers=16)
+    tls = _threading.local()
+    port = client.http_port
+
+    def http_window(seconds: float = 0.7) -> float:
+        return _http_qps_window(pool, tls, port, "/noop_tr", seconds)
+
+    arm(0.01)()
+    http_window(0.2)  # warm keep-alive conns
+    on_rates, off_rates = [], []
+    for _ in range(5):
+        arm(0.01)()
+        on_rates.append(http_window())
+        arm(0.0)()
+        off_rates.append(http_window())
+    arm(0.01)()  # leave the cluster at the default rate
+    _rate_rows(results, [
+        ("tracing A/B serve http qps", on_rates),
+        ("tracing A/B serve http qps (tracing-off control)", off_rates),
+    ], windows=5)
     pool.shutdown()
     serve.shutdown()
 

@@ -28,6 +28,7 @@ import time
 
 from ray_tpu._private import failpoints as _fp
 from ray_tpu._private import rpc
+from ray_tpu._private import tracing
 from ray_tpu._private.common import InsufficientResources, ResourceSet
 from ray_tpu._private.config import Config, get_config, set_config
 from ray_tpu._private.ids import NodeID, ObjectID
@@ -112,7 +113,20 @@ class Raylet:
         self.m_locality_spillbacks = stats.Count(
             "raylet.locality_spillbacks_total",
             "lease requests redirected to the node holding their args")
+        self.m_lease_grant_s = stats.Histogram(
+            "raylet.lease_grant_s", stats.LATENCY_BOUNDARIES_S,
+            "lease request arrival -> grant (queue + worker startup)")
         self.num_cpus = int(resources.get("CPU", os.cpu_count() or 1))
+
+        # trace spans (tracing.py) recorded by this raylet — lease grants
+        # and object-transfer hops — flushed to the GCS on the heartbeat
+        # cadence (~2s)
+        from ray_tpu._private.profiling import ProfileBuffer
+
+        self._profile = ProfileBuffer("raylet")
+        tracing.bind_buffer(self._profile)
+        self._last_profile_flush = 0.0
+        self._beat_n = 0
 
         # scheduling
         self._lease_seq = 0
@@ -626,6 +640,7 @@ class Raylet:
         returns an empty grant list immediately, so owner-side lease
         pre-warm for bursts of tiny tasks cannot spawn-storm the node."""
         spec = d["spec"]
+        lease_t0 = time.time()
         if _fp.ARMED:
             # grant seam: `raise` -> RemoteError at the owner's lease
             # request (typed retry/fail path); `exit` kills the raylet
@@ -679,6 +694,7 @@ class Raylet:
                 await self._dispatch_pending()
             else:
                 self._track_holder(conn, grants)
+            self._note_lease_granted(lease_t0, spec, len(grants))
             return {"grants": grants} if batched else grants[0]
         if soft:
             return {"grants": []}
@@ -719,9 +735,23 @@ class Raylet:
                 await self._dispatch_pending()
             else:
                 self._track_holder(conn, [result])
+            self._note_lease_granted(lease_t0, spec, 1)
         if batched and "spillback" not in result:
             return {"grants": [result]}
         return result
+
+    def _note_lease_granted(self, t0: float, spec, count: int):
+        """Raylet-side scheduling hop: histogram always, a `raylet.lease`
+        span (child of the requesting task's root) when the spec carries
+        a sampled trace context."""
+        now = time.time()
+        self.m_lease_grant_s.observe(now - t0)
+        root = tracing.from_wire(spec.get("trace"))
+        if root is not None:
+            tracing.record_span("raylet.lease", t0, now,
+                                tracing.child(root),
+                                {"name": spec.get("name", "?"),
+                                 "count": count})
 
     @staticmethod
     def _track_holder(conn, grants):
@@ -1175,12 +1205,22 @@ class Raylet:
         cfg = self.config
         object_id = ObjectID(oid)
         loop = asyncio.get_running_loop()
+        # bulk-pull trace entry point: the wire context rides the pull
+        # request so the SOURCE raylet's serve span joins this tree
+        ctx = tracing.maybe_trace()
+        t0 = time.time()
         size = await loop.run_in_executor(None, lambda: transfer.streaming_pull(
             oid, object_id, self.store, bulk_addresses,
             chunk=cfg.object_transfer_chunk_size,
             stripe=cfg.object_transfer_stripe_size,
             max_sources=cfg.max_pull_sources,
-            io_timeout=cfg.bulk_transfer_io_timeout_s))
+            io_timeout=cfg.bulk_transfer_io_timeout_s,
+            trace=tracing.to_wire(ctx) if ctx is not None else None))
+        if ctx is not None:
+            tracing.record_span("transfer.pull", t0, time.time(), ctx,
+                                {"object_id": oid[:6].hex(),
+                                 "bytes": size,
+                                 "sources": len(bulk_addresses)})
         self._pulled_local(oid, size)
         await self._wake_object_waiters(oid)
 
@@ -1653,6 +1693,9 @@ class Raylet:
         if channel == _fp.CHANNEL:
             _fp.apply_kv_value(data)
             return
+        if channel == tracing.CHANNEL:
+            tracing.apply_kv_value(data)
+            return
         if channel == "nodes":
             node = data["node"]
             if data["event"] in ("added", "updated"):
@@ -1736,6 +1779,45 @@ class Raylet:
                 pass
         os._exit(1)
 
+    def _heartbeat_metrics(self) -> dict | None:
+        """Every 4th beat (~2s) the heartbeat piggybacks this raylet's
+        metric snapshot for the GCS time-series ring. A fired
+        metrics.push failpoint skips the sample — never the beat."""
+        self._beat_n += 1
+        if self._beat_n % 4:
+            return None
+        try:
+            if _fp.ARMED:
+                _fp.fire_strict("metrics.push")
+        except _fp.FailpointError:
+            return None
+        from ray_tpu._private import stats
+
+        return stats.snapshot()
+
+    async def _flush_profile(self):
+        """Flush recorded trace spans / profile events to the GCS (~2s
+        cadence off the heartbeat loop); a failed flush requeues into
+        the bounded buffer like the core-worker path."""
+        now = time.monotonic()
+        if now - self._last_profile_flush < 2.0:
+            return
+        self._last_profile_flush = now
+        events = self._profile.drain()
+        if not events or self.gcs is None:
+            return
+        try:
+            if _fp.ARMED:
+                _fp.fire_strict("trace.flush")
+            await self.gcs.notify("add_profile_events", {
+                "component_type": "raylet",
+                "component_id": os.getpid(),
+                "node_id": self.node_id.binary(),
+                "events": events,
+            })
+        except Exception:
+            self._profile.requeue(events)
+
     async def heartbeat_loop(self):
         interval = self.config.heartbeat_interval_s
         window = max(self.config.gcs_reconnect_timeout_s, 2 * interval)
@@ -1745,14 +1827,25 @@ class Raylet:
             try:
                 if _fp.ARMED:
                     await _fp.fire_async_strict("raylet.heartbeat")
+                beat = {
+                    "node_id": self.node_id.binary(),
+                    "available": self.available.raw(),
+                }
+                metrics = self._heartbeat_metrics()
+                if metrics is not None:
+                    beat["metrics"] = metrics
+                    beat["metrics_source"] = (
+                        f"{self.node_id.hex()[:8]}/raylet")
                 # Bounded per-beat: a HUNG (not dead) GCS must not park
                 # this call forever — that would stop the failure clock
                 # and leave exactly the zombie this loop exists to kill.
-                await self.gcs.call("heartbeat", {
-                    "node_id": self.node_id.binary(),
-                    "available": self.available.raw(),
-                }, timeout=max(2.0, 4 * interval))
+                await self.gcs.call("heartbeat", beat,
+                                    timeout=max(2.0, 4 * interval))
                 last_ok = time.monotonic()
+                try:
+                    await self._flush_profile()
+                except Exception:
+                    logger.exception("profile flush failed")
             except Exception:
                 logger.warning("heartbeat to GCS failed")
                 if time.monotonic() - last_ok > window:
@@ -1789,6 +1882,10 @@ class Raylet:
             armed = await conn.call("kv_get", {"key": _fp.KV_KEY})
             if armed:
                 _fp.apply_kv_value(armed)
+            await conn.call("subscribe", {"channel": tracing.CHANNEL})
+            rate = await conn.call("kv_get", {"key": tracing.KV_KEY})
+            if rate:
+                tracing.apply_kv_value(rate)
             nodes = await conn.call("get_all_nodes", {})
             self.cluster_nodes = {n["node_id"]: n for n in nodes}
             await conn.call("register_node", {

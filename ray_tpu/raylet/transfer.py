@@ -65,6 +65,7 @@ from ray_tpu import exceptions as exc
 from ray_tpu._private import failpoints as _fp
 from ray_tpu._private import rpc
 from ray_tpu._private import stats as _stats
+from ray_tpu._private import tracing as _tracing
 from ray_tpu._private.ids import ObjectID
 
 logger = logging.getLogger("ray_tpu.transfer")
@@ -396,6 +397,10 @@ class BulkTransferServer:
         length = int(data.get("length", 0))  # 0 = stat/pin only
         chunk = int(data.get("chunk", 0)) or \
             raylet.config.object_transfer_chunk_size
+        # puller's sampled trace context (tracing.py wire format): this
+        # source's serve span joins the puller's transfer tree
+        _trace_start = time.time()
+        _trace_ctx = _tracing.from_wire(data.get("trace"))
         if _fp.ARMED:
             # transfer registration seam: `raise` -> typed error reply
             # (puller fails this source over); `drop_conn` kills the
@@ -461,6 +466,11 @@ class BulkTransferServer:
                 M_INFLIGHT_CHUNKS.add(-1)
             pos += n
         sock.sendall(_CHUNK.pack(_DONE_OFFSET, 0))
+        if _trace_ctx is not None and length:
+            _tracing.record_span(
+                "transfer.serve", _trace_start, time.time(),
+                _tracing.child(_trace_ctx),
+                {"object_id": oid[:6].hex(), "bytes": end - offset})
 
     @staticmethod
     def _send_err(sock, msgid, e: BaseException):
@@ -493,14 +503,18 @@ class _Source:
             pass
 
     def _request(self, oid: bytes, offset: int, length: int,
-                 chunk: int) -> int:
+                 chunk: int, trace: list | None = None) -> int:
         """Send one bulk_pull request; returns the object's total size.
         Raises the sender's typed error on REPLY_ERR."""
         self._msgid += 1
+        req = {"object_id": oid, "offset": offset, "length": length,
+               "chunk": chunk}
+        if trace is not None:
+            # puller's sampled trace context: the source raylet's serve
+            # span joins the pull's trace tree (tracing.py wire format)
+            req["trace"] = trace
         self.sock.sendall(rpc._pack([
-            rpc.REQUEST, self._msgid, "bulk_pull",
-            {"object_id": oid, "offset": offset, "length": length,
-             "chunk": chunk}]))
+            rpc.REQUEST, self._msgid, "bulk_pull", req]))
         msg = _read_control_frame(self.sock)
         if msg[0] == rpc.REPLY_ERR:
             e = pickle.loads(msg[3][0])
@@ -515,11 +529,12 @@ class _Source:
         return size
 
     def pull_range(self, oid: bytes, offset: int, length: int, chunk: int,
-                   view: memoryview, progress: list) -> None:
+                   view: memoryview, progress: list,
+                   trace: list | None = None) -> None:
         """Stream one contiguous range into `view` at its true offsets.
         `progress[0]` tracks contiguous bytes landed so a failure mid-
         range lets the caller requeue only the remainder."""
-        self._request(oid, offset, length, chunk)
+        self._request(oid, offset, length, chunk, trace)
         self._drain_stream(view, offset, length, progress)
 
     def _drain_stream(self, view, offset, length, progress=None):
@@ -553,7 +568,8 @@ class _Source:
 def streaming_pull(oid: bytes, object_id: ObjectID, store,
                    addresses: list[str], *, chunk: int, stripe: int,
                    max_sources: int = 4, connect_timeout: float = 5.0,
-                   io_timeout: float = 30.0) -> int:
+                   io_timeout: float = 30.0,
+                   trace: list | None = None) -> int:
     """Pull one object over the bulk plane, striping across up to
     `max_sources` of `addresses`. Creates, fills and seals the store
     entry; aborts it on failure. Blocking — run on an executor thread.
@@ -647,7 +663,8 @@ def streaming_pull(oid: bytes, object_id: ObjectID, store,
                             ln += l2
                     progress = [0]
                     try:
-                        conn.pull_range(oid, off, ln, chunk, view, progress)
+                        conn.pull_range(oid, off, ln, chunk, view, progress,
+                                        trace)
                         moved += ln
                         with lock:
                             remaining[0] -= ln

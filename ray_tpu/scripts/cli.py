@@ -282,6 +282,101 @@ def cmd_timeline(args) -> int:
     return 0
 
 
+def cmd_trace(args) -> int:
+    """Export the GCS trace table (causally-linked cross-process span
+    trees, tracing.py) as Perfetto/chrome-trace JSON — the whole table,
+    or one tree via --trace-id."""
+    addr = _gcs_address(args)
+    if not addr:
+        print("no cluster found", file=sys.stderr)
+        return 1
+    from ray_tpu._private.profiling import spans_to_chrome_trace
+
+    rows = _rpc_call(addr, "get_trace_spans",
+                     {"trace_id": args.trace_id})
+    if not rows:
+        print("(no trace spans recorded — is sampling on? see "
+              "RAY_TPU_TRACE_SAMPLE / ray_tpu.set_trace_sampling)")
+        return 0
+    trace = spans_to_chrome_trace(rows)
+    out = args.out or "trace.json"
+    with open(out, "w") as f:
+        json.dump(trace, f)
+    traces = {r["extra_data"].get("tid") for r in rows}
+    print(f"wrote {len(rows)} spans across {len(traces)} trace(s) to "
+          f"{out} (open in Perfetto / chrome://tracing)")
+    return 0
+
+
+def cmd_top(args) -> int:
+    """Live cluster metrics view off the GCS time-series ring (the
+    `ray-tpu top` analog of `ray status -v`, refreshed in place).
+    Shows, per source, the latest sample plus a rate over the window
+    for counters and the current p99 for latency histograms."""
+    import time as _time
+
+    addr = _gcs_address(args)
+    if not addr:
+        print("no cluster found", file=sys.stderr)
+        return 1
+
+    def render() -> int:
+        hist = _rpc_call(addr, "get_metrics_history", {"samples": 0})
+        lines = []
+        for source in sorted(hist):
+            rings = hist[source]
+            rows = []
+            for name in sorted(rings):
+                series = rings[name]
+                if not series:
+                    continue
+                if args.filter and args.filter not in name:
+                    continue
+                ts, val = series[-1]
+                if name.endswith(".p99"):
+                    rows.append(f"    {name:<44} {val * 1e3:9.2f} ms")
+                    continue
+                rate = ""
+                # rate-over-window is only meaningful for counters —
+                # a rising gauge (bytes in use) is a level, not a flow
+                if len(series) >= 2 and (name.endswith("_total")
+                                         or name.endswith(".count")):
+                    (t0, v0), (t1, v1) = series[0], series[-1]
+                    if t1 > t0 and v1 >= v0:
+                        rate = f"  ({(v1 - v0) / (t1 - t0):8.1f}/s)"
+                rows.append(f"    {name:<44} {val:12g}{rate}")
+            if rows:
+                age = _time.time() - max(s[-1][0] for s in rings.values()
+                                         if s)
+                lines.append(f"  {source}  (sample {age:.1f}s old, "
+                             f"{len(rows)} metrics)")
+                lines.extend(rows)
+        print(f"ray-tpu top — {_time.strftime('%H:%M:%S')} — "
+              f"{len(hist)} sources")
+        if lines:
+            print("\n".join(lines))
+        else:
+            print("  (no samples yet — history fills on the ~2s "
+                  "heartbeat/flush cadence)")
+        return len(lines)
+
+    if args.iterations == 1:
+        render()
+        return 0
+    try:
+        n = 0
+        while args.iterations <= 0 or n < args.iterations:
+            if n:
+                print("\x1b[2J\x1b[H", end="")  # clear + home
+            render()
+            n += 1
+            if args.iterations <= 0 or n < args.iterations:
+                _time.sleep(args.interval)
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
 def cmd_submit(args) -> int:
     """Run a driver script against the recorded cluster (reference:
     `ray submit` — there via the cluster launcher; here the cluster is
@@ -470,6 +565,25 @@ def main(argv=None) -> int:
     p = sub.add_parser("metrics", help="metric snapshots from gcs + raylets")
     p.add_argument("--address", default=None)
     p.set_defaults(fn=cmd_metrics)
+
+    p = sub.add_parser("trace",
+                       help="export distributed-trace span trees "
+                            "(Perfetto JSON)")
+    p.add_argument("--address", default=None)
+    p.add_argument("--trace-id", default=None,
+                   help="hex trace id — export one tree only")
+    p.add_argument("--out", default=None, help="output path (trace.json)")
+    p.set_defaults(fn=cmd_trace)
+
+    p = sub.add_parser("top",
+                       help="live metrics view off the GCS time-series")
+    p.add_argument("--address", default=None)
+    p.add_argument("--interval", type=float, default=2.0)
+    p.add_argument("--iterations", type=int, default=0,
+                   help="stop after N refreshes (0 = until Ctrl-C)")
+    p.add_argument("--filter", default=None,
+                   help="only metrics whose name contains this substring")
+    p.set_defaults(fn=cmd_top)
 
     p = sub.add_parser("timeline", help="dump chrome-trace profile timeline")
     p.add_argument("--address", default=None)

@@ -11,6 +11,13 @@ import json
 import threading
 import time
 
+from ray_tpu._private import stats as _stats
+from ray_tpu._private import tracing
+
+M_HTTP_E2E_S = _stats.Histogram(
+    "serve.http_e2e_s", _stats.LATENCY_BOUNDARIES_S,
+    "HTTP request arrival -> response sent (proxy side)")
+
 
 class HTTPProxy:
     """Actor: runs an aiohttp server on a thread; one Router per endpoint."""
@@ -117,6 +124,13 @@ class HTTPProxy:
             router = self._routers.get(endpoint)
             if router is None:
                 router = self._router_for(endpoint)
+            # Serve trace entry point: head-sample a root context and
+            # make it ambient for the dispatch — the router carries it
+            # to the replica so one HTTP request becomes one tree
+            # (proxy -> router queue -> lease -> replica exec).
+            ctx = tracing.maybe_trace()
+            token = tracing.push(ctx) if ctx is not None else None
+            t0 = time.time()
             try:
                 if self._legacy_path:
                     ref = await router.assign_async(data)
@@ -127,6 +141,13 @@ class HTTPProxy:
                 return web.json_response({"result": result})
             except Exception as e:
                 return web.json_response({"error": str(e)}, status=500)
+            finally:
+                end = time.time()
+                M_HTTP_E2E_S.observe(end - t0)
+                if token is not None:
+                    tracing.pop(token)
+                    tracing.record_span("http.request", t0, end, ctx,
+                                        {"name": request.path})
 
         async def run():
             app = web.Application()

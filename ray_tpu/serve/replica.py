@@ -6,8 +6,16 @@ batch — on TPU the batch is the unit that fills the MXU)."""
 from __future__ import annotations
 
 import inspect
+import time
 
 import cloudpickle
+
+from ray_tpu._private import stats as _stats
+
+M_REPLICA_EXEC_S = _stats.Histogram(
+    "serve.replica_exec_s", _stats.LATENCY_BOUNDARIES_S,
+    "user-callable execution per batch (replica side; pairs with "
+    "serve.router_queue_s as the autoscaler's latency feed)")
 
 
 def _is_accept_batch(fn) -> bool:
@@ -50,14 +58,18 @@ class Replica:
     def handle_batch(self, requests: list):
         """One RPC per batch; returns per-request results (the runtime
         splits them into the callers' ObjectRefs via num_returns)."""
-        if self._accept_batch:
-            out = self._callable(requests)
-            if len(out) != len(requests):
-                raise ValueError(
-                    f"accept_batch callable returned {len(out)} results "
-                    f"for {len(requests)} requests")
-        else:
-            out = [self._callable(r) for r in requests]
+        start = time.time()
+        try:
+            if self._accept_batch:
+                out = self._callable(requests)
+                if len(out) != len(requests):
+                    raise ValueError(
+                        f"accept_batch callable returned {len(out)} results "
+                        f"for {len(requests)} requests")
+            else:
+                out = [self._callable(r) for r in requests]
+        finally:
+            M_REPLICA_EXEC_S.observe(time.time() - start)
         return tuple(out) if len(out) > 1 else out[0]
 
     def ping(self):

@@ -16,10 +16,18 @@ from __future__ import annotations
 import threading
 import time
 
+from ray_tpu._private import stats as _stats
+from ray_tpu._private import tracing
+
+M_ROUTER_QUEUE_S = _stats.Histogram(
+    "serve.router_queue_s", _stats.LATENCY_BOUNDARIES_S,
+    "query enqueue -> batch dispatch to a replica (the autoscaler's "
+    "queue-delay feed, observed for every query)")
+
 
 class _PendingQuery:
     __slots__ = ("data", "event", "ref", "error", "abandoned", "loop",
-                 "future", "want_result")
+                 "future", "want_result", "trace", "t_enqueue")
 
     def __init__(self, data):
         self.data = data
@@ -30,6 +38,11 @@ class _PendingQuery:
         self.loop = None    # set by assign_async/call_async: asyncio bridge
         self.future = None
         self.want_result = False  # call_async: resolve with the VALUE
+        # the caller's ambient trace context (the HTTP proxy mints one
+        # per sampled request): carried to the flusher thread so the
+        # dispatched batch task joins the request's trace tree
+        self.trace = tracing.current()
+        self.t_enqueue = time.time()
 
     def _notify(self):
         """Dispatch outcome is ready: wake the sync waiter and, for async
@@ -306,10 +319,26 @@ class Router:
         key = replica._actor_id.binary()
         with self._lock:
             self._inflight[key] = self._inflight.get(key, 0) + 1
+        batch_ctx = None
+        if not shadow:
+            # queue-wait hop closes here: histogram for every query,
+            # spans for the traced ones. The first traced query's
+            # context becomes ambient for the batch's .remote() below,
+            # so the replica-side exec span joins its request tree.
+            now = time.time()
+            for q in batch:
+                M_ROUTER_QUEUE_S.observe(now - q.t_enqueue)
+                if q.trace is not None:
+                    tracing.record_span(
+                        "serve.router_queue", q.t_enqueue, now,
+                        tracing.child(q.trace))
+                    if batch_ctx is None:
+                        batch_ctx = q.trace
         refs: list = []
         try:
-            out = replica.handle_batch.options(
-                num_returns=len(batch)).remote([q.data for q in batch])
+            with tracing.use(batch_ctx):
+                out = replica.handle_batch.options(
+                    num_returns=len(batch)).remote([q.data for q in batch])
             refs = [out] if len(batch) == 1 else list(out)
             if not shadow:
                 for q, ref in zip(batch, refs):

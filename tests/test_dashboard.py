@@ -70,5 +70,42 @@ def test_dashboard_endpoints(ray_start_regular):
     tail = get_json(f"/api/logs?node={node_id}&file={fname}&lines=5")
     assert isinstance(tail, str) and tail, tail
 
+    # observability endpoints: events ring, trace table, metrics history
+    events = get_json("/api/events")
+    assert any(e["label"] == "NODE_ADDED" for e in events), events
+
+    ray_tpu.set_trace_sampling(1.0)
+    try:
+        @ray_tpu.remote
+        def dash_traced():
+            return 1
+
+        assert ray_tpu.get(dash_traced.remote(), timeout=60) == 1
+        deadline = time.monotonic() + 20
+        trace = []
+        while time.monotonic() < deadline:
+            trace = get_json("/api/trace")
+            if any("dash_traced" in str(e.get("name")) for e in trace):
+                break
+            time.sleep(0.3)
+        assert any("dash_traced" in str(e.get("name")) for e in trace)
+        tid = next(e["args"]["tid"] for e in trace
+                   if "dash_traced" in str(e.get("name")))
+        one = get_json(f"/api/trace?trace_id={tid}")
+        slices = [e for e in one if e.get("ph") == "X"]
+        assert slices and all(e["args"]["tid"] == tid for e in slices)
+
+        hist = {}
+        while time.monotonic() < deadline:
+            hist = get_json("/api/metrics/history?samples=3")
+            if hist:
+                break
+            time.sleep(0.3)
+        assert hist and all(
+            isinstance(series, list)
+            for rings in hist.values() for series in rings.values())
+    finally:
+        ray_tpu.set_trace_sampling(0.01)
+
     with urllib.request.urlopen(base + "/", timeout=10) as r:
         assert b"ray_tpu cluster" in r.read()

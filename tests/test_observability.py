@@ -123,3 +123,614 @@ def test_event_log_files(tmp_path):
     assert e["source_type"] == "TEST"
     # reset so other tests' global state is clean
     ev.init_events("unknown", "", None)
+
+
+# ---------------------------------------------------------------------------
+# distributed tracing (tracing.py): causally-linked spans across every hop
+# ---------------------------------------------------------------------------
+
+
+def _wait_spans(pred, timeout=20.0):
+    """Poll the GCS trace table until `pred(spans)` returns truthy
+    (spans flush on the ~2s cadence, sooner after task completion)."""
+    deadline = time.monotonic() + timeout
+    spans = []
+    while time.monotonic() < deadline:
+        spans = ray_tpu.trace_spans()
+        got = pred(spans)
+        if got:
+            return got
+        time.sleep(0.25)
+    raise AssertionError(
+        f"trace spans never matched; have "
+        f"{[(s['event_type'], s['component_type']) for s in spans]}")
+
+
+def _tree_of(spans, tid):
+    return [s for s in spans if s["extra_data"].get("tid") == tid]
+
+
+def _assert_connected(tree):
+    """Every span's parent link resolves inside the tree, and exactly
+    one root exists — i.e. ONE causally-connected tree, not islands."""
+    sids = {s["extra_data"]["sid"] for s in tree}
+    roots = [s for s in tree
+             if s["extra_data"].get("psid", "") not in sids]
+    assert len(roots) == 1, (
+        f"expected one root, got {[(r['event_type']) for r in roots]}")
+    return roots[0]
+
+
+def test_task_trace_tree_spans_three_processes(ray_start_regular):
+    """A sampled multi-arg remote task yields ONE connected span tree
+    crossing driver -> raylet -> worker, exported to Perfetto JSON with
+    cross-process flow arrows."""
+    ray_tpu.set_trace_sampling(1.0)
+    try:
+        @ray_tpu.remote
+        def combine(a, b, c):
+            return a + b + c
+
+        assert ray_tpu.get(combine.remote(1, 2, 3), timeout=60) == 6
+
+        def have_tree(spans):
+            for s in spans:
+                if (s["event_type"] == "task.e2e"
+                        and s["extra_data"].get("name", "").endswith(
+                            "combine")):
+                    tree = _tree_of(spans, s["extra_data"]["tid"])
+                    procs = {(t["component_type"], t["component_id"])
+                             for t in tree}
+                    if len(procs) >= 3:
+                        return tree
+            return None
+
+        tree = _wait_spans(have_tree)
+        root = _assert_connected(tree)
+        assert root["event_type"] == "task.e2e"
+        kinds = {t["component_type"] for t in tree}
+        assert {"driver", "raylet", "worker"} <= kinds, kinds
+        # every hop of the round trip is represented
+        names = {t["event_type"] for t in tree}
+        assert {"task.e2e", "task.queue_wait", "raylet.lease",
+                "task"} <= names, names
+
+        # Perfetto export: the spans appear with flow-link ('s'/'f')
+        # pairs keyed by child span id
+        trace = ray_tpu.timeline()
+        sids = {t["extra_data"]["sid"] for t in tree}
+        starts = {e["id"] for e in trace if e.get("ph") == "s"}
+        finishes = {e["id"] for e in trace if e.get("ph") == "f"}
+        linked = sids & starts & finishes
+        assert linked, "no flow links for the task tree in the export"
+    finally:
+        ray_tpu.set_trace_sampling(0.01)
+
+
+def test_serve_http_trace_tree_spans_three_processes(ray_start_regular):
+    """One HTTP request through proxy -> router -> replica -> nested
+    task = ONE connected tree spanning >=3 processes (the composition
+    pattern: a replica fanning out to a downstream remote function)."""
+    import urllib.request
+
+    from ray_tpu import serve
+
+    ray_tpu.set_trace_sampling(1.0)
+    client = serve.start()
+    try:
+        @ray_tpu.remote
+        def embed(x):
+            return {"embedded": x}
+
+        def model(data=None):
+            import ray_tpu as rt
+
+            return rt.get(embed.remote(7), timeout=30)
+
+        client.create_backend("model", model)
+        client.create_endpoint("model", backend="model", route="/model",
+                               methods=["GET"])
+        port = client.enable_http()
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/model", timeout=30) as r:
+            assert b"embedded" in r.read()
+
+        def have_tree(spans):
+            for s in spans:
+                if s["event_type"] == "http.request":
+                    tree = _tree_of(spans, s["extra_data"]["tid"])
+                    procs = {(t["component_type"], t["component_id"])
+                             for t in tree}
+                    if len(procs) >= 3:
+                        return tree
+            return None
+
+        tree = _wait_spans(have_tree)
+        root = _assert_connected(tree)
+        assert root["event_type"] == "http.request"
+        names = {t["event_type"] for t in tree}
+        assert "serve.router_queue" in names, names
+        procs = {(t["component_type"], t["component_id"]) for t in tree}
+        assert len(procs) >= 3, procs
+        # the filtered query surface returns exactly this tree
+        tid = root["extra_data"]["tid"]
+        only = ray_tpu.trace_spans(tid)
+        assert {s["extra_data"]["sid"] for s in only} == {
+            s["extra_data"]["sid"] for s in tree}
+    finally:
+        client.shutdown()
+        ray_tpu.set_trace_sampling(0.01)
+
+
+def test_trace_sampling_live_override(ray_start_regular):
+    """set_trace_sampling rides the KV+pubsub plane: rate 0 stops new
+    roots cluster-wide, rate 1.0 (set LIVE, no restarts) traces the next
+    call."""
+    ray_tpu.set_trace_sampling(0.0)
+    try:
+        @ray_tpu.remote
+        def quiet():
+            return 1
+
+        @ray_tpu.remote
+        def loud():
+            return 2
+
+        assert ray_tpu.get(quiet.remote(), timeout=60) == 1
+        time.sleep(2.5)  # a flush cycle
+        assert not any(
+            s["extra_data"].get("name", "").endswith("quiet")
+            for s in ray_tpu.trace_spans()), "rate 0 still minted a root"
+
+        ray_tpu.set_trace_sampling(1.0)
+        assert ray_tpu.get(loud.remote(), timeout=60) == 2
+        _wait_spans(lambda spans: [
+            s for s in spans
+            if s["extra_data"].get("name", "").endswith("loud")])
+    finally:
+        ray_tpu.set_trace_sampling(0.01)
+
+
+# ---------------------------------------------------------------------------
+# metrics time series (GCS ring) + per-hop histograms
+# ---------------------------------------------------------------------------
+
+
+def test_metrics_history_accumulates_samples(ray_start_regular):
+    """A counter incremented between pushes shows >=2 distinct
+    timestamped samples in api.cluster_metrics(history=...)."""
+    c = stats.Count("obs_test.history_counter")
+    c.inc(5)
+
+    def series():
+        hist = ray_tpu.cluster_metrics(history=10)
+        for source, rings in hist.items():
+            if "driver" in source and "obs_test.history_counter" in rings:
+                return rings["obs_test.history_counter"]
+        return []
+
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline and len(series()) < 1:
+        time.sleep(0.3)
+    c.inc(2)
+    while time.monotonic() < deadline:
+        ss = series()
+        if len(ss) >= 2 and ss[-1][1] > ss[0][1]:
+            break
+        time.sleep(0.3)
+    ss = series()
+    assert len(ss) >= 2, f"history never got 2 samples: {ss}"
+    ts = [t for t, _ in ss]
+    assert ts == sorted(ts) and ts[0] < ts[-1]
+    assert ss[0][1] == 5.0 and ss[-1][1] == 7.0, ss
+
+
+def test_per_hop_histograms_feed_history(ray_start_regular):
+    """The task-path latency histograms (always on, no sampling needed)
+    land in the time-series ring as .count/.sum/.p99 scalar series —
+    the feed the serve autoscaler consumes."""
+    @ray_tpu.remote
+    def tick():
+        return 1
+
+    assert ray_tpu.get([tick.remote() for _ in range(5)],
+                       timeout=60) == [1] * 5
+    snap = stats.snapshot()
+    assert snap["core.task_e2e_s"]["count"] >= 5
+    assert snap["core.task_queue_wait_s"]["count"] >= 5
+    p99 = stats.percentile(snap["core.task_e2e_s"], 0.99)
+    assert p99 > 0
+
+    deadline = time.monotonic() + 15
+    found = {}
+    while time.monotonic() < deadline:
+        hist = ray_tpu.cluster_metrics(history=5)
+        for source, rings in hist.items():
+            if "driver" in source and "core.task_e2e_s.p99" in rings:
+                found = rings
+        if found:
+            break
+        time.sleep(0.3)
+    assert "core.task_e2e_s.count" in found and \
+        "core.task_e2e_s.sum" in found, sorted(found)[:20]
+
+
+def test_stats_snapshot_lock_consistency():
+    """Hammer test for the satellite fix: Histogram.snapshot() and
+    Gauge.set() take the metric lock, so a snapshot can never observe a
+    torn (counts, sum, n) triple mid-observe()."""
+    import threading
+
+    h = stats.Histogram("obs_test.hammer_hist",
+                        boundaries=[0.001, 0.01, 0.1, 1.0])
+    g = stats.Gauge("obs_test.hammer_gauge")
+    stop = threading.Event()
+
+    def pound():
+        while not stop.is_set():
+            h.observe(0.005)
+            g.set(3.0)
+            g.add(1.0)
+
+    threads = [threading.Thread(target=pound, daemon=True)
+               for _ in range(3)]
+    for t in threads:
+        t.start()
+    try:
+        for _ in range(400):
+            snap = h.snapshot()
+            # invariants a torn read breaks: bucket counts sum to n,
+            # and every observation contributed exactly 0.005 to sum
+            assert sum(snap["counts"]) == snap["count"]
+            assert abs(snap["sum"] - snap["count"] * 0.005) < 1e-9, snap
+            gv = g.snapshot()["value"]
+            assert gv >= 3.0 or gv == 0.0
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=5)
+
+
+def test_profile_buffer_requeue_bounded_and_counted():
+    """Satellite: a failed GCS flush requeues the drained batch at the
+    front (retried next cycle); only bound-evicted events are lost, and
+    those are counted in profiling.events_dropped_total."""
+    from ray_tpu._private import profiling
+
+    buf = profiling.ProfileBuffer("test", maxlen=4)
+    base = profiling.M_EVENTS_DROPPED.snapshot()["value"]
+    for i in range(3):
+        buf.record("e", float(i), float(i) + 1, {"i": i})
+    events = buf.drain()
+    assert len(buf) == 0 and len(events) == 3
+    # failed flush: everything fits back, in original order, ahead of
+    # newer events
+    assert buf.requeue(events) == 0
+    buf.record("tail", 9.0, 10.0)
+    replay = buf.drain()
+    assert [e["extra_data"].get("i") for e in replay] == [0, 1, 2, None]
+    # overflowing requeue keeps the NEWEST events and counts the drops
+    big = [{"event_type": "x", "start_time": float(i),
+            "end_time": float(i) + 1, "extra_data": {"i": i}}
+           for i in range(6)]
+    assert buf.requeue(big) == 2
+    kept = buf.drain()
+    assert [e["extra_data"]["i"] for e in kept] == [2, 3, 4, 5]
+    assert profiling.M_EVENTS_DROPPED.snapshot()["value"] - base == 2
+
+
+# ---------------------------------------------------------------------------
+# events.py: forwarder -> GCS ring -> API round trip + degradation
+# ---------------------------------------------------------------------------
+
+
+def test_event_forwarder_roundtrip_and_severity_filter(
+        ray_start_regular, tmp_path):
+    """Satellite: an event reported with a GCS forwarder lands in the
+    cluster ring (readable via cluster_events and /api/events), severity
+    filtering works, and a DEAD forwarder degrades to local-file-only
+    without raising in the reporting process."""
+    from ray_tpu._private import events as ev
+    from ray_tpu._private import global_state
+
+    cw = global_state.require_core_worker()
+
+    def forward(event):
+        cw._io.run(cw.gcs.call("report_event", event))
+
+    ev.init_events("TESTSRC", "t1", str(tmp_path), forward=forward)
+    try:
+        ev.report_event(ev.ERROR, "OBS_TEST_ERR", "boom", k=1)
+        ev.report_event(ev.INFO, "OBS_TEST_INFO", "fine")
+
+        errs = ray_tpu.cluster_events(severity="ERROR")
+        assert any(e["label"] == "OBS_TEST_ERR" for e in errs), errs
+        assert not any(e["label"] == "OBS_TEST_INFO" for e in errs)
+        assert any(e["label"] == "OBS_TEST_INFO"
+                   for e in ray_tpu.cluster_events())
+        # forwarded copy preserved source identity + custom fields
+        mine = next(e for e in errs if e["label"] == "OBS_TEST_ERR")
+        assert mine["source_type"] == "TESTSRC"
+        assert mine["custom_fields"] == {"k": 1}
+
+        # dead forwarder: must NOT raise, must still write the file
+        def dead(event):
+            raise ConnectionError("gcs unreachable")
+
+        ev.init_events("TESTDEAD", "t2", str(tmp_path), forward=dead)
+        ev.report_event(ev.WARNING, "LOCAL_ONLY", "still recorded")
+        local = ev.read_events(str(tmp_path), "TESTDEAD")
+        assert len(local) == 1 and local[0]["label"] == "LOCAL_ONLY"
+        assert not any(e["label"] == "LOCAL_ONLY"
+                       for e in ray_tpu.cluster_events())
+    finally:
+        ev.init_events("unknown", "", None)
+
+
+# ---------------------------------------------------------------------------
+# CI gates: metric-name drift + microbench tracing overhead
+# ---------------------------------------------------------------------------
+
+
+def _referenced_metric_names() -> set[str]:
+    """Metric names the docs/dashboard promise: every `_total`-suffixed
+    backticked token anywhere in ARCHITECTURE.md, plus the first
+    backticked token of each row of the Observability section's metrics
+    table (marked `<!-- metrics-registry-check -->`)."""
+    import os
+    import re
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    text = open(os.path.join(root, "ARCHITECTURE.md")).read()
+    names = set(re.findall(r"`([a-z]+\.[a-z0-9_.]*_total)`", text))
+    marker = "<!-- metrics-registry-check -->"
+    if marker in text:
+        section = text.split(marker, 1)[1]
+        for line in section.splitlines():
+            if line.startswith("<!-- end"):
+                break
+            m = re.match(r"\|\s*`([a-z]+\.[a-z0-9_.]+)`", line)
+            if m:
+                names.add(m.group(1))
+    return {re.sub(r"\.(count|sum|p99)$", "", n) for n in names}
+
+
+def test_metric_name_drift_gate(ray_start_regular):
+    """Tier-1 drift gate (satellite): every metric name referenced in
+    ARCHITECTURE.md exists in the live registry — a renamed or deleted
+    counter fails here instead of silently breaking dashboards."""
+    # register every metric-bearing module + exercise the task path so
+    # instance metrics exist
+    import ray_tpu.serve.http_proxy   # noqa: F401
+    import ray_tpu.serve.replica      # noqa: F401
+    import ray_tpu.serve.router       # noqa: F401
+    from ray_tpu._private import profiling  # noqa: F401
+    from ray_tpu.raylet import transfer     # noqa: F401
+
+    @ray_tpu.remote
+    def poke():
+        return 1
+
+    assert ray_tpu.get(poke.remote(), timeout=60) == 1
+
+    live = set(stats.snapshot())
+    cm = ray_tpu.cluster_metrics()
+    live |= set(cm["gcs"])
+    for snap in cm["raylets"].values():
+        live |= set(snap)
+
+    referenced = _referenced_metric_names()
+    assert referenced, "no metric names found in ARCHITECTURE.md"
+    missing = sorted(referenced - live)
+    assert not missing, (
+        f"ARCHITECTURE.md references metrics missing from the live "
+        f"registry (renamed/deleted?): {missing}")
+
+
+def test_microbench_tracing_overhead_gate():
+    """Gate on the recorded interleaved tracing-on/off A/B rows: >5%
+    throughput regression with default sampling on the tasks-sync or
+    serve-http row fails tier-1 (reads MICROBENCH.json — deterministic,
+    no benchmarking in CI)."""
+    import json
+    import os
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    doc = json.load(open(os.path.join(root, "MICROBENCH.json")))
+    rows = {r["name"]: r for r in doc["results"]}
+    for case in ("tracing A/B tasks sync", "tracing A/B serve http qps"):
+        on_name, off_name = case, f"{case} (tracing-off control)"
+        assert on_name in rows and off_name in rows, (
+            f"missing tracing A/B row {case!r} in MICROBENCH.json")
+        on, off = rows[on_name], rows[off_name]
+        if on.get("high_variance") or off.get("high_variance"):
+            continue  # window noise, not signal (see timeit docstring)
+        assert on["per_second"] >= 0.95 * off["per_second"], (
+            f"{case}: tracing-on {on['per_second']:.1f}/s is >5% below "
+            f"tracing-off {off['per_second']:.1f}/s")
+
+
+# ---------------------------------------------------------------------------
+# failure injection through the new seams
+# ---------------------------------------------------------------------------
+
+
+def test_trace_flush_failure_bounded_and_retried(ray_start_regular):
+    """trace.flush failpoint (models an unreachable GCS): flushes fail
+    silently-but-typed, the local buffer stays bounded (drops counted),
+    tasks keep completing, and disarming lets the retained spans reach
+    the GCS on the next cycle."""
+    from ray_tpu._private import failpoints as fp
+    from ray_tpu._private import global_state
+
+    ray_tpu.set_trace_sampling(1.0)
+    try:
+        fp.configure("trace.flush=raise")
+
+        @ray_tpu.remote
+        def survivor():
+            return 1
+
+        for _ in range(3):
+            assert ray_tpu.get(survivor.remote(), timeout=60) == 1
+        time.sleep(2.5)  # let a flush cycle fail
+        cw = global_state.require_core_worker()
+        assert 0 < len(cw._profile) <= 20_000
+        assert not any(
+            s["component_type"] == "driver"
+            and s["extra_data"].get("name", "").endswith("survivor")
+            for s in ray_tpu.trace_spans()), \
+            "driver flush should have been failing"
+
+        fp.configure("")  # GCS "reachable" again -> requeued batch lands
+        _wait_spans(lambda spans: [
+            s for s in spans
+            if s["component_type"] == "driver"
+            and s["extra_data"].get("name", "").endswith("survivor")])
+    finally:
+        fp.configure("")
+        ray_tpu.set_trace_sampling(0.01)
+
+
+def test_gcs_trace_table_apply_failpoint(ray_start_regular):
+    """gcs.trace_table.apply=raise: the GCS drops the batch with a typed
+    counter instead of crashing; client-side flushing is unaffected."""
+    from ray_tpu._private import failpoints as fp
+
+    ray_tpu.set_trace_sampling(1.0)
+    try:
+        fp.arm_cluster("gcs.trace_table.apply=raise")
+
+        @ray_tpu.remote
+        def dropped():
+            return 1
+
+        assert ray_tpu.get(dropped.remote(), timeout=60) == 1
+        time.sleep(2.5)
+        cm = ray_tpu.cluster_metrics()
+        fp.arm_cluster("")
+        assert cm["gcs"].get("gcs.trace_apply_failures_total",
+                             {}).get("value", 0) >= 1
+        # cluster recovered: fresh spans apply again
+        @ray_tpu.remote
+        def landed():
+            return 2
+
+        assert ray_tpu.get(landed.remote(), timeout=60) == 2
+        _wait_spans(lambda spans: [
+            s for s in spans
+            if s["extra_data"].get("name", "").endswith("landed")])
+    finally:
+        fp.arm_cluster("")
+        ray_tpu.set_trace_sampling(0.01)
+
+
+@pytest.mark.chaos
+def test_chaos_gcs_killed_mid_flush(ray_start_regular):
+    """Seeded chaos case (satellite): the GCS dies while traced work is
+    flushing spans + metrics at 100% sampling. Required: no hang, no
+    unbounded buffer growth, and full recovery once the node monitor
+    restarts the GCS."""
+    from ray_tpu import api as _api
+    from ray_tpu._private import global_state
+
+    node = _api._global_node
+    ray_tpu.set_trace_sampling(1.0)
+    try:
+        @ray_tpu.remote
+        def work(i):
+            return i
+
+        assert ray_tpu.get([work.remote(i) for i in range(10)],
+                           timeout=60) == list(range(10))
+        old_pid = next(s.proc.pid for s in node.processes
+                       if s.name == "gcs_server")
+        node.kill_gcs()
+        # GCS down: tasks must still complete (driver->raylet->worker
+        # path does not touch it) and flush failures must stay bounded
+        for i in range(10):
+            assert ray_tpu.get(work.remote(i), timeout=60) == i
+        cw = global_state.require_core_worker()
+        assert len(cw._profile) <= 20_000
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            gcs = next((s for s in node.processes
+                        if s.name == "gcs_server"), None)
+            if gcs is not None and gcs.alive() and gcs.proc.pid != old_pid:
+                break
+            time.sleep(0.2)
+        else:
+            raise TimeoutError("GCS was not restarted")
+
+        @ray_tpu.remote
+        def after():
+            return "back"
+
+        assert ray_tpu.get(after.remote(), timeout=60) == "back"
+        # spans recorded after the restart reach the (fresh) trace table
+        _wait_spans(lambda spans: [
+            s for s in spans
+            if s["extra_data"].get("name", "").endswith("after")],
+            timeout=30)
+    finally:
+        ray_tpu.set_trace_sampling(0.01)
+
+
+# ---------------------------------------------------------------------------
+# CLI surfaces: ray-tpu trace / ray-tpu top
+# ---------------------------------------------------------------------------
+
+
+def test_cli_trace_export_and_top(ray_start_regular, tmp_path, capsys):
+    import json
+
+    from ray_tpu import api as _api
+    from ray_tpu.scripts import cli
+
+    addr = _api._global_node.gcs_address
+    ray_tpu.set_trace_sampling(1.0)
+    try:
+        @ray_tpu.remote
+        def cli_traced():
+            return 1
+
+        assert ray_tpu.get(cli_traced.remote(), timeout=60) == 1
+        # wait for the DRIVER-side root too (flushes a cycle after the
+        # worker's exec span) so the export has a linkable tree
+        _wait_spans(lambda spans: [
+            s for s in spans
+            if s["event_type"] == "task.e2e"
+            and s["extra_data"].get("name", "").endswith("cli_traced")
+            and len(_tree_of(spans, s["extra_data"]["tid"])) >= 2])
+
+        out = tmp_path / "trace.json"
+        assert cli.main(["trace", "--address", addr,
+                         "--out", str(out)]) == 0
+        data = json.loads(out.read_text())
+        assert any("cli_traced" in str(e.get("name")) for e in data)
+        assert any(e.get("ph") == "s" for e in data), "no flow links"
+
+        # single-tree filter
+        tid = next(s["extra_data"]["tid"] for s in ray_tpu.trace_spans()
+                   if s["extra_data"].get("name", "").endswith(
+                       "cli_traced"))
+        one = tmp_path / "one.json"
+        assert cli.main(["trace", "--address", addr, "--trace-id", tid,
+                         "--out", str(one)]) == 0
+        data1 = json.loads(one.read_text())
+        slices = [e for e in data1 if e.get("ph") == "X"]
+        assert slices and all(e["args"].get("tid") == tid for e in slices)
+
+        # top: history needs a push cycle; poll until a sample lands
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            if ray_tpu.cluster_metrics(history=1):
+                break
+            time.sleep(0.3)
+        capsys.readouterr()
+        assert cli.main(["top", "--address", addr,
+                         "--iterations", "1"]) == 0
+        top_out = capsys.readouterr().out
+        assert "ray-tpu top" in top_out and "raylet" in top_out, top_out
+    finally:
+        ray_tpu.set_trace_sampling(0.01)
