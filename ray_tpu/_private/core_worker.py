@@ -65,6 +65,10 @@ M_EXEC_HOPS = _stats.Count(
     "core.exec_hops_total", "dispatcher/executor thread handoffs")
 M_LEASE_REQUESTS = _stats.Count(
     "core.lease_requests_total", "worker-lease request RPCs issued")
+M_LEASE_RPCS = _stats.Count(
+    "core.lease_rpcs_total",
+    "owner-issued request_worker_lease RPCs, counting every spillback "
+    "redial (the raylet->raylet forwarding win shows up here)")
 
 # Per-hop latency histograms derived from the task path (always on —
 # these, via the raylet's metric merge, are the feed the serve replica
@@ -219,6 +223,10 @@ class CoreWorker:
         # actors
         self.actor_clients: dict[bytes, _ActorClient] = {}
 
+        # placement-group waiters parked on the pg pubsub channel
+        # (io-loop-confined): pg_id -> [future resolved with the record]
+        self._pg_waiters: dict[bytes, list] = {}
+
         # function registry
         self._fn_cache: dict[bytes, Any] = {}
         self._exported: set[bytes] = set()
@@ -246,6 +254,9 @@ class CoreWorker:
 
         # connections
         self.raylet: rpc.Connection | None = None
+        # tcp form, as raylets advertise each other (grant `granted_by`
+        # addresses compare against this to spot remote-granted leases)
+        self.raylet_address = raylet_address
         self.gcs: rpc.Connection | None = None
         self._peer_conns: dict[str, rpc.Connection] = {}
         # io-loop-confined per-address dial locks: without them a burst
@@ -343,14 +354,23 @@ class CoreWorker:
                         self._apply_actor_update(info)
                         await self._flush_actor_queue(client)
 
-            self.gcs = rpc.ReconnectingConnection(
-                gcs_address, name="cw->gcs", on_reconnect=_gcs_reconnected,
+            from ray_tpu.gcs.client import GcsClient
+
+            director = rpc.ReconnectingConnection(
+                self._maybe_uds(gcs_address),
+                name="cw->gcs", on_reconnect=_gcs_reconnected,
                 retry_timeout=self.config.gcs_reconnect_timeout_s,
                 # a worker is spawned into a RUNNING cluster: a dead GCS
                 # at bootstrap means the cluster is gone — die fast
                 # (the raylet respawns workers if it's actually alive)
                 # instead of lingering 10s as an un-registered orphan
                 dial_timeout=(3.0 if self.mode == WORKER else 10.0))
+            # Sharded control plane: key-partitioned table ops (KV,
+            # object directory, actor/pg reads) route shard-direct; the
+            # director keeps membership/pubsub/scheduling. With
+            # gcs_shards=1 (default) this is a pure passthrough.
+            self.gcs = GcsClient(director, self.config,
+                                 uds_dir=self._uds_dir())
             self.gcs.set_push_handler(self._on_gcs_push)
             await self.gcs.ensure_connected()
             # Live fault-injection plane: failpoints armed through the
@@ -1082,6 +1102,7 @@ class CoreWorker:
             target_addr = None  # None = local raylet
             hops = 0
             while True:
+                M_LEASE_RPCS.inc()
                 reply = await target.call("request_worker_lease",
                                           {"spec": spec, "hops": hops,
                                            "count": count, "soft": soft})
@@ -1094,10 +1115,12 @@ class CoreWorker:
             grants = reply.get("grants")
             if grants is None:
                 grants = [reply] if reply.get("granted") else []
+            grants = await self._claim_forwarded_grants(grants)
             for grant in grants:
                 conn = await self._peer(grant["worker_address"])
                 lease = _Lease(grant["lease_id"], grant["worker_id"],
-                               grant["worker_address"], conn, target,
+                               grant["worker_address"], conn,
+                               grant.pop("_raylet_conn", None) or target,
                                task_conn=await self._task_channel_conn(
                                    grant.get("task_channel")))
                 self.leases.setdefault(key, []).append(lease)
@@ -1122,23 +1145,33 @@ class CoreWorker:
                 self._soft_backoff[key] = time.monotonic() + 0.2
                 asyncio.get_running_loop().call_later(
                     0.25, self._maybe_request_leases, key)
-            if grants and target_addr is not None and self.raylet is not None:
-                # Spilled-back lease: the task will run on a remote node
-                # while its plasma args live here. Hint our raylet to
-                # start pushing them so the transfer overlaps with task
-                # dispatch (PushManager parity, reference:
-                # push_manager.h:29 — dedup happens receiver-side).
-                # Purely an optimization: a hint failure must never fail
-                # the (already granted) lease.
+            remote_granters = {g.get("granted_by") for g in grants
+                               if g.get("granted_by")}
+            remote_granters.discard(self.raylet_address)
+            if target_addr is not None and any(
+                    not g.get("granted_by") for g in grants):
+                # granted_by names the true executor; only fall back to
+                # the redial target for replies that predate the field —
+                # a raylet that merely FORWARDED the request must not
+                # receive arg pushes for a task it will never run
+                remote_granters.add(target_addr)
+            if grants and remote_granters and self.raylet is not None:
+                # Spilled-back lease (owner redial OR a raylet→raylet
+                # forwarded grant — `granted_by` names the true node):
+                # the task will run on a remote node while its plasma
+                # args live here. Hint our raylet to start pushing them
+                # so the transfer overlaps with task dispatch
+                # (PushManager parity, reference: push_manager.h:29 —
+                # dedup happens receiver-side). Purely an optimization:
+                # a hint failure must never fail the granted lease.
                 try:
                     arg_ids = [a["id"] for a in spec.get("args", [])
                                if a.get("kind") == "ref"
                                and a.get("plasma")]
-                    if arg_ids:
+                    for addr in remote_granters if arg_ids else ():
                         self._io.submit(self.raylet.notify(
                             "push_objects_to",
-                            {"object_ids": arg_ids,
-                             "target": target_addr}))
+                            {"object_ids": arg_ids, "target": addr}))
                 except Exception:
                     pass
         except Exception as e:
@@ -1159,6 +1192,40 @@ class CoreWorker:
             self._ensure_lease_reaper()
         await self._drain_pending(key)
 
+    async def _claim_forwarded_grants(self, grants: list[dict]) -> list[dict]:
+        """Adopt leases granted by a REMOTE raylet for a forwarded
+        (spillback-chain) request. Such grants arrive over the chain
+        holder-less — the granting raylet parks them in its unadopted
+        set; claiming them over OUR connection (adopt_leases) re-arms
+        holder-death reclaim exactly as for a direct grant, and pins the
+        connection return_worker must use (`_raylet_conn`). A grant the
+        granting raylet already reaped (we took longer than its adoption
+        deadline) is dropped here; the lease retry timer re-requests."""
+        claim: dict[str, list[dict]] = {}
+        out = []
+        for g in grants:
+            if g.pop("adopt", False):
+                claim.setdefault(g["granted_by"], []).append(g)
+            else:
+                out.append(g)
+        for addr, gs in claim.items():
+            try:
+                conn = await self._peer(addr)
+                reply = await conn.call(
+                    "adopt_leases",
+                    {"lease_ids": [g["lease_id"] for g in gs]})
+                adopted = set(reply.get("adopted") or ())
+            except Exception as e:
+                logger.warning("adopting %d spillback lease(s) at %s "
+                               "failed (%s); dropping them", len(gs),
+                               addr, e)
+                continue
+            for g in gs:
+                if g["lease_id"] in adopted:
+                    g["_raylet_conn"] = conn
+                    out.append(g)
+        return out
+
     async def _maybe_request_lease(self, key, spec):
         # Round-7 control arm (RAY_TPU_TASK_LEGACY): one outstanding
         # single-lease hard request per scheduling key at a time.
@@ -1168,17 +1235,35 @@ class CoreWorker:
         try:
             target = self.raylet
             hops = 0
+            attempts = 0
             while True:
+                M_LEASE_RPCS.inc()
                 reply = await target.call("request_worker_lease",
                                           {"spec": spec, "hops": hops})
                 if reply.get("spillback"):
                     target = await self._peer(reply["spillback"])
                     hops = int(reply.get("hops", hops + 1))
                     continue
-                break
+                claimed = await self._claim_forwarded_grants([reply])
+                if claimed:
+                    reply = claimed[0]
+                    break
+                # adoption raced the granting raylet's unadopted deadline
+                # (or its dial transiently failed): the lease is back in
+                # that raylet's idle pool — re-request instead of failing
+                # a healthy cluster's tasks
+                attempts += 1
+                if attempts >= 3:
+                    raise exc.WorkerCrashedError(
+                        "spillback lease reclaimed before adoption "
+                        f"({attempts} attempts)")
+                target = self.raylet
+                hops = 0
+                await asyncio.sleep(0.1 * attempts)
             conn = await self._peer(reply["worker_address"])
             lease = _Lease(reply["lease_id"], reply["worker_id"],
-                           reply["worker_address"], conn, target)
+                           reply["worker_address"], conn,
+                           reply.pop("_raylet_conn", None) or target)
             self.leases.setdefault(key, []).append(lease)
         except Exception as e:
             pending = self._pending_by_key.pop(key, [])
@@ -1637,8 +1722,15 @@ class CoreWorker:
         }))
 
     def get_cluster_metrics(self) -> dict:
-        """GCS + per-raylet metric snapshots, merged."""
-        out = {"gcs": self._io.run(self.gcs.call("get_metrics", {}))}
+        """GCS (+ store shards) + per-raylet metric snapshots, merged."""
+        async def _gcs_and_shards():
+            return await asyncio.gather(self.gcs.call("get_metrics", {}),
+                                        self.gcs.shard_metrics())
+
+        gcs_snap, shards = self._io.run(_gcs_and_shards())
+        out = {"gcs": gcs_snap}
+        if shards:
+            out["gcs_shards"] = shards
 
         async def _node_metrics():
             nodes = await self.gcs.call("get_all_nodes", {})
@@ -1678,6 +1770,14 @@ class CoreWorker:
             return
         if channel == tracing.CHANNEL:
             tracing.apply_kv_value(data)
+            return
+        if channel.startswith("pg:"):
+            # placement-group transition (CREATED / REMOVED): wake every
+            # parked wait_placement_group with the published record
+            pg_id = data.get("pg_id")
+            for fut in self._pg_waiters.get(pg_id, []):
+                if not fut.done():
+                    fut.set_result(data)
             return
         if channel.startswith("actor:"):
             self._apply_actor_update(data)
@@ -1978,6 +2078,75 @@ class CoreWorker:
     def get_placement_group(self, pg_id: bytes):
         return self._io.run(self.gcs.call("get_placement_group",
                                           {"pg_id": pg_id}))
+
+    def wait_placement_group(self, pg_id: bytes,
+                             timeout: float | None = None):
+        """Park until the placement group reaches a terminal-ish state
+        (CREATED, or removal) — event-driven on the GCS `pg:<hex>`
+        pubsub channel instead of the old 20ms client busy-poll. The
+        publish payload carries the full public record (mirror-then-
+        publish ordering, gcs/server.py), so the common path never even
+        reads back. A slow exponential re-poll (0.1s -> 1s) backstops a
+        publish lost to a GCS restart. Returns the record, None if
+        `timeout` elapsed first, or raises ValueError if removed."""
+        async def _wait():
+            channel = f"pg:{pg_id.hex()}"
+            fut: asyncio.Future = asyncio.get_running_loop().create_future()
+            self._pg_waiters.setdefault(pg_id, []).append(fut)
+            await self.gcs.call("subscribe", {"channel": channel})
+            deadline = (None if timeout is None
+                        else time.monotonic() + timeout)
+            poll = 0.1
+            try:
+                # subscribe raced the transition: read once up front
+                # (shard-routed; mirrors are pushed before the publish)
+                info = await self.gcs.call("get_placement_group",
+                                           {"pg_id": pg_id})
+                while True:
+                    if info is None or info.get("state") == "REMOVED":
+                        raise ValueError(
+                            f"placement group {pg_id.hex()} was removed")
+                    if info.get("state") == "CREATED":
+                        return info
+                    remaining = poll
+                    if deadline is not None:
+                        remaining = min(remaining,
+                                        deadline - time.monotonic())
+                        if remaining <= 0:
+                            return None
+                    try:
+                        info = await asyncio.wait_for(
+                            asyncio.shield(fut), remaining)
+                    except asyncio.TimeoutError:
+                        # backstop re-poll for a lost publish
+                        poll = min(poll * 2, 1.0)
+                        info = await self.gcs.call("get_placement_group",
+                                                   {"pg_id": pg_id})
+                        continue
+                    if fut.done():
+                        # drop the consumed future BEFORE re-arming, or
+                        # every event-driven wakeup would leak it in the
+                        # waiter list (and the finally below would never
+                        # see the list empty -> never unsubscribe)
+                        stale = self._pg_waiters.get(pg_id, [])
+                        if fut in stale:
+                            stale.remove(fut)
+                        fut = asyncio.get_running_loop().create_future()
+                        self._pg_waiters.setdefault(pg_id, []).append(fut)
+            finally:
+                waiters = self._pg_waiters.get(pg_id)
+                if waiters is not None:
+                    if fut in waiters:
+                        waiters.remove(fut)
+                    if not waiters:
+                        self._pg_waiters.pop(pg_id, None)
+                        try:
+                            await self.gcs.call("unsubscribe",
+                                                {"channel": channel})
+                        except Exception:
+                            pass
+
+        return self._io.run(_wait())
 
     def get_named_placement_group(self, name: str):
         return self._io.run(self.gcs.call("get_named_placement_group",

@@ -125,7 +125,8 @@ def _spawn(cmd: list[str], config: Config, name: str) -> ServiceProcess:
     return ServiceProcess(name, proc)
 
 
-def start_gcs(session_dir: str, config: Config, port: int = 0) -> tuple[ServiceProcess, str]:
+def start_gcs(session_dir: str, config: Config, port: int = 0,
+              shard_addresses: list[str] | None = None) -> tuple[ServiceProcess, str]:
     ready = os.path.join(session_dir, f"gcs_ready_{uuid.uuid4().hex[:6]}")
     log_file = os.path.join(session_dir, "logs", "gcs_server.log")
     cmd = [
@@ -136,18 +137,61 @@ def start_gcs(session_dir: str, config: Config, port: int = 0) -> tuple[ServiceP
     ]
     if config.gcs_persistence:
         cmd += ["--store-dir", os.path.join(session_dir, "gcs_store")]
+    if shard_addresses:
+        cmd += ["--shard-addresses", ",".join(shard_addresses)]
+    cmd += ["--uds-dir", os.path.join(session_dir, "sock")]
     svc = _spawn(cmd, config, "gcs_server")
     actual_port = _wait_ready(ready, svc.proc, "gcs_server")
     return svc, f"{config.node_ip_address}:{actual_port}"
 
 
-def restart_gcs(session_dir: str, config: Config,
-                gcs_address: str) -> ServiceProcess:
+def start_gcs_shard(session_dir: str, config: Config, index: int,
+                    port: int = 0) -> tuple[ServiceProcess, str]:
+    """Spawn one GCS store shard (gcs/shard.py). A restart reuses the
+    same port + journal dir, so client-side key routing never remaps."""
+    ready = os.path.join(session_dir,
+                         f"gcs_shard_ready_{index}_{uuid.uuid4().hex[:6]}")
+    log_file = os.path.join(session_dir, "logs", f"gcs_shard_{index}.log")
+    cmd = [
+        sys.executable, "-m", "ray_tpu.gcs.shard",
+        "--index", str(index),
+        "--port", str(port),
+        "--ready-file", ready,
+        "--log-file", log_file,
+    ]
+    if config.gcs_persistence:
+        cmd += ["--store-dir",
+                os.path.join(session_dir, f"gcs_shard_{index}")]
+    cmd += ["--uds-dir", os.path.join(session_dir, "sock")]
+    svc = _spawn(cmd, config, f"gcs_shard_{index}")
+    actual_port = _wait_ready(ready, svc.proc, f"gcs_shard_{index}")
+    svc.shard_index = index
+    svc.shard_port = int(actual_port)
+    return svc, f"{config.node_ip_address}:{actual_port}"
+
+
+def start_gcs_shards(session_dir: str,
+                     config: Config) -> tuple[list[ServiceProcess], list[str]]:
+    """Spawn the store-shard tier (config.gcs_shards processes; none at
+    the default of 1 — single-GCS layout preserved)."""
+    if config.gcs_shards <= 1:
+        return [], []
+    procs, addrs = [], []
+    for i in range(config.gcs_shards):
+        svc, addr = start_gcs_shard(session_dir, config, i)
+        procs.append(svc)
+        addrs.append(addr)
+    return procs, addrs
+
+
+def restart_gcs(session_dir: str, config: Config, gcs_address: str,
+                shard_addresses: list[str] | None = None) -> ServiceProcess:
     """Bring a (crashed) GCS back on its old port against its persisted
     store, so clients' redial loops land on a server that remembers them
     (reference: test_gcs_fault_tolerance.py restart path)."""
     port = int(gcs_address.rsplit(":", 1)[1])
-    svc, _addr = start_gcs(session_dir, config, port)
+    svc, _addr = start_gcs(session_dir, config, port,
+                           shard_addresses=shard_addresses)
     return svc
 
 
@@ -200,9 +244,16 @@ class Node:
         self.session_dir = session_dir or new_session_dir()
         self.processes: list[ServiceProcess] = []
         self.is_head = gcs_address is None
+        self.shard_addresses: list[str] = []
         if gcs_address is None:
-            gcs_proc, gcs_address = start_gcs(self.session_dir, config,
-                                              config.gcs_port)
+            # Store-shard tier first (the director advertises their
+            # addresses via get_shard_map); none at gcs_shards=1.
+            shard_procs, self.shard_addresses = start_gcs_shards(
+                self.session_dir, config)
+            self.processes.extend(shard_procs)
+            gcs_proc, gcs_address = start_gcs(
+                self.session_dir, config, config.gcs_port,
+                shard_addresses=self.shard_addresses)
             self.processes.append(gcs_proc)
         self.gcs_address = gcs_address
         raylet_proc, raylet_addr, node_id, store_root = start_raylet(
@@ -228,6 +279,7 @@ class Node:
         def _watch():
             while not self._stopping:
                 time.sleep(0.5)
+                self._respawn_dead_shards()
                 gcs = next((s for s in self.processes
                             if s.name == "gcs_server"), None)
                 if gcs is None or self._stopping:
@@ -239,7 +291,8 @@ class Node:
                                    gcs.proc.returncode, self.gcs_address)
                     try:
                         new = restart_gcs(self.session_dir, self.config,
-                                          self.gcs_address)
+                                          self.gcs_address,
+                                          shard_addresses=self.shard_addresses)
                     except Exception:
                         logger.exception("GCS restart failed")
                         continue
@@ -260,6 +313,38 @@ class Node:
         threading.Thread(target=_watch, name="gcs-monitor",
                          daemon=True).start()
 
+    def _respawn_dead_shards(self):
+        """Restart crashed store shards on their FIXED ports against
+        their journals (journal replay restores the partition's tables;
+        clients' per-shard ReconnectingConnections redial the same
+        address, so key routing never remaps)."""
+        for i, svc in enumerate(list(self.processes)):
+            if (self._stopping or not svc.name.startswith("gcs_shard_")
+                    or svc.alive()):
+                continue
+            index = getattr(svc, "shard_index", None)
+            port = getattr(svc, "shard_port", 0)
+            if index is None:
+                continue
+            logger.warning("GCS shard %d exited (rc=%s); restarting on "
+                           "port %d", index, svc.proc.returncode, port)
+            try:
+                new, _addr = start_gcs_shard(self.session_dir, self.config,
+                                             index, port=port)
+            except Exception:
+                logger.exception("GCS shard %d restart failed", index)
+                continue
+            if self._stopping:
+                new.kill()
+                continue
+            try:
+                self.processes[self.processes.index(svc)] = new
+            except ValueError:
+                if self._stopping:
+                    new.kill()
+                else:
+                    self.processes.append(new)
+
     def kill_all_processes(self):
         self._stopping = True
         for svc in reversed(self.processes):
@@ -271,6 +356,13 @@ class Node:
         by the monitor when gcs_auto_restart is on)."""
         for svc in self.processes:
             if svc.name == "gcs_server":
+                svc.kill()
+
+    def kill_gcs_shard(self, index: int = 0):
+        """Fault injection: kill one store shard (auto-restarted by the
+        monitor when gcs_auto_restart is on)."""
+        for svc in self.processes:
+            if getattr(svc, "shard_index", None) == index:
                 svc.kill()
 
     def kill_raylet(self):

@@ -12,6 +12,8 @@ from ray_tpu._private.node import (
     ServiceProcess,
     new_session_dir,
     start_gcs,
+    start_gcs_shard,
+    start_gcs_shards,
     start_raylet,
 )
 
@@ -36,10 +38,15 @@ class Cluster:
         self.session_dir = new_session_dir()
         self.gcs_svc = None
         self.gcs_address = None
+        self.shard_procs: list[ServiceProcess] = []
+        self.shard_addresses: list[str] = []
         self.nodes: list[ClusterNode] = []
         if initialize_head:
-            self.gcs_svc, self.gcs_address = start_gcs(
+            self.shard_procs, self.shard_addresses = start_gcs_shards(
                 self.session_dir, self.config)
+            self.gcs_svc, self.gcs_address = start_gcs(
+                self.session_dir, self.config,
+                shard_addresses=self.shard_addresses)
             self.add_node(is_head=True, **(head_node_args or {}))
 
     @property
@@ -79,6 +86,20 @@ class Cluster:
             config=self.config,
         )
 
+    def kill_shard(self, index: int) -> ServiceProcess:
+        """Fault injection: kill one store shard. restart_shard() brings
+        it back on the same port against its journal."""
+        svc = self.shard_procs[index]
+        svc.kill()
+        return svc
+
+    def restart_shard(self, index: int) -> ServiceProcess:
+        old = self.shard_procs[index]
+        svc, _addr = start_gcs_shard(self.session_dir, self.config, index,
+                                     port=old.shard_port)
+        self.shard_procs[index] = svc
+        return svc
+
     def shutdown(self):
         for node in reversed(self.nodes):
             node.kill()
@@ -86,3 +107,6 @@ class Cluster:
         if self.gcs_svc is not None:
             self.gcs_svc.kill()
             self.gcs_svc = None
+        for svc in self.shard_procs:
+            svc.kill()
+        self.shard_procs.clear()

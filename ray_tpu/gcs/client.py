@@ -1,0 +1,229 @@
+"""Sharded GCS client: deterministic key→shard routing in the caller.
+
+Wraps the director connection every process already holds and fans the
+key-partitioned table ops (KV, object directory, actor/pg reads) out to
+the store shards (shard.py) directly — in steady state the director
+never sees them, so control-plane throughput scales with shard count
+instead of serializing through one event loop (Ray §4.1; ROADMAP 2).
+
+Routing is `crc32(key) % nshards` (shard_for) — every process computes
+the same owner for a key with no directory lookup on the op path. The
+shard map (addresses, fixed across shard restarts) is fetched once from
+the director (`get_shard_map`) and cached. `RAY_TPU_GCS_SHARDS=1` (the
+default) yields an empty map and this wrapper passes everything through
+to the director — today's single-process layout, byte-identical.
+
+Director-owned ops keep their single home: membership + heartbeats,
+scheduling, placement 2PC, pubsub (`subscribe`/`publish` and every push),
+jobs, events/profile/trace/metrics tables, and the `ray_tpu:` control
+keys (failpoint arming, trace sampling) whose writes must fan out on the
+director's pubsub plane.
+
+Shard connections are rpc.ReconnectingConnection — a shard restarted by
+the node monitor (same port, journal replay) is transparently redialed
+and idempotent ops retried, exactly like the director today.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import zlib
+
+from ray_tpu._private import rpc
+
+# Director-owned control keys (failpoints, trace sampling): their kv_put
+# must run WHERE the pubsub plane lives.
+CONTROL_KEY_PREFIX = "ray_tpu:"
+
+
+def shard_for(key, nshards: int) -> int:
+    """Deterministic key→shard index; identical in every process."""
+    if isinstance(key, str):
+        key = key.encode()
+    return zlib.crc32(key) % nshards
+
+
+def _kv_key(d):
+    key = d["key"]
+    return None if key.startswith(CONTROL_KEY_PREFIX) else key
+
+
+# method -> key extractor; None routes to the director
+_ROUTED = {
+    "kv_put": _kv_key,
+    "kv_get": _kv_key,
+    "kv_del": _kv_key,
+    "kv_exists": _kv_key,
+    "add_object_location": lambda d: d["object_id"],
+    "remove_object_location": lambda d: d["object_id"],
+    "get_object_locations": lambda d: d["object_id"],
+    "get_actor": lambda d: d["actor_id"],
+    "get_placement_group": lambda d: d["pg_id"],
+}
+
+
+class GcsClient:
+    """Drop-in facade over the director connection (same call/notify/
+    subscribe surface), adding shard routing. Must be used from one
+    event loop (the process's io loop), like the connection it wraps."""
+
+    def __init__(self, director, config=None, uds_dir: str | None = None):
+        self.director = director
+        self._config = config
+        # same-node fast path: when the shard's sibling UDS socket exists
+        # under this dir, dial it instead of loopback TCP (rpc.prefer_uds
+        # — remote shards pass through untouched)
+        self._uds_dir = uds_dir
+        self._shard_addrs: list[str] | None = None
+        self._shards: dict[int, rpc.ReconnectingConnection] = {}
+        self._map_lock: asyncio.Lock | None = None
+
+    # -- shard discovery -------------------------------------------------
+
+    async def _addresses(self) -> list[str]:
+        if self._shard_addrs is not None:
+            return self._shard_addrs
+        if self._map_lock is None:
+            self._map_lock = asyncio.Lock()
+        async with self._map_lock:
+            if self._shard_addrs is None:
+                reply = await self.director.call("get_shard_map", {})
+                self._shard_addrs = list((reply or {}).get("addresses", []))
+        return self._shard_addrs
+
+    async def _shard_conn(self, idx: int) -> rpc.ReconnectingConnection:
+        conn = self._shards.get(idx)
+        if conn is None:
+            addrs = await self._addresses()
+            retry = (self._config.gcs_reconnect_timeout_s
+                     if self._config is not None else 30.0)
+            local_ips = ("127.0.0.1",) + (
+                (self._config.node_ip_address,)
+                if self._config is not None else ())
+            conn = self._shards[idx] = rpc.ReconnectingConnection(
+                rpc.prefer_uds(addrs[idx], self._uds_dir,
+                               local_ips=local_ips),
+                name=f"->gcs-shard{idx}", retry_timeout=retry)
+        return conn
+
+    async def _route(self, method: str, data):
+        """Connection owning this op, or the director."""
+        extract = _ROUTED.get(method)
+        if extract is None:
+            return self.director
+        key = extract(data)
+        if key is None:
+            return self.director
+        addrs = await self._addresses()
+        if not addrs:
+            return self.director
+        return await self._shard_conn(shard_for(key, len(addrs)))
+
+    # -- call surface ----------------------------------------------------
+
+    async def call(self, method: str, data=None, timeout: float | None = None):
+        if method == "get_object_locations_batch":
+            return await self._batch_locations(data, timeout)
+        if method == "kv_keys":
+            return await self._kv_keys(data, timeout)
+        conn = await self._route(method, data)
+        reply = await conn.call(method, data, timeout)
+        if (reply is None and conn is not self.director
+                and method in ("get_actor", "get_placement_group")):
+            # Mirror miss: the director's push is best-effort (a shard
+            # mid-restart loses it until the reconnect resync), so None
+            # from a MIRROR is "not visible here yet", not "removed" —
+            # only the owning director's answer is authoritative enough
+            # for callers that treat None as removal (pg.ready()).
+            reply = await self.director.call(method, data, timeout)
+        return reply
+
+    async def notify(self, method: str, data=None):
+        conn = await self._route(method, data)
+        await conn.notify(method, data)
+
+    async def _batch_locations(self, data, timeout):
+        addrs = await self._addresses()
+        if not addrs:
+            return await self.director.call("get_object_locations_batch",
+                                            data, timeout)
+        by_shard: dict[int, list] = {}
+        for oid in data["object_ids"]:
+            by_shard.setdefault(shard_for(oid, len(addrs)), []).append(oid)
+        if len(by_shard) == 1:
+            idx, oids = next(iter(by_shard.items()))
+            conn = await self._shard_conn(idx)
+            return await conn.call("get_object_locations_batch",
+                                   {"object_ids": oids}, timeout)
+        async def one(idx, oids):
+            conn = await self._shard_conn(idx)
+            return await conn.call("get_object_locations_batch",
+                                   {"object_ids": oids}, timeout)
+
+        parts = await asyncio.gather(
+            *[one(idx, oids) for idx, oids in by_shard.items()])
+        out = {}
+        for part in parts:
+            out.update(part or {})
+        return out
+
+    async def _kv_keys(self, data, timeout):
+        addrs = await self._addresses()
+        if not addrs:
+            return await self.director.call("kv_keys", data, timeout)
+        conns = [await self._shard_conn(i) for i in range(len(addrs))]
+        parts = await asyncio.gather(
+            self.director.call("kv_keys", data, timeout),
+            *[c.call("kv_keys", data, timeout) for c in conns])
+        seen: dict = dict.fromkeys(k for part in parts for k in (part or ()))
+        return list(seen)
+
+    async def barrier(self) -> None:
+        """One ping per live connection (director + every dialed shard):
+        frames are read in order per connection, so the replies arriving
+        means every previously sent frame — including notify()s, which
+        carry no reply of their own — has been dispatched server-side."""
+        conns = [self.director, *self._shards.values()]
+        await asyncio.gather(*(c.call("ping", {}) for c in conns))
+
+    async def shard_metrics(self) -> dict[str, dict]:
+        """Per-shard metric snapshots keyed by address (cluster_metrics).
+        Concurrent: a dead shard costs one 2s timeout, not one each."""
+        addrs = await self._addresses()
+
+        async def one(i):
+            try:
+                conn = await self._shard_conn(i)
+                return await conn.call("get_metrics", {}, timeout=2.0)
+            except Exception:
+                return {}
+
+        snaps = await asyncio.gather(*(one(i) for i in range(len(addrs))))
+        return dict(zip(addrs, snaps))
+
+    # -- passthrough (director) -----------------------------------------
+
+    async def push(self, channel: str, data=None):
+        await self.director.push(channel, data)
+
+    def set_push_handler(self, fn):
+        self.director.set_push_handler(fn)
+
+    async def ensure_connected(self):
+        return await self.director.ensure_connected()
+
+    @property
+    def closed(self) -> bool:
+        return self.director.closed
+
+    @property
+    def context(self):
+        return self.director.context
+
+    async def close(self):
+        for conn in self._shards.values():
+            try:
+                await conn.close()
+            except Exception:
+                pass
+        await self.director.close()

@@ -50,9 +50,19 @@ DEAD = "DEAD"
 
 
 class GcsServer:
-    def __init__(self, config: Config, storage=None):
+    def __init__(self, config: Config, storage=None,
+                 shard_addresses: list[str] | None = None):
         self.config = config
         self.storage = storage
+        # Store-shard tier (gcs/shard.py): the director advertises the
+        # addresses (get_shard_map) so clients key-route table ops
+        # directly, and keeps its own connection per shard to push
+        # actor/pg directory mirrors, node-death prunes, and live
+        # failpoint arming. Empty = single-process layout (shards=1).
+        self.shard_addresses = list(shard_addresses or [])
+        self._shard_conns: list = [None] * len(self.shard_addresses)
+        # sibling-UDS dir (run() fills it): local shard dials skip TCP
+        self._uds_dir: str | None = None
         self.kv: dict[str, bytes] = {}
         self.subscriptions: dict[str, set[rpc.Connection]] = {}
         # node_id(bytes) -> node info dict
@@ -203,8 +213,81 @@ class GcsServer:
             "report_event": self.h_report_event,
             "get_events": self.h_get_events,
             "get_metrics": self.h_get_metrics,
+            "get_shard_map": self.h_get_shard_map,
             "ping": lambda conn, data: "pong",
         }
+
+    # ---- store-shard tier ----
+    async def h_get_shard_map(self, conn, d):
+        """Addresses of the store shards, in index order — the client-
+        side routing table (gcs/client.py shard_for)."""
+        return {"addresses": self.shard_addresses}
+
+    async def _shard_conn(self, idx: int):
+        conn = self._shard_conns[idx]
+        if conn is None:
+            async def _resync(c, idx=idx):
+                await self._resync_shard(idx, c)
+
+            conn = rpc.ReconnectingConnection(
+                rpc.prefer_uds(self.shard_addresses[idx], self._uds_dir),
+                name=f"gcs->shard{idx}", on_reconnect=_resync,
+                retry_timeout=self.config.gcs_reconnect_timeout_s)
+            self._shard_conns[idx] = conn
+        return conn
+
+    def _shard_index_for(self, key) -> int:
+        from ray_tpu.gcs.client import shard_for
+
+        return shard_for(key, len(self.shard_addresses))
+
+    async def _resync_shard(self, idx: int, conn):
+        """Re-push everything the director owns that this shard mirrors:
+        actor/pg public records in its partition, plus live failpoint /
+        trace-sampling specs. Runs at startup and after every shard
+        reconnect, so a shard restarted WHILE a mirror push was lost
+        still converges (its journal already replayed the rest)."""
+        records = []
+        for actor_id, rec in self.actors.items():
+            if self._shard_index_for(actor_id) == idx:
+                records.append(["actors", actor_id, self._actor_public(rec)])
+        for pg_id, rec in self.placement_groups.items():
+            if self._shard_index_for(pg_id) == idx:
+                records.append(["pgs", pg_id, _pg_public(rec)])
+        if records:
+            await conn.call("mirror_apply", {"records": records})
+        spec = self.kv.get(_fp.KV_KEY)
+        if spec:
+            await conn.notify("configure_failpoints", {"spec": spec})
+
+    async def _mirror(self, table: str, key, value):
+        """Push one actor/pg public record (value=None deletes) to the
+        owning shard. Best-effort with a short bound: a shard mid-restart
+        must not stall scheduling — the reconnect resync repairs it."""
+        if not self.shard_addresses:
+            return
+        conn = await self._shard_conn(self._shard_index_for(key))
+        try:
+            await asyncio.wait_for(
+                conn.call("mirror_apply",
+                          {"records": [[table, key, value]]}),
+                timeout=2.0)
+        except Exception:
+            logger.warning("mirror push to shard lost (%s); reconnect "
+                           "resync will repair", table)
+
+    async def _broadcast_shards(self, method: str, data):
+        async def one(idx):
+            try:
+                conn = await self._shard_conn(idx)
+                await asyncio.wait_for(conn.call(method, data), timeout=2.0)
+            except Exception:
+                logger.warning("shard %d broadcast %r failed", idx, method)
+
+        # concurrent: callers like _remove_node gate failover on this —
+        # serial 2s timeouts would stack per unreachable shard
+        await asyncio.gather(*(one(i)
+                               for i in range(len(self.shard_addresses))))
 
     # ---- kv ----
     async def h_kv_put(self, conn, d):
@@ -215,9 +298,13 @@ class GcsServer:
         self._persist("kv", key, d["value"])
         if key == _fp.KV_KEY:
             # live fault-injection arming: apply here, broadcast to every
-            # subscribed raylet/worker/driver (failpoints.arm_cluster)
+            # subscribed raylet/worker/driver (failpoints.arm_cluster),
+            # and forward to the store shards (they don't subscribe)
             _fp.apply_kv_value(d["value"])
             await self.publish(_fp.CHANNEL, d["value"])
+            if self.shard_addresses:
+                await self._broadcast_shards(
+                    "configure_failpoints", {"spec": d["value"]})
         elif key == _tracing.KV_KEY:
             # live trace-sampling override (ray_tpu.set_trace_sampling):
             # same apply-here + broadcast plane as the failpoints
@@ -399,6 +486,10 @@ class GcsServer:
         await self.publish("nodes", {"event": "removed",
                                      "node": _node_public(info),
                                      "reason": reason})
+        if self.shard_addresses:
+            # the object-directory partitions live on the shards: drop
+            # every location entry naming the dead node
+            await self._broadcast_shards("prune_node", {"node_id": node_id})
         # Fail or restart actors that lived on this node.
         for actor_id, rec in list(self.actors.items()):
             if rec.get("node_id") == node_id and rec["state"] in (ALIVE, PENDING_CREATION):
@@ -482,6 +573,7 @@ class GcsServer:
         }
         self.actors[actor_id] = rec
         self._persist_actor(rec)
+        await self._mirror("actors", actor_id, self._actor_public(rec))
         await self._schedule_actor(actor_id)
         return self._actor_public(rec)
 
@@ -598,6 +690,9 @@ class GcsServer:
                 actor_id=rec["actor_id"].hex(),
                 class_name=rec["spec"]["name"])
         self._persist_actor(rec)
+        # mirror BEFORE the publish: a subscriber poked awake by the push
+        # must read back at-least-as-fresh state from the owning shard
+        await self._mirror("actors", rec["actor_id"], self._actor_public(rec))
         await self.publish(f"actor:{rec['actor_id'].hex()}", self._actor_public(rec))
 
     def _actor_public(self, rec):
@@ -874,6 +969,8 @@ class GcsServer:
                 "name": d.get("name", ""),
             }
             self._persist_pg(self.placement_groups[pg_id])
+            await self._mirror("pgs", pg_id,
+                               _pg_public(self.placement_groups[pg_id]))
         return {"state": await self._try_create_pg(pg_id)}
 
     async def _retry_pending_pgs(self):
@@ -975,6 +1072,12 @@ class GcsServer:
             for i in range(len(bundles))
         ]
         self._persist_pg(rec)
+        # mirror-then-publish (same ordering rule as actors), then wake
+        # PlacementGroup.ready() waiters parked on the pg channel — the
+        # payload carries the full record so waiters don't even need the
+        # read-back
+        await self._mirror("pgs", pg_id, _pg_public(rec))
+        await self.publish(f"pg:{pg_id.hex()}", _pg_public(rec))
         return "CREATED"
 
     def _nodes_by_slice(self, node_ids):
@@ -1115,6 +1218,10 @@ class GcsServer:
     async def h_remove_placement_group(self, conn, d):
         self._persist_del("placement_groups", d["pg_id"])
         rec = self.placement_groups.pop(d["pg_id"], None)
+        if rec is not None:
+            await self._mirror("pgs", d["pg_id"], None)
+            await self.publish(f"pg:{d['pg_id'].hex()}",
+                               {"pg_id": d["pg_id"], "state": "REMOVED"})
         if rec and rec["state"] == "CREATED":
             for b in rec["bundles"]:
                 conn_n = self.node_conns.get(b["node_id"])
@@ -1149,10 +1256,29 @@ class GcsServer:
             # raylet connection means the process died — remove immediately.
             await self._remove_node(node_id, reason="raylet disconnected")
 
-    async def run(self, port: int, ready_file: str | None = None):
+    async def _connect_shards(self):
+        """Dial every store shard at startup and push an initial mirror
+        resync (a director restarted against its persisted tables
+        refreshes mirrors that may have gone stale while it was down;
+        reconnects after a shard restart resync via on_reconnect)."""
+        for idx in range(len(self.shard_addresses)):
+            try:
+                conn = await self._shard_conn(idx)
+                await conn.ensure_connected()
+                await self._resync_shard(idx, conn)
+            except Exception:
+                logger.warning("initial connect to shard %d failed "
+                               "(will keep redialing)", idx)
+
+    async def run(self, port: int, ready_file: str | None = None,
+                  uds_dir: str | None = None):
         cfg = get_config()
-        actual = await self.server.start_tcp(host=cfg.bind_host, port=port)
+        self._uds_dir = uds_dir
+        actual = await self.server.start_tcp(host=cfg.bind_host, port=port,
+                                             uds_dir=uds_dir)
         asyncio.create_task(self.heartbeat_checker())
+        if self.shard_addresses:
+            asyncio.create_task(self._connect_shards())
         logger.info("GCS listening on %s:%d (advertised %s)",
                     cfg.bind_host, actual, cfg.node_ip_address)
         if ready_file:
@@ -1171,6 +1297,10 @@ def _node_public(info):
         "tpu_slice")}
 
 
+def _pg_public(rec):
+    return {k: v for k, v in rec.items() if k != "creating"}
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--port", type=int, default=0)
@@ -1178,6 +1308,12 @@ def main():
     parser.add_argument("--log-file", default=None)
     parser.add_argument("--store-dir", default=None,
                         help="WAL+snapshot dir; enables persistence/restart")
+    parser.add_argument("--shard-addresses", default="",
+                        help="comma-separated store-shard addresses "
+                             "(index order; empty = unsharded)")
+    parser.add_argument("--uds-dir", default=None,
+                        help="serve a sibling UDS listener here (same-node "
+                             "clients skip the loopback-TCP tax)")
     args = parser.parse_args()
     from ray_tpu._private.log_utils import setup_process_logging
 
@@ -1193,8 +1329,11 @@ def main():
         from ray_tpu.gcs.storage import GcsStorage
 
         storage = GcsStorage(args.store_dir)
-    server = GcsServer(get_config(), storage=storage)
-    asyncio.run(server.run(args.port, args.ready_file))
+    shard_addresses = [a for a in args.shard_addresses.split(",") if a]
+    server = GcsServer(get_config(), storage=storage,
+                       shard_addresses=shard_addresses)
+    asyncio.run(server.run(args.port, args.ready_file,
+                           uds_dir=args.uds_dir))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,343 @@
+"""GCS store shard: one key-partition of the control-plane tables.
+
+The sharded control plane (Ray paper §4.1 analog, ROADMAP item 2) splits
+the GCS into a stateless-ish *director* (gcs/server.py — membership,
+scheduling, pubsub, placement) and N *store shards*, each owning a
+deterministic key-partition (client.shard_for) of the high-rate tables:
+
+- the KV store (every key except the director-owned `ray_tpu:` control
+  keys — failpoint arming and trace-sampling ride the director's pubsub),
+- the object directory (add/remove/get locations + the batched locality
+  lookup — the hottest steady-state op stream in the cluster),
+- read-only mirrors of the actor and placement-group directories (the
+  director owns the writes and pushes every public-record transition
+  here, so `get_actor` / `get_placement_group` polls scale with shard
+  count instead of serializing through the scheduler's event loop).
+
+Clients (core workers, raylets) route by key directly to the owning
+shard — steady-state ops never touch the director (gcs/client.py).
+
+Each shard persists through a snapshot + append-only journal
+(journal.py): a killed shard replays to its exact pre-kill tables in
+bounded time instead of waiting for raylet re-registration, and the node
+monitor restarts it on its fixed port so client routing never remaps.
+
+Failpoint seams: `gcs.shard.apply` before every mutating table apply
+(`raise` -> the client's ReconnectingConnection retries idempotently;
+`exit` kills the shard mid-workload — the chaos sweep's primary-kill),
+plus the journal's `gcs.journal.append` / `gcs.journal.replay`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import os
+
+import msgpack
+
+from ray_tpu._private import failpoints as _fp
+from ray_tpu._private import rpc
+from ray_tpu._private import stats as _stats
+from ray_tpu._private.config import Config, get_config, set_config
+from ray_tpu.gcs.journal import Journal
+
+logger = logging.getLogger("ray_tpu.gcs.shard")
+
+M_SHARD_OPS = _stats.Count(
+    "gcs.shard_ops_total", "table ops served by this store shard")
+M_SHARD_REPLAYS = _stats.Count(
+    "gcs.shard_journal_replays_total",
+    "journal records replayed at shard startup")
+
+
+class GcsShard:
+    def __init__(self, index: int, journal: Journal | None = None):
+        self.index = index
+        self.kv: dict[str, bytes] = {}
+        # oid -> {"nodes": set[bytes], "size": int}
+        self.object_locations: dict[bytes, dict] = {}
+        # director-pushed read mirrors
+        self.actors: dict[bytes, dict] = {}
+        self.placement_groups: dict[bytes, dict] = {}
+        self.journal = journal
+        self._flush_fut: asyncio.Future | None = None
+        if journal is not None:
+            replayed = journal.recover(self._apply_snapshot, self._apply)
+            if replayed:
+                M_SHARD_REPLAYS.inc(replayed)
+                logger.info("shard %d replayed %d journal records",
+                            index, replayed)
+        self.server = rpc.Server(self._handlers(), name=f"gcs-shard{index}")
+
+    # ---- state application (live ops and journal replay share this) ----
+
+    def _apply_snapshot(self, snap):
+        self.kv = dict(snap.get("kv", {}))
+        self.object_locations = {
+            oid: {"nodes": set(rec[0]), "size": rec[1]}
+            for oid, rec in snap.get("oloc", {}).items()}
+        self.actors = dict(snap.get("actors", {}))
+        self.placement_groups = dict(snap.get("pgs", {}))
+
+    def _state(self) -> dict:
+        return {
+            "kv": self.kv,
+            "oloc": {oid: [sorted(rec["nodes"]), rec["size"]]
+                     for oid, rec in self.object_locations.items()},
+            "actors": self.actors,
+            "pgs": self.placement_groups,
+        }
+
+    def canonical_state(self) -> bytes:
+        """Deterministic byte serialization of the full table state —
+        byte-equal across a kill + journal replay (the chaos sweep's
+        bit-identical restore check)."""
+        def canon(v):
+            if isinstance(v, dict):
+                return [[canon(k), canon(v[k])]
+                        for k in sorted(v, key=lambda x: (str(type(x)), x))]
+            if isinstance(v, (set, frozenset)):
+                return sorted(v)
+            if isinstance(v, (list, tuple)):
+                return [canon(x) for x in v]
+            return v
+
+        return msgpack.packb(canon(self._state()), use_bin_type=True)
+
+    def _apply(self, rec):
+        op = rec[0]
+        if op == "kv_put":
+            self.kv[rec[1]] = rec[2]
+        elif op == "kv_del":
+            self.kv.pop(rec[1], None)
+        elif op == "oloc_add":
+            entry = self.object_locations.setdefault(
+                rec[1], {"nodes": set(), "size": 0})
+            entry["nodes"].add(rec[2])
+            if rec[3]:
+                entry["size"] = int(rec[3])
+        elif op == "oloc_rem":
+            entry = self.object_locations.get(rec[1])
+            if entry:
+                entry["nodes"].discard(rec[2])
+                if not entry["nodes"]:
+                    del self.object_locations[rec[1]]
+        elif op == "mirror":
+            table = self.actors if rec[1] == "actors" else self.placement_groups
+            table[rec[2]] = rec[3]
+        elif op == "mirror_del":
+            table = self.actors if rec[1] == "actors" else self.placement_groups
+            table.pop(rec[2], None)
+        elif op == "prune":
+            for oid in [o for o, entry in self.object_locations.items()
+                        if rec[1] in entry["nodes"]]:
+                entry = self.object_locations[oid]
+                entry["nodes"].discard(rec[1])
+                if not entry["nodes"]:
+                    del self.object_locations[oid]
+
+    async def _mutate(self, rec):
+        """One mutating table op: failpoint seam, apply, group-commit
+        journal. The ack (handler return) is withheld until the record
+        is flushed to the OS — process-kill durable — but the flush is
+        COALESCED: every mutation in one event-loop batch shares a
+        single write syscall (_flush_batch) instead of paying one each,
+        which is what lets a shard's op rate scale past the legacy
+        per-op-flush ceiling."""
+        if _fp.ARMED:
+            # shard-apply seam: `raise` -> RemoteError at the caller,
+            # whose ReconnectingConnection/idempotent-op retries; `exit`
+            # kills this shard primary mid-apply (chaos sweep)
+            _fp.fire_strict("gcs.shard.apply")
+        M_SHARD_OPS.inc()
+        self._apply(rec)
+        if self.journal is not None:
+            self.journal.append_lazy(rec)
+            await self._group_flush()
+            self.journal.maybe_sync()
+            self.journal.maybe_compact(self._state)
+
+    def _group_flush(self) -> asyncio.Future:
+        """One journal flush per event-loop batch: the first mutation of
+        a tick schedules the flush via call_soon (running AFTER every
+        handler queued in this tick has appended), later mutations in
+        the same tick just await the shared future."""
+        fut = self._flush_fut
+        if fut is None or fut.done():
+            loop = asyncio.get_running_loop()
+            fut = self._flush_fut = loop.create_future()
+
+            def _flush_batch():
+                try:
+                    self.journal.flush()
+                    fut.set_result(None)
+                except Exception as e:  # full disk etc. -> typed error
+                    fut.set_exception(e)
+
+            loop.call_soon(_flush_batch)
+        return fut
+
+    # ---- handlers ----
+
+    def _handlers(self):
+        return {
+            "kv_put": self.h_kv_put,
+            "kv_get": self.h_kv_get,
+            "kv_del": self.h_kv_del,
+            "kv_exists": self.h_kv_exists,
+            "kv_keys": self.h_kv_keys,
+            "add_object_location": self.h_add_object_location,
+            "remove_object_location": self.h_remove_object_location,
+            "get_object_locations": self.h_get_object_locations,
+            "get_object_locations_batch": self.h_get_object_locations_batch,
+            "get_actor": self.h_get_actor,
+            "get_placement_group": self.h_get_placement_group,
+            "mirror_apply": self.h_mirror_apply,
+            "prune_node": self.h_prune_node,
+            "configure_failpoints": self.h_configure_failpoints,
+            "shard_snapshot": self.h_shard_snapshot,
+            "get_metrics": self.h_get_metrics,
+            "ping": lambda conn, d: "pong",
+        }
+
+    # kv — same wire surface as the director's handlers, so routing is
+    # invisible to callers
+    async def h_kv_put(self, conn, d):
+        key = d["key"]
+        if not d.get("overwrite", True) and key in self.kv:
+            return False
+        await self._mutate(["kv_put", key, d["value"]])
+        return True
+
+    async def h_kv_get(self, conn, d):
+        M_SHARD_OPS.inc()
+        return self.kv.get(d["key"])
+
+    async def h_kv_del(self, conn, d):
+        existed = d["key"] in self.kv
+        await self._mutate(["kv_del", d["key"]])
+        return existed
+
+    async def h_kv_exists(self, conn, d):
+        M_SHARD_OPS.inc()
+        return d["key"] in self.kv
+
+    async def h_kv_keys(self, conn, d):
+        prefix = d.get("prefix", "")
+        return [k for k in self.kv if k.startswith(prefix)]
+
+    # object directory partition
+    async def h_add_object_location(self, conn, d):
+        await self._mutate(["oloc_add", d["object_id"], d["node_id"],
+                      int(d.get("size") or 0)])
+        return True
+
+    async def h_remove_object_location(self, conn, d):
+        await self._mutate(["oloc_rem", d["object_id"], d["node_id"]])
+        return True
+
+    async def h_get_object_locations(self, conn, d):
+        M_SHARD_OPS.inc()
+        rec = self.object_locations.get(d["object_id"])
+        return list(rec["nodes"]) if rec else []
+
+    async def h_get_object_locations_batch(self, conn, d):
+        M_SHARD_OPS.inc()
+        out = {}
+        for oid in d["object_ids"]:
+            rec = self.object_locations.get(oid)
+            if rec:
+                out[oid] = {"nodes": list(rec["nodes"]),
+                            "size": rec["size"]}
+        return out
+
+    # directory mirrors (director-pushed)
+    async def h_get_actor(self, conn, d):
+        M_SHARD_OPS.inc()
+        return self.actors.get(d["actor_id"])
+
+    async def h_get_placement_group(self, conn, d):
+        M_SHARD_OPS.inc()
+        return self.placement_groups.get(d["pg_id"])
+
+    async def h_mirror_apply(self, conn, d):
+        """Director pushes actor/pg public records (single or bulk
+        resync after a shard restart). `value=None` deletes."""
+        for table, key, value in d["records"]:
+            if value is None:
+                await self._mutate(["mirror_del", table, key])
+            else:
+                await self._mutate(["mirror", table, key, value])
+        return True
+
+    async def h_prune_node(self, conn, d):
+        """Director broadcast on node death: drop every object location
+        entry naming the dead node (no copy there anymore)."""
+        await self._mutate(["prune", d["node_id"]])
+        return True
+
+    async def h_configure_failpoints(self, conn, d):
+        """Live fault-injection arming forwarded by the director (shards
+        don't subscribe to the pubsub plane — the director pushes the
+        spec here on every `ray_tpu:failpoints` KV write and on shard
+        reconnect)."""
+        _fp.apply_kv_value(d["spec"])
+        return True
+
+    async def h_shard_snapshot(self, conn, d):
+        """Canonical table-state bytes (chaos sweep bit-identical check)
+        + op counter."""
+        return {"state": self.canonical_state(),
+                "ops": M_SHARD_OPS.snapshot()["value"],
+                "index": self.index}
+
+    async def h_get_metrics(self, conn, d):
+        snap = _stats.snapshot()
+        snap["gcs.shard_kv_keys"] = {"type": "gauge", "value": len(self.kv)}
+        snap["gcs.shard_object_locations"] = {
+            "type": "gauge", "value": len(self.object_locations)}
+        return snap
+
+    async def run(self, port: int, ready_file: str | None = None,
+                  uds_dir: str | None = None):
+        cfg = get_config()
+        actual = await self.server.start_tcp(host=cfg.bind_host, port=port,
+                                             uds_dir=uds_dir)
+        logger.info("GCS shard %d listening on %s:%d", self.index,
+                    cfg.bind_host, actual)
+        if ready_file:
+            tmp = ready_file + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(str(actual))
+            os.rename(tmp, ready_file)
+        while True:
+            await asyncio.sleep(3600)
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--index", type=int, required=True)
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--ready-file", default=None)
+    parser.add_argument("--log-file", default=None)
+    parser.add_argument("--store-dir", default=None,
+                        help="journal+snapshot dir; enables recovery")
+    parser.add_argument("--uds-dir", default=None,
+                        help="serve a sibling UDS listener here (same-node "
+                             "clients skip the loopback-TCP tax)")
+    args = parser.parse_args()
+    from ray_tpu._private.log_utils import setup_process_logging
+
+    setup_process_logging(f"gcs_shard_{args.index}", args.log_file)
+    _fp.set_role("gcs")
+    set_config(Config.load())
+    journal = Journal(args.store_dir) if args.store_dir else None
+    shard = GcsShard(args.index, journal=journal)
+    asyncio.run(shard.run(args.port, args.ready_file,
+                          uds_dir=args.uds_dir))
+
+
+if __name__ == "__main__":
+    main()

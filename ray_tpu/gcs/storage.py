@@ -1,4 +1,4 @@
-"""GCS table storage: crash-safe persistence for the control plane.
+"""GCS table storage: crash-safe persistence for the director.
 
 Plays the role of the reference's GcsTableStorage over Redis/in-memory
 store clients (reference: src/ray/gcs/gcs_server/gcs_table_storage.h:294,
@@ -11,6 +11,10 @@ snapshot compaction under the session directory — no extra process, no
 network hop, fsync only on actor/PG state transitions (the records whose
 loss would strand live workers).
 
+Since the sharded control plane landed, the frame/snapshot engine lives
+in journal.py (the same engine every store shard persists through); this
+class is the table-shaped wrapper the director uses.
+
 File layout (under `<dir>/`):
     snapshot.bin   msgpack({table: {key: value}})   (atomic rename)
     wal.bin        appended msgpack frames [op, table, key, value]
@@ -21,13 +25,8 @@ snapshot and truncates the WAL once it outgrows `compact_bytes`.
 
 from __future__ import annotations
 
-import os
-import struct
-import threading
+from ray_tpu.gcs.journal import Journal
 
-import msgpack
-
-_HDR = struct.Struct(">I")
 PUT, DELETE = 0, 1
 
 
@@ -37,82 +36,35 @@ class GcsStorage:
 
     def __init__(self, dir_path: str, compact_bytes: int = 4 << 20):
         self.dir = dir_path
-        self.compact_bytes = compact_bytes
-        os.makedirs(dir_path, exist_ok=True)
-        self._snap_path = os.path.join(dir_path, "snapshot.bin")
-        self._wal_path = os.path.join(dir_path, "wal.bin")
-        self._lock = threading.Lock()
         self.tables: dict[str, dict] = {}
-        valid_end = self._load()
-        if valid_end is not None:
-            # A crash mid-append left a torn frame: cut it off BEFORE
-            # appending, or every later (valid) record would sit behind
-            # the garbage and be discarded on the next recovery.
-            with open(self._wal_path, "ab") as f:
-                f.truncate(valid_end)
-        self._wal = open(self._wal_path, "ab")
+        self.journal = Journal(dir_path, compact_bytes,
+                               journal_name="wal.bin")
+        self.journal.recover(self._apply_snapshot, self._apply_record)
 
-    # -- recovery ------------------------------------------------------
+    def _apply_snapshot(self, raw):
+        self.tables = {t: dict(kv) for t, kv in raw.items()}
 
-    def _load(self) -> int | None:
-        """Replay snapshot+WAL. Returns the WAL offset of a torn tail (to
-        truncate at), or None when the WAL is clean."""
-        if os.path.exists(self._snap_path):
-            with open(self._snap_path, "rb") as f:
-                raw = msgpack.unpackb(f.read(), raw=False, strict_map_key=False)
-            self.tables = {t: dict(kv) for t, kv in raw.items()}
-        if os.path.exists(self._wal_path):
-            with open(self._wal_path, "rb") as f:
-                data = f.read()
-            off = 0
-            while off + _HDR.size <= len(data):
-                (length,) = _HDR.unpack_from(data, off)
-                end = off + _HDR.size + length
-                if end > len(data):
-                    return off  # torn tail from a crash mid-append
-                try:
-                    op, table, key, value = msgpack.unpackb(
-                        data[off + _HDR.size:end], raw=False,
-                        strict_map_key=False)
-                except Exception:
-                    if end == len(data):
-                        return off  # last frame garbled: tail crash
-                    # Corruption MID-file with valid (possibly fsynced)
-                    # records after it: truncating would silently destroy
-                    # durable state — fail loudly instead.
-                    raise RuntimeError(
-                        f"GCS WAL corrupt at offset {off} with "
-                        f"{len(data) - end} bytes after it; refusing to "
-                        f"auto-truncate (inspect {self._wal_path})")
-                tbl = self.tables.setdefault(table, {})
-                if op == PUT:
-                    tbl[key] = value
-                else:
-                    tbl.pop(key, None)
-                off = end
-            if off != len(data):
-                return off  # trailing partial header
-        return None
+    def _apply_record(self, rec):
+        op, table, key, value = rec
+        tbl = self.tables.setdefault(table, {})
+        if op == PUT:
+            tbl[key] = value
+        else:
+            tbl.pop(key, None)
 
     # -- mutation ------------------------------------------------------
 
-    def _append(self, op: int, table: str, key, value, sync: bool):
-        body = msgpack.packb([op, table, key, value], use_bin_type=True)
-        with self._lock:
-            self._wal.write(_HDR.pack(len(body)) + body)
-            self._wal.flush()
-            if sync:
-                os.fsync(self._wal.fileno())
-            if self._wal.tell() > self.compact_bytes:
-                self._compact_locked()
-
     def put(self, table: str, key, value, sync: bool = False):
         self.tables.setdefault(table, {})[key] = value
-        self._append(PUT, table, key, value, sync)
+        self.journal.append([PUT, table, key, value], sync=sync)
+        self.journal.maybe_sync()
+        self.journal.maybe_compact(lambda: self.tables)
 
     def delete(self, table: str, key, sync: bool = False):
         self.tables.setdefault(table, {}).pop(key, None)
-        self._append(DELETE, table, key, None, sync)
+        self.journal.append([DELETE, table, key, None], sync=sync)
+        self.journal.maybe_sync()
+        self.journal.maybe_compact(lambda: self.tables)
 
     def get(self, table: str, key, default=None):
         return self.tables.get(table, {}).get(key, default)
@@ -122,25 +74,8 @@ class GcsStorage:
 
     # -- compaction ----------------------------------------------------
 
-    def _compact_locked(self):
-        tmp = self._snap_path + ".tmp"
-        with open(tmp, "wb") as f:
-            f.write(msgpack.packb(self.tables, use_bin_type=True))
-            f.flush()
-            os.fsync(f.fileno())
-        os.rename(tmp, self._snap_path)
-        self._wal.close()
-        self._wal = open(self._wal_path, "wb")
-
     def compact(self):
-        with self._lock:
-            self._compact_locked()
+        self.journal.compact(self.tables)
 
     def close(self):
-        with self._lock:
-            try:
-                self._wal.flush()
-                os.fsync(self._wal.fileno())
-                self._wal.close()
-            except Exception:
-                pass
+        self.journal.close()

@@ -304,6 +304,7 @@ def main(seconds_per_case: float = 2.0) -> list[dict]:
     ray_tpu.shutdown()
 
     _cross_node_bench(results)
+    _control_plane(results)
     return results
 
 
@@ -775,6 +776,55 @@ def _tracing_ab(results: list[dict]):
     ], windows=5)
     pool.shutdown()
     serve.shutdown()
+
+
+def _control_plane(results: list[dict], shards: int = 4):
+    """Sharded-control-plane scale-sim rows (scalesim/harness.py): 16
+    spoofed raylets over 3 client processes drive the steady-state
+    table-op mix and scheduler-decision stream against a real
+    director+shards plane, paired-interleaved per window against the
+    single-shard legacy arm (median of 5 windows), with a seeded
+    mid-window SIGKILL+journal-replay restart of one shard.
+
+    Besides the two rates, each row carries the **director-bypass**
+    check — per-arm server CPU from /proc normalized per op. On boxes
+    with fewer than shards+2 cores (this 2-core box included) the
+    wall-clock rates UNDERSTATE the sharded plane: every extra server
+    process multiplies per-tick socket syscalls (~0.4ms each under
+    gVisor) on the same two cores, so the legacy arm's single perfectly-
+    coalesced connection wins the transport race while its director
+    burns ~14x the CPU per op. The scaling claim rides
+    `director_cpu_us_per_op` (the single-process ceiling collapsing),
+    not the same-box rate ratio; see PERF.md round 11."""
+    from ray_tpu.scalesim.harness import run_scalesim
+
+    sim = run_scalesim(shards=shards, raylets=16, windows=5,
+                       window_s=1.0, client_procs=3, kill_shard=True)
+    for label in (f"shards{shards}", "shards1"):
+        arm = sim["arms"][label]
+        suffix = ("" if label != "shards1"
+                  else " (single-shard legacy control)")
+        for kind, key in (("gcs ops", "gcs_ops_per_s"),
+                          ("scheduler decisions", "decisions_per_s")):
+            stat = arm[key]
+            trials = stat["samples"]
+            mean = sum(trials) / len(trials)
+            sd = (sum((t - mean) ** 2 for t in trials)
+                  / max(len(trials) - 1, 1)) ** 0.5
+            row = {"name": f"control_plane {kind}{suffix}",
+                   "per_second": stat["median"], "sd": round(sd, 2),
+                   "trials": trials,
+                   "director_cpu_us_per_op":
+                       arm["director_cpu_us_per_op"]}
+            if kind == "gcs ops" and not suffix:
+                row["director_bypass_ratio"] = sim[
+                    "director_bypass_ratio"]
+                row["cores"] = sim["cores"]
+                row["shard_kill"] = sim["kill"]
+            results.append(row)
+            print(f"{row['name']} per second "
+                  f"{row['per_second']:.1f} "
+                  f"(director {row['director_cpu_us_per_op']}us/op)")
 
 
 if __name__ == "__main__":

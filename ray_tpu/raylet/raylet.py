@@ -113,6 +113,13 @@ class Raylet:
         self.m_locality_spillbacks = stats.Count(
             "raylet.locality_spillbacks_total",
             "lease requests redirected to the node holding their args")
+        self.m_spillback_forwards = stats.Count(
+            "raylet.spillback_forwards_total",
+            "lease requests forwarded raylet->raylet instead of bounced "
+            "back to the owner")
+        self.m_spillback_grants = stats.Count(
+            "raylet.spillback_grants_total",
+            "leases granted here for a forwarded (spillback-chain) request")
         self.m_lease_grant_s = stats.Histogram(
             "raylet.lease_grant_s", stats.LATENCY_BOUNDARIES_S,
             "lease request arrival -> grant (queue + worker startup)")
@@ -131,6 +138,12 @@ class Raylet:
         # scheduling
         self._lease_seq = 0
         self.pending_leases: list[tuple[dict, asyncio.Future]] = []
+        # lease_id -> monotonic deadline for grants made on behalf of a
+        # FORWARDED request (spillback chain): the true holder (the task
+        # owner) claims them via adopt_leases over its own connection;
+        # one that never does (owner died between grant and adoption) is
+        # reclaimed by the reap loop at the deadline.
+        self._unadopted: dict[bytes, float] = {}
 
         # placement group bundles: (pg_id, index) -> {"resources", "available",
         # "state"}
@@ -182,6 +195,7 @@ class Raylet:
             # worker/driver-facing
             "register_client": self.h_register_client,
             "request_worker_lease": self.h_request_worker_lease,
+            "adopt_leases": self.h_adopt_leases,
             "return_worker": self.h_return_worker,
             "notify_object_sealed": self.h_notify_object_sealed,
             "wait_object_local": self.h_wait_object_local,
@@ -475,9 +489,11 @@ class Raylet:
             return True  # bundles are explicit placements; wait for them
         return need.is_subset_of(self.total)
 
-    def _pick_spillback(self, spec) -> str | None:
+    def _pick_spillback(self, spec, exclude=()) -> str | None:
         """Hybrid policy fallback: a random remote node whose *total*
-        resources fit (reference: cluster_resource_scheduler.cc:320)."""
+        resources fit (reference: cluster_resource_scheduler.cc:320).
+        `exclude`: addresses already visited by a forwarded request
+        (cycle guard)."""
         import random
 
         need = ResourceSet.from_raw(spec["resources"])
@@ -485,11 +501,13 @@ class Raylet:
         for node_id, info in self.cluster_nodes.items():
             if node_id == self.node_id.binary():
                 continue
+            if info["address"] in exclude:
+                continue
             if need.is_subset_of(ResourceSet.from_raw(info["resources"])):
                 cands.append(info["address"])
         return random.choice(cands) if cands else None
 
-    async def _pick_spillback_load_aware(self, spec) -> str | None:
+    async def _pick_spillback_load_aware(self, spec, exclude=()) -> str | None:
         """Local node is feasible-by-totals but saturated: find a remote
         node with the capacity available RIGHT NOW (heartbeat-fresh GCS
         view) instead of hoarding the task in the local queue
@@ -503,9 +521,10 @@ class Raylet:
             return None
         avail = {nid: ResourceSet.from_raw(raw)
                  for nid, raw in avail_by_node.items()}
-        return self._pick_from_availability(spec, avail)
+        return self._pick_from_availability(spec, avail, exclude)
 
-    def _pick_from_availability(self, spec, avail: dict) -> str | None:
+    def _pick_from_availability(self, spec, avail: dict,
+                                exclude=()) -> str | None:
         """Synchronous selection from a fetched availability view (callers
         holding the view across multiple picks subtract as they assign)."""
         import random
@@ -515,6 +534,8 @@ class Raylet:
         cands = []
         for node_id, rs in avail.items():
             if node_id == me or node_id not in self.cluster_nodes:
+                continue
+            if self.cluster_nodes[node_id]["address"] in exclude:
                 continue
             if need.is_subset_of(rs):
                 cands.append(node_id)
@@ -649,6 +670,7 @@ class Raylet:
         count = max(1, int(d.get("count", 1)))
         soft = bool(d.get("soft"))
         hops = int(d.get("hops", 0))
+        visited = list(d.get("visited") or ())
         if hops == 0 and not soft:
             # Locality-aware lease targeting (reference: lease_policy.h):
             # a task whose plasma args are resident on another node is
@@ -659,7 +681,7 @@ class Raylet:
             if addr is not None:
                 self.m_spillbacks.inc()
                 self.m_locality_spillbacks.inc()
-                return {"spillback": addr, "hops": 1}
+                return await self._spill(d, addr, 1)
         tpu = self._needs_tpu(spec)
         grants: list[dict] = []
         while len(grants) < count:
@@ -681,7 +703,14 @@ class Raylet:
                     raise
             grants.append(self._lease_reply(worker, res, pg_key))
         if grants:
-            if conn.closed:
+            if d.get("forwarded"):
+                # Spillback-chain grant: the conn is a PEER RAYLET, not
+                # the lease holder — the owner claims these via
+                # adopt_leases over its own connection; unclaimed grants
+                # are reclaimed at the deadline (reap loop).
+                self.m_spillback_grants.inc(len(grants))
+                self._note_unadopted(grants)
+            elif conn.closed:
                 # The holder died while we awaited worker spawn: its
                 # disconnect callback already ran, so reclaim these
                 # grants now — nobody can receive the reply or ever
@@ -702,27 +731,32 @@ class Raylet:
         if key is not None and self._find_bundle(key) is None:
             addr = await self._pg_spillback(key)
             if addr is not None:
-                return {"spillback": addr}
+                return await self._spill(d, addr, hops + 1)
+        max_hops = self.config.lease_spillback_max_hops
         if not self._feasible_ever(spec):
-            addr = self._pick_spillback(spec)
+            addr = self._pick_spillback(spec, exclude=visited)
             if addr is not None:
                 self.m_spillbacks.inc()
-                return {"spillback": addr, "hops": hops + 1}
+                return await self._spill(d, addr, hops + 1)
             # Infeasible everywhere: queue until the cluster changes.
             self._warn_infeasible(spec)
-        elif key is None and hops < 3:
+        elif key is None and hops < max_hops:
             # Feasible here but saturated: offer it to a node that can run
             # it now rather than hoarding it (hop-capped to stop ping-pong
             # when the whole cluster is saturated).
-            addr = await self._pick_spillback_load_aware(spec)
+            addr = await self._pick_spillback_load_aware(spec,
+                                                         exclude=visited)
             if addr is not None:
                 self.m_spillbacks.inc()
-                return {"spillback": addr, "hops": hops + 1}
+                return await self._spill(d, addr, hops + 1)
         fut = asyncio.get_running_loop().create_future()
         self.pending_leases.append((spec, fut))
         result = await fut
         if result.get("granted"):
-            if conn.closed:
+            if d.get("forwarded"):
+                self.m_spillback_grants.inc()
+                self._note_unadopted([result])
+            elif conn.closed:
                 # The holder died while its request sat in the queue:
                 # its disconnect callback already ran (empty lease set),
                 # so reclaim this grant NOW — the reply can't be
@@ -739,6 +773,94 @@ class Raylet:
         if batched and "spillback" not in result:
             return {"grants": [result]}
         return result
+
+    async def _spill(self, d: dict, addr: str, hops: int):
+        """Redirect a lease request to the raylet at `addr`. Forwarding
+        mode (lease_spillback_forwarding, the tentpole path) CHAINS the
+        request raylet→raylet — this raylet relays the peer's grant back
+        toward the owner, so a cross-node burst costs the owner ONE lease
+        RPC instead of a redial per hop. The chain is hop-capped
+        (lease_spillback_max_hops), cycle-guarded (`visited` addresses are
+        never re-picked), and carries the spec unchanged — locality hints
+        (args) and the PR 6 trace context ride along. Legacy mode (or a
+        failed forward, or an exhausted hop budget) bounces the
+        owner-visible {"spillback": addr} reply exactly as before."""
+        if (not self.config.lease_spillback_forwarding
+                or hops > self.config.lease_spillback_max_hops):
+            return {"spillback": addr, "hops": hops}
+        if _fp.ARMED:
+            # forward seam: `raise` degrades to the owner-mediated bounce
+            # (liveness must not depend on the chain); `exit` kills this
+            # raylet mid-chain (chaos sweep)
+            try:
+                await _fp.fire_async_strict("lease.spillback")
+            except _fp.FailpointError:
+                return {"spillback": addr, "hops": hops}
+        fwd = dict(d)
+        fwd["hops"] = hops
+        fwd["forwarded"] = True
+        fwd["visited"] = list(d.get("visited") or ()) + [self.address]
+        self.m_spillback_forwards.inc()
+        try:
+            conn = await self._raylet_conn(addr)
+            reply = await conn.call("request_worker_lease", fwd)
+        except Exception as e:
+            # peer died / unreachable mid-chain: degrade to the legacy
+            # bounce so the owner can redial (or re-spill elsewhere)
+            logger.warning("lease spillback forward to %s failed (%s); "
+                           "bouncing to owner", addr, e)
+            return {"spillback": addr, "hops": hops}
+        root = tracing.from_wire((d.get("spec") or {}).get("trace"))
+        if root is not None:
+            tracing.record_span("raylet.spillback", time.time(), time.time(),
+                                tracing.child(root), {"to": addr,
+                                                      "hops": hops})
+        return reply
+
+    def _note_unadopted(self, grants):
+        # `adopt` tells the owner these grants arrived over a spillback
+        # chain: it must claim them (adopt_leases at granted_by) before
+        # this deadline, or the reap loop returns them to the idle pool.
+        deadline = time.monotonic() + 10.0
+        for g in grants:
+            g["adopt"] = True
+            self._unadopted[g["lease_id"]] = deadline
+
+    async def h_adopt_leases(self, conn, d):
+        """The true lease holder claims leases granted for a forwarded
+        request: holder-death reclaim (_on_disconnect) now watches the
+        OWNER's connection, exactly as for a directly-requested lease.
+        Returns the lease_ids actually adopted — one missing means the
+        unadopted deadline already reclaimed it (the owner treats that
+        lease as lost and re-requests)."""
+        held = conn.context.setdefault("lease_ids", set())
+        adopted = []
+        for lid in d["lease_ids"]:
+            if self._unadopted.pop(lid, None) is None:
+                continue
+            held.add(lid)
+            adopted.append(lid)
+        return {"adopted": adopted}
+
+    def _reap_unadopted(self):
+        """Reclaim forwarded-request grants whose owner never adopted
+        them (died between the relayed grant and adopt_leases)."""
+        if not self._unadopted:
+            return False
+        now = time.monotonic()
+        expired = [lid for lid, dl in self._unadopted.items() if dl < now]
+        reclaimed = False
+        for lid in expired:
+            del self._unadopted[lid]
+            for w in list(self.workers.values()):
+                if w.lease_id == lid:
+                    logger.warning("reclaiming never-adopted spillback "
+                                   "lease %s", lid.hex())
+                    self._release(w.lease_resources, w.lease_pg)
+                    self._push_worker(w)
+                    reclaimed = True
+                    break
+        return reclaimed
 
     def _note_lease_granted(self, t0: float, spec, count: int):
         """Raylet-side scheduling hop: histogram always, a `raylet.lease`
@@ -789,6 +911,10 @@ class Raylet:
             "worker_id": worker.worker_id,
             "worker_address": worker.address,
             "task_channel": worker.task_channel,
+            # which raylet granted: a forwarded (spillback-chain) grant
+            # reaches the owner through its LOCAL raylet's reply, and the
+            # owner must return the lease (and adopt it) HERE
+            "granted_by": self.address,
         }
 
     async def h_return_worker(self, conn, d):
@@ -797,6 +923,7 @@ class Raylet:
         held = conn.context.get("lease_ids")
         if held is not None:
             held.discard(d["lease_id"])
+        self._unadopted.pop(d["lease_id"], None)
         worker = None
         for w in self.workers.values():
             if w.lease_id == d["lease_id"]:
@@ -1723,6 +1850,11 @@ class Raylet:
                     await self._complete_deferred_frees(freeable)
             except Exception:
                 logger.exception("transfer-pin sweep failed")
+            try:
+                if self._reap_unadopted():
+                    await self._dispatch_pending()
+            except Exception:
+                logger.exception("unadopted-lease reap failed")
 
     async def _respill_pending(self):
         """Queued leases get re-offered to nodes that NOW have capacity
@@ -1905,13 +2037,25 @@ class Raylet:
 
         # Duplex: the GCS drives actor creation and bundle 2PC back over
         # this connection; it survives GCS restarts.
-        self.gcs = rpc.ReconnectingConnection(
-            self.gcs_address, handlers=self._handlers(), name="raylet->gcs",
+        uds_dir = os.path.join(self.session_dir, "sock")
+        director = rpc.ReconnectingConnection(
+            rpc.prefer_uds(self.gcs_address, uds_dir,
+                           local_ips=("127.0.0.1",
+                                      self.config.node_ip_address)),
+            handlers=self._handlers(), name="raylet->gcs",
             on_reconnect=_gcs_session,
             retry_timeout=self.config.gcs_reconnect_timeout_s,
             on_give_up=_gcs_gone)
+        # Sharded control plane: the object-directory ops this raylet
+        # issues per seal/free/pull (the hottest steady-state stream)
+        # key-route straight to the owning store shard; membership,
+        # heartbeats, scheduling and pubsub stay on the director. With
+        # gcs_shards=1 (default) this is a pure passthrough.
+        from ray_tpu.gcs.client import GcsClient
+
+        self.gcs = GcsClient(director, self.config, uds_dir=uds_dir)
         self.gcs.set_push_handler(self._handle_gcs_push)
-        await _gcs_session(await self.gcs.ensure_connected())
+        await _gcs_session(await director.ensure_connected())
         asyncio.create_task(self.heartbeat_loop())
         asyncio.create_task(self._reap_loop())
         prestart = self.config.num_initial_workers

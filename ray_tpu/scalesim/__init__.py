@@ -1,0 +1,3 @@
+from ray_tpu.scalesim.harness import ControlPlane, run_scalesim
+
+__all__ = ["ControlPlane", "run_scalesim"]

@@ -1,0 +1,8 @@
+"""`python -m ray_tpu.scalesim` — same surface as `ray-tpu scalesim`."""
+
+import sys
+
+from ray_tpu.scripts.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main(["scalesim", *sys.argv[1:]]))

@@ -1,0 +1,127 @@
+"""Scale-sim worker process: drives a subset of the spoofed raylets.
+
+Spawned by harness.run_scalesim, one per `client_procs`. Protocol:
+
+1. read the shared config JSON (plane addresses, schedule, seeds);
+2. connect every assigned SimRaylet to every plane and seed its hosted
+   object locations, then touch `<out>.ready`;
+3. poll for the go file, read the shared wall-clock T0;
+4. follow the timetable: slice i covers
+   [T0 + i*(window_s+gap_s), +window_s] — sleep to each slice start,
+   drive the slice's (arm, kind) with this worker's clients until the
+   slice deadline, record the completed-op count;
+5. write counts + every acked KV write to `<out>` and exit 0.
+
+Worker processes exist so the measured bottleneck is the CONTROL PLANE:
+a single driving process is GIL-bound and caps both arms at the
+harness's own speed; several of them generate enough concurrent demand
+to saturate the single-director arm's one event loop while the sharded
+arm keeps scaling across its processes."""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import time
+
+from ray_tpu._private.config import Config
+from ray_tpu.scalesim.harness import SimRaylet
+
+
+async def _run(cfg: dict, indices: list[int], out: str) -> None:
+    window_s = cfg["window_s"]
+    gap_s = cfg["gap_s"]
+    # clients per (plane, sim-raylet index)
+    clients: dict[str, list[SimRaylet]] = {}
+    for label, plane in cfg["planes"].items():
+        config = Config.load({"gcs_shards": plane["shards"]})
+        cs = [SimRaylet(i, cfg["seed"], cfg["raylets"], cfg["pool_size"])
+              for i in indices]
+        await asyncio.gather(*(c.connect(plane["gcs_address"], config,
+                                         uds_dir=plane.get("uds_dir"))
+                               for c in cs))
+        await asyncio.gather(*(c.seed_locations() for c in cs))
+        clients[label] = cs
+
+    with open(out + ".ready.tmp", "w") as f:
+        f.write("ready")
+    os.rename(out + ".ready.tmp", out + ".ready")
+
+    while not os.path.exists(cfg["go_path"]):
+        await asyncio.sleep(0.02)
+    with open(cfg["go_path"]) as f:
+        t0 = float(f.read().strip())
+
+    counts = []  # [arm, kind, window, n]
+    for sl in cfg["schedule"]:
+        start = t0 + sl["index"] * (window_s + gap_s)
+        stop = start + window_s
+        await asyncio.sleep(max(0.0, start - time.time()))
+        cs = clients[sl["arm"]]
+        kind = sl["kind"]
+        streams = int(cfg.get("streams", 8))
+        budget = int(window_s * 4000) + 64  # far beyond one slice
+        if kind == "ops":
+            work = [(c.issue_op, c.gen_ops(budget)) for c in cs]
+        else:
+            work = [(c.issue_decision, c.gen_decisions(budget))
+                    for c in cs]
+        slice_counts = [0] * len(work)
+
+        async def drive(i, issue, items, offset):
+            # `streams` concurrent op streams per sim raylet: a real
+            # raylet has many control ops in flight at once (seal
+            # registrations spawn a task per object, lease and pull
+            # lookups overlap) — a depth-1 client measures its own
+            # RTT, not the plane's capacity
+            n = 0
+            while time.time() < stop:
+                await issue(items[(offset + n * streams) % len(items)])
+                n += 1
+            slice_counts[i] += n
+
+        t_start = time.time()
+        await asyncio.gather(*(
+            drive(i, issue, items, k)
+            for i, (issue, items) in enumerate(work)
+            for k in range(streams)))
+        # drain: pipelined notify()s issued this slice must be fully
+        # dispatched server-side before they count (and before the next
+        # slice starts measuring a different arm); the drain time stays
+        # in this slice's denominator so backlog can't inflate the rate
+        await asyncio.gather(*(c.gcs.barrier() for c in cs))
+        counts.append([sl["arm"], kind, sl["window"], sum(slice_counts),
+                       time.time() - t_start])
+
+    # only the verify arm's acks count (same keys get independently
+    # written on every plane; verification reads one plane)
+    acked = {k: v.hex()
+             for c in clients.get(cfg.get("verify_arm", ""), ())
+             for k, v in c.acked_kv.items()}
+    for cs in clients.values():
+        for c in cs:
+            await c.close()
+
+    with open(out + ".tmp", "w") as f:
+        json.dump({"counts": counts, "acked": acked}, f)
+    os.rename(out + ".tmp", out)
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--config", required=True)
+    parser.add_argument("--out", required=True)
+    parser.add_argument("--clients", required=True,
+                        help="comma-separated sim-raylet indices")
+    args = parser.parse_args()
+    with open(args.config) as f:
+        cfg = json.load(f)
+    indices = [int(x) for x in args.clients.split(",")]
+    asyncio.run(_run(cfg, indices, args.out))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

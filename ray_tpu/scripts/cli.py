@@ -529,6 +529,37 @@ def cmd_microbenchmark(args) -> int:
     return 0
 
 
+def cmd_scalesim(args) -> int:
+    """Control-plane scale-sim: spoofed raylets against a real GCS
+    (director + store shards) on this box — scheduler decisions/s and
+    GCS op throughput, interleaved A/B vs the single-shard legacy arm
+    (ray_tpu/scalesim/harness.py)."""
+    from ray_tpu.scalesim import run_scalesim
+
+    result = run_scalesim(
+        shards=args.shards, raylets=args.raylets, windows=args.windows,
+        window_s=args.window_s, seed=args.seed,
+        kill_shard=args.kill_shard, legacy_arm=not args.no_legacy_arm,
+        out=args.out)
+    for label, arm in result["arms"].items():
+        print(f"{label}: gcs ops/s "
+              f"{arm['gcs_ops_per_s']['median']:.0f}  "
+              f"decisions/s {arm['decisions_per_s']['median']:.0f}")
+    if "speedup_gcs_ops" in result:
+        print(f"speedup vs shards=1: gcs ops {result['speedup_gcs_ops']}x, "
+              f"decisions {result['speedup_decisions']}x")
+    if "director_bypass_ratio" in result:
+        print(f"director bypass: {result['director_bypass_ratio']}x the "
+              f"legacy arm's director CPU per op "
+              f"({result['cores']} cores on this box; rates understate "
+              f"the sharded arm below shards+2 cores)")
+    if result.get("kill"):
+        print(f"shard kill: {result['kill']}")
+    if args.out:
+        print(f"wrote {args.out}")
+    return 0
+
+
 # ---------------------------------------------------------------------------
 
 def main(argv=None) -> int:
@@ -639,6 +670,24 @@ def main(argv=None) -> int:
     p = sub.add_parser("microbenchmark", help="run the core benchmark suite")
     p.add_argument("--out", default=None)
     p.set_defaults(fn=cmd_microbenchmark)
+
+    p = sub.add_parser("scalesim",
+                       help="control-plane scale-sim (spoofed raylets "
+                            "vs a real sharded GCS)")
+    p.add_argument("--shards", type=int, default=4)
+    p.add_argument("--raylets", type=int, default=16,
+                   help="spoofed raylet clients")
+    p.add_argument("--windows", type=int, default=5)
+    p.add_argument("--window-s", type=float, default=1.0,
+                   help="seconds per measurement slice")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--kill-shard", action="store_true",
+                   help="SIGKILL+restart a seeded shard mid-window and "
+                        "verify zero lost acked ops")
+    p.add_argument("--no-legacy-arm", action="store_true",
+                   help="skip the interleaved shards=1 control arm")
+    p.add_argument("--out", default=None, help="write result JSON here")
+    p.set_defaults(fn=cmd_scalesim)
 
     args = parser.parse_args(argv)
     return args.fn(args)

@@ -112,6 +112,10 @@ class Client:
         for handle in self._handles.values():
             handle._router.close()
         self._handles.clear()
+        try:  # stop the autoscale tick before the hard kill
+            ray_tpu.get(self._controller.stop.remote(), timeout=2)
+        except Exception:
+            pass
         for actor in self._proxies + [self._controller]:
             try:
                 ray_tpu.kill(actor)

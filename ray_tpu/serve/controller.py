@@ -21,21 +21,60 @@ from ray_tpu.serve.replica import Replica
 
 
 class ServeController:
+    # Autoscaling clock: router reports drive reactive scaling, the tick
+    # drives idle convergence (a deployment with NO router traffic — or
+    # no router at all, handle-only — must still drift to min_replicas).
+    AUTOSCALE_TICK_S = 0.5
+    # A queue report older than this reads as 0: a router that died (or
+    # an endpoint whose traffic stopped reaching any router) must not
+    # pin replicas up with its last non-zero report forever.
+    QUEUE_REPORT_TTL_S = 10.0
+
     def __init__(self):
+        import threading
+
         # name -> {"config": dict, "pickled": bytes, "init_args": tuple,
         #          "replicas": [handle]}
         self.backends: dict[str, dict] = {}
         # name -> {"backend": str, "route": str|None, "methods": [str]}
         self.endpoints: dict[str, dict] = {}
         self.version = 0
-        # endpoint -> latest reported router queue length
-        self._queue_lens: dict[str, float] = {}
+        # endpoint -> (latest reported router queue length, monotonic ts)
+        self._queue_lens: dict[str, tuple[float, float]] = {}
         self._last_downscale_ok: dict[str, float] = {}
         self._last_autoscale = 0.0
+        # serializes tick-thread autoscaling against report-triggered
+        # autoscaling on the actor's dispatcher thread
+        self._autoscale_lock = threading.Lock()
+        self._stopped = False
         # Long-poll parking: listeners wait on this event (on the actor's
         # async loop); sync mutators fire it thread-safely via the loop.
         self._change_event = None
         self._loop = None
+        threading.Thread(target=self._autoscale_loop,
+                         name="serve-autoscale", daemon=True).start()
+
+    def _autoscale_loop(self):
+        """The control-loop clock (reference: controller.py run_control_loop):
+        without it, _maybe_autoscale only ran when router traffic reports
+        arrived, so an idle deployment never scaled down to min_replicas
+        and a handle-only deployment never autoscaled at all."""
+        import logging
+
+        logger = logging.getLogger("ray_tpu.serve.controller")
+        while not self._stopped:
+            time.sleep(self.AUTOSCALE_TICK_S)
+            try:
+                self._maybe_autoscale()
+            except Exception:
+                logger.exception("autoscale tick failed")
+
+    def stop(self):
+        """Stop the autoscale tick thread (called by Client.shutdown
+        before the actor is killed; also the teardown for in-process
+        controllers in tests)."""
+        self._stopped = True
+        return True
 
     def _notify_change(self):
         """Wake parked listen_for_change calls; safe from any thread."""
@@ -63,13 +102,16 @@ class ServeController:
         if name in self.backends:
             raise ValueError(f"backend {name!r} already exists")
         cfg = BackendConfig.from_dict(config)
-        self.backends[name] = {
-            "config": cfg.to_dict(),
-            "pickled": pickled_callable,
-            "init_args": init_args,
-            "replicas": [],
-        }
-        self._reconcile(name)
+        # _autoscale_lock: the tick thread walks backends/replicas;
+        # structural mutations must not interleave with its _reconcile
+        with self._autoscale_lock:
+            self.backends[name] = {
+                "config": cfg.to_dict(),
+                "pickled": pickled_callable,
+                "init_args": init_args,
+                "replicas": [],
+            }
+            self._reconcile(name)
         self.version += 1
         self._notify_change()
         return True
@@ -83,26 +125,33 @@ class ServeController:
             raise ValueError(
                 f"backend {name!r} is used by endpoint(s) {used_by}; "
                 f"delete them first")
-        rec = self.backends.pop(name, None)
-        if rec is None:
-            return False
-        for handle in rec["replicas"]:
-            try:
-                ray_tpu.kill(handle)
-            except Exception:
-                pass
+        with self._autoscale_lock:
+            # under the lock: a tick-thread _reconcile appending a fresh
+            # replica to a just-popped rec would orphan that actor
+            rec = self.backends.pop(name, None)
+            if rec is None:
+                return False
+            for handle in rec["replicas"]:
+                try:
+                    ray_tpu.kill(handle)
+                except Exception:
+                    pass
         self.version += 1
         self._notify_change()
         return True
 
     def update_backend_config(self, name: str, config: dict):
-        rec = self._backend(name)
-        merged = {**rec["config"], **config}
-        rec["config"] = BackendConfig.from_dict(merged).to_dict()
-        self._reconcile(name)
+        with self._autoscale_lock:
+            rec = self._backend(name)
+            merged = {**rec["config"], **config}
+            rec["config"] = BackendConfig.from_dict(merged).to_dict()
+            self._reconcile(name)
+            replicas = list(rec["replicas"])
         if rec["config"].get("user_config") is not None:
+            # reconfigure outside the lock: a 60s replica get must not
+            # stall the autoscale tick
             refs = [r.reconfigure.remote(rec["config"]["user_config"])
-                    for r in rec["replicas"]]
+                    for r in replicas]
             ray_tpu.get(refs, timeout=60)
         self.version += 1
         self._notify_change()
@@ -271,26 +320,32 @@ class ServeController:
     # -- autoscaling (reference: autoscaling_policy.py:137) --------------
 
     def report_queue_len(self, endpoint: str, queued: int):
-        """Routers report their queue depth each poll cycle; the report
-        traffic is also the autoscaler's clock."""
-        self._queue_lens[endpoint] = float(queued)
+        """Routers report their queue depth each poll cycle; reports
+        drive reactive scaling, the periodic tick (_autoscale_loop)
+        drives idle convergence."""
+        self._queue_lens[endpoint] = (float(queued), time.monotonic())
         self._maybe_autoscale()
         return True
 
     def _maybe_autoscale(self):
+        with self._autoscale_lock:
+            self._maybe_autoscale_locked()
+
+    def _maybe_autoscale_locked(self):
         now = time.monotonic()
         if now - self._last_autoscale < 0.5:
             return
         self._last_autoscale = now
-        for name, rec in self.backends.items():
+        for name, rec in list(self.backends.items()):
             auto = rec["config"].get("autoscaling")
             if not auto:
                 continue
             queued = sum(
                 q * (self.endpoints[ep]["traffic"].get(name, 0.0)
                      + self.endpoints[ep]["shadow"].get(name, 0.0))
-                for ep, q in self._queue_lens.items()
-                if ep in self.endpoints)
+                for ep, (q, ts) in self._queue_lens.items()
+                if ep in self.endpoints
+                and now - ts < self.QUEUE_REPORT_TTL_S)
             cur = len(rec["replicas"])
             target = auto.get("target_queued", 2.0) or 2.0
             desired = max(auto.get("min_replicas", 1),

@@ -11,8 +11,6 @@ bundle inherit its reserved resources.
 
 from __future__ import annotations
 
-import time
-
 from ray_tpu._private import global_state
 from ray_tpu._private.ids import PlacementGroupID
 
@@ -30,10 +28,13 @@ class PlacementGroup:
     def ready(self, timeout: float | None = None) -> bool:
         """Block until all bundles are reserved (reference's pg.ready() is an
         ObjectRef; here a blocking call — pair with wait(timeout=0) for a
-        non-blocking probe)."""
+        non-blocking probe). Parks on the GCS `pg:<id>` pubsub channel
+        (woken by the CREATED/REMOVED publish, with a slow re-poll
+        backstop) instead of the old 20ms client busy-poll; the reads it
+        does issue are shard-routed like every pg-table lookup."""
         cw = global_state.require_core_worker()
-        deadline = None if timeout is None else time.monotonic() + timeout
-        while True:
+        if timeout is not None and timeout <= 0:
+            # non-blocking probe: one read, no subscription
             info = cw.get_placement_group(self.id.binary())
             if info is None:
                 raise ValueError(
@@ -41,9 +42,12 @@ class PlacementGroup:
             if info["state"] == "CREATED":
                 self._bundles = info["bundles"]
                 return True
-            if deadline is not None and time.monotonic() >= deadline:
-                return False
-            time.sleep(0.02)
+            return False
+        info = cw.wait_placement_group(self.id.binary(), timeout=timeout)
+        if info is None:
+            return False
+        self._bundles = info["bundles"]
+        return True
 
     def wait(self, timeout_seconds: float = 30) -> bool:
         return self.ready(timeout=timeout_seconds)

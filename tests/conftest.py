@@ -65,7 +65,8 @@ def pytest_configure(config):
 # spawned must be gone once it no longer holds a cluster.
 
 _RUNTIME_CMD_MARKS = ("ray_tpu.worker.main", "ray_tpu.raylet.raylet",
-                      "ray_tpu.gcs.server")
+                      "ray_tpu.gcs.server", "ray_tpu.gcs.shard",
+                      "ray_tpu.scalesim.worker")
 
 
 def _runtime_procs() -> dict:

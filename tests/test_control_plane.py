@@ -1,0 +1,483 @@
+"""Sharded GCS control plane: key->shard routing, per-shard journal
+recovery, raylet->raylet lease spillback, GCS-restart re-subscription,
+and the scale-sim smoke (reference behaviors: the Ray paper's sharded
+GCS, §4.1; python/ray/tests/test_gcs_fault_tolerance.py restart idioms).
+
+Chaos tier (`-m chaos`): 5-seeded sweep killing a store-shard primary
+(and the director) mid-workload against a REAL sharded cluster — every
+workload completes or raises a typed error within deadline, no hangs,
+and the killed shard's journal replay restores its tables bit-identical.
+"""
+
+import asyncio
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import api as _api
+from ray_tpu._private import failpoints as fp
+from ray_tpu._private import stats
+from ray_tpu.experimental import internal_kv
+from ray_tpu.gcs.client import CONTROL_KEY_PREFIX, shard_for
+from ray_tpu.gcs.journal import Journal, JournalCorruption
+from ray_tpu.gcs.shard import GcsShard
+
+from .conftest import scale_timeout
+
+
+# ---------------------------------------------------------------------------
+# routing + journal units
+# ---------------------------------------------------------------------------
+
+def test_shard_routing_deterministic():
+    """Every process must compute the same owner for a key, str or bytes
+    spellings included, and the partition must cover all shards."""
+    for n in (1, 2, 4, 7):
+        owners = {shard_for(f"key-{i}", n) for i in range(200)}
+        assert owners == set(range(n))
+    assert shard_for("abc", 4) == shard_for(b"abc", 4)
+    # director-owned control keys never route to a shard
+    assert CONTROL_KEY_PREFIX == "ray_tpu:"
+    assert fp.KV_KEY.startswith(CONTROL_KEY_PREFIX)
+
+
+def _drive_shard(shard, ops):
+    async def _run():
+        for method, payload in ops:
+            await shard._handlers()[method](None, payload)
+    asyncio.run(_run())
+
+
+def _seed_ops(n=40):
+    ops = []
+    for i in range(n):
+        ops.append(("kv_put", {"key": f"k{i}", "value": b"v%d" % i}))
+        ops.append(("add_object_location",
+                    {"object_id": b"o%03d" % i, "node_id": b"n%d" % (i % 3),
+                     "size": 100 + i}))
+        if i % 4 == 0:
+            ops.append(("kv_del", {"key": f"k{i}"}))
+        if i % 5 == 0:
+            ops.append(("remove_object_location",
+                        {"object_id": b"o%03d" % i,
+                         "node_id": b"n%d" % (i % 3)}))
+        if i % 3 == 0:
+            ops.append(("mirror_apply", {
+                "records": [["actors", b"a%d" % i, {"state": "ALIVE"}]]}))
+    return ops
+
+
+def test_journal_replay_bit_identical(tmp_path):
+    """Kill-and-replay restores the exact table state: canonical bytes
+    equal before and after, including across a compaction."""
+    store = str(tmp_path / "shard0")
+    shard = GcsShard(0, journal=Journal(store))
+    _drive_shard(shard, _seed_ops())
+    before = shard.canonical_state()
+    shard.journal.close()
+
+    replayed = GcsShard(0, journal=Journal(store))
+    assert replayed.canonical_state() == before
+    # snapshot compaction preserves equality too
+    replayed.journal.compact(replayed._state())
+    replayed.journal.close()
+    again = GcsShard(0, journal=Journal(store))
+    assert again.canonical_state() == before
+    again.journal.close()
+
+
+def test_journal_torn_tail_truncated(tmp_path):
+    """A crash mid-append leaves a torn frame: recovery truncates it and
+    keeps every whole record; new appends land cleanly after."""
+    store = str(tmp_path / "shard0")
+    shard = GcsShard(0, journal=Journal(store))
+    _drive_shard(shard, [("kv_put", {"key": "a", "value": b"1"}),
+                         ("kv_put", {"key": "b", "value": b"2"})])
+    shard.journal.close()
+    path = os.path.join(store, "journal.bin")
+    with open(path, "ab") as f:
+        f.write(b"\x00\x00\x00\x40garbage")  # length says 64, only 7 left
+
+    replayed = GcsShard(0, journal=Journal(store))
+    assert replayed.kv == {"a": b"1", "b": b"2"}
+    _drive_shard(replayed, [("kv_put", {"key": "c", "value": b"3"})])
+    replayed.journal.close()
+    final = GcsShard(0, journal=Journal(store))
+    assert final.kv == {"a": b"1", "b": b"2", "c": b"3"}
+    final.journal.close()
+
+
+def test_journal_midfile_corruption_refuses(tmp_path):
+    """Corruption with valid (possibly fsynced) records after it must
+    refuse to open — auto-truncating would destroy durable state."""
+    store = str(tmp_path / "shard0")
+    shard = GcsShard(0, journal=Journal(store))
+    _drive_shard(shard, [("kv_put", {"key": k, "value": b"x" * 32})
+                         for k in "abcdef"])
+    shard.journal.close()
+    path = os.path.join(store, "journal.bin")
+    with open(path, "r+b") as f:
+        f.seek(10)
+        f.write(b"\xff\xff\xff\xff")
+    with pytest.raises(JournalCorruption):
+        GcsShard(0, journal=Journal(store))
+
+
+# ---------------------------------------------------------------------------
+# sharded cluster end-to-end
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def sharded_cluster():
+    ray_tpu.init(num_cpus=2, _system_config={"gcs_shards": 2})
+    try:
+        yield _api._global_node
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_sharded_cluster_end_to_end(sharded_cluster):
+    """gcs_shards=2: the same API surface works with table ops key-routed
+    to store shards — tasks, plasma objects, KV, named actors."""
+    node = sharded_cluster
+    assert len([s for s in node.processes
+                if s.name.startswith("gcs_shard_")]) == 2
+
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    assert ray_tpu.get(add.remote(1, 2), timeout=scale_timeout(30)) == 3
+
+    # KV routes by key: exercise both shards and the union read
+    for i in range(16):
+        internal_kv._kv_put(f"cpk-{i}", b"val-%d" % i)
+    for i in range(16):
+        assert internal_kv._kv_get(f"cpk-{i}") == b"val-%d" % i
+
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.v = 0
+
+        def inc(self):
+            self.v += 1
+            return self.v
+
+    c = Counter.options(name="sharded-counter").remote()
+    assert ray_tpu.get(c.inc.remote(), timeout=scale_timeout(30)) == 1
+    # actor read mirrors serve get_actor through the owning shard
+    import numpy as np
+
+    arr = ray_tpu.put(np.ones(200_000))  # plasma -> object directory
+    assert float(ray_tpu.get(arr).sum()) == 200_000.0
+
+
+def test_shard_kill_recovery(sharded_cluster):
+    """SIGKILL a store shard mid-session: the node monitor restarts it on
+    its fixed port against its journal; acked KV writes survive and the
+    cluster keeps serving (clients redial transparently)."""
+    node = sharded_cluster
+    for i in range(12):
+        internal_kv._kv_put(f"durable-{i}", b"d%d" % i)
+
+    victims = [s for s in node.processes if s.name.startswith("gcs_shard_")]
+    old_pid = victims[0].proc.pid
+    node.kill_gcs_shard(0)
+    deadline = time.monotonic() + scale_timeout(15)
+    while time.monotonic() < deadline:
+        cur = [s for s in node.processes
+               if getattr(s, "shard_index", None) == 0]
+        if cur and cur[0].alive() and cur[0].proc.pid != old_pid:
+            break
+        time.sleep(0.1)
+    else:
+        raise TimeoutError("shard was not restarted by the node monitor")
+
+    # every acked write must read back through the restarted shard
+    for i in range(12):
+        assert internal_kv._kv_get(f"durable-{i}") == b"d%d" % i
+
+    @ray_tpu.remote
+    def ping():
+        return "ok"
+
+    assert ray_tpu.get(ping.remote(), timeout=scale_timeout(30)) == "ok"
+
+
+# ---------------------------------------------------------------------------
+# lease spillback: raylet->raylet forwarding
+# ---------------------------------------------------------------------------
+
+def _lease_burst_rpcs(forwarding: bool, n_tasks: int = 100):
+    """Run a cross-node lease burst on a 2-node cluster (head has no
+    CPUs, so every lease must come from the second node) and return
+    (owner lease RPCs, cluster metric snapshots)."""
+    from ray_tpu._private import global_state
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(
+        initialize_head=False,
+        _system_config={"lease_spillback_forwarding": forwarding})
+    try:
+        from ray_tpu._private.node import start_gcs
+
+        cluster.gcs_svc, cluster.gcs_address = start_gcs(
+            cluster.session_dir, cluster.config)
+        cluster.add_node(num_cpus=0, is_head=True)
+        cluster.add_node(num_cpus=2)
+        cluster.connect_driver()
+
+        @ray_tpu.remote(num_cpus=1)
+        def unit(x):
+            return x + 1
+
+        before = stats.snapshot()
+        refs = [unit.remote(i) for i in range(n_tasks)]
+        assert ray_tpu.get(refs, timeout=scale_timeout(120)) == [
+            i + 1 for i in range(n_tasks)]
+        after = stats.snapshot()
+        rpcs = (after["core.lease_rpcs_total"]["value"]
+                - before.get("core.lease_rpcs_total",
+                             {}).get("value", 0))
+        metrics = ray_tpu.cluster_metrics()
+        return rpcs, metrics
+    finally:
+        cw = global_state.get_core_worker()
+        if cw is not None:
+            cw.shutdown()
+        cluster.shutdown()
+
+
+def test_spillback_forwarding_cuts_owner_lease_rpcs():
+    """The tentpole claim, counter-verified: a 100-task cross-node burst
+    costs the owner >= 50% fewer request_worker_lease RPCs with
+    raylet->raylet forwarding than with the legacy owner-mediated bounce
+    (each legacy round trips owner->head, bounces, then owner->peer)."""
+    legacy_rpcs, legacy_metrics = _lease_burst_rpcs(forwarding=False)
+    fwd_rpcs, fwd_metrics = _lease_burst_rpcs(forwarding=True)
+
+    # Structurally 2 owner RPCs/round (request -> bounce -> redial)
+    # become 1 (the chain relays the grant): a >= 50% cut. +2 slack
+    # tolerates ONE adoption-deadline race re-request (the owner drops a
+    # grant the granting raylet already reaped and asks again) without
+    # masking a broken chain.
+    assert fwd_rpcs * 2 <= legacy_rpcs + 2, (
+        f"forwarding used {fwd_rpcs} owner lease RPCs vs {legacy_rpcs} "
+        f"legacy — less than a 50% cut")
+    assert fwd_rpcs < legacy_rpcs
+
+    def counter(metrics, name):
+        return sum(snap.get(name, {}).get("value", 0)
+                   for snap in metrics["raylets"].values())
+
+    # the chain really ran: the head forwarded, the peer granted for it
+    assert counter(fwd_metrics, "raylet.spillback_forwards_total") > 0
+    assert counter(fwd_metrics, "raylet.spillback_grants_total") > 0
+    # and the legacy arm really bounced (no forwarding)
+    assert counter(legacy_metrics, "raylet.spillback_forwards_total") == 0
+    assert counter(legacy_metrics, "raylet.spillbacks_total") > 0
+
+
+# ---------------------------------------------------------------------------
+# GCS restart re-subscription (satellite: failpoint arming, trace_config,
+# actor-directory subscribers must survive a GCS restart)
+# ---------------------------------------------------------------------------
+
+def _kill_gcs_and_wait_restart(node):
+    old_pid = next(s.proc.pid for s in node.processes
+                   if s.name == "gcs_server")
+    node.kill_gcs()
+    deadline = time.monotonic() + scale_timeout(15)
+    while time.monotonic() < deadline:
+        gcs = next((s for s in node.processes if s.name == "gcs_server"),
+                   None)
+        if gcs is not None and gcs.alive() and gcs.proc.pid != old_pid:
+            return
+        time.sleep(0.1)
+    raise TimeoutError("GCS was not restarted by the node monitor")
+
+
+@pytest.fixture
+def gcs_cluster():
+    ray_tpu.init(num_cpus=4)
+    try:
+        yield _api._global_node
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_failpoint_arming_after_gcs_restart(gcs_cluster):
+    """Live failpoint arming rides the GCS pubsub plane; after a GCS
+    restart every process must have re-subscribed — a spec armed
+    POST-restart must still reach workers."""
+    @ray_tpu.remote
+    def work(x):
+        return x * 2
+
+    assert ray_tpu.get(work.remote(1), timeout=scale_timeout(30)) == 2
+    _kill_gcs_and_wait_restart(gcs_cluster)
+    try:
+        fp.arm_cluster("worker.exec=raise(nth=1,role=worker)")
+        deadline = time.monotonic() + scale_timeout(30)
+        hit = False
+        while time.monotonic() < deadline and not hit:
+            try:
+                ray_tpu.get(work.remote(2), timeout=scale_timeout(30))
+            except Exception as e:  # typed: FailpointError inside the task
+                assert "worker.exec" in str(e) or isinstance(
+                    e, fp.FailpointError), e
+                hit = True
+        assert hit, ("failpoint armed after GCS restart never fired in a "
+                     "worker — pubsub re-subscription broken")
+    finally:
+        fp.disarm_cluster()
+
+
+def test_trace_config_after_gcs_restart(gcs_cluster):
+    """set_trace_sampling publishes on the trace_config channel; after a
+    restart the worker/driver subscriptions must be re-established so a
+    post-restart override still turns tracing on cluster-wide."""
+    _kill_gcs_and_wait_restart(gcs_cluster)
+    ray_tpu.set_trace_sampling(1.0)
+    try:
+        @ray_tpu.remote
+        def traced():
+            return "t"
+
+        deadline = time.monotonic() + scale_timeout(30)
+        while time.monotonic() < deadline:
+            assert ray_tpu.get(traced.remote(),
+                               timeout=scale_timeout(30)) == "t"
+            time.sleep(0.5)  # profile-flush cadence ships the spans
+            spans = ray_tpu.trace_spans()
+            if any(str(s.get("event_type", "")).startswith("task")
+                   for s in spans):
+                return
+        pytest.fail("no task.exec span reached the GCS trace table after "
+                    "a post-restart sampling override")
+    finally:
+        ray_tpu.set_trace_sampling(0.01)
+
+
+def test_actor_subscriber_after_gcs_restart(gcs_cluster):
+    """An actor channel subscribed BEFORE the restart must observe
+    post-restart publishes: kill a max_restarts actor after the GCS
+    bounce — the owner's re-subscribed client sees RESTARTING/ALIVE and
+    recovers the handle."""
+    @ray_tpu.remote(max_restarts=2)
+    class Phoenix:
+        def pid(self):
+            return os.getpid()
+
+    a = Phoenix.remote()
+    pid1 = ray_tpu.get(a.pid.remote(), timeout=scale_timeout(30))
+    _kill_gcs_and_wait_restart(gcs_cluster)
+
+    os.kill(pid1, 9)
+    deadline = time.monotonic() + scale_timeout(60)
+    while time.monotonic() < deadline:
+        try:
+            pid2 = ray_tpu.get(a.pid.remote(), timeout=scale_timeout(30))
+            if pid2 != pid1:
+                return
+        except ray_tpu.exceptions.ActorError:
+            time.sleep(0.2)  # typed death/unavailable: restart in flight
+    pytest.fail("actor never recovered after post-GCS-restart kill — "
+                "actor-directory re-subscription broken")
+
+
+# ---------------------------------------------------------------------------
+# scale-sim smoke (CI satellite)
+# ---------------------------------------------------------------------------
+
+def test_scalesim_smoke():
+    """Tiny tier-1 scale-sim: a seeded shard kill mid-workload must lose
+    ZERO acked ops and journal-replay bit-identical, and the sharded
+    arm's steady-state stream must bypass the director (its CPU/op
+    collapses vs the legacy arm). The raw-throughput comparison only
+    binds where the box has enough cores to host the shard tier
+    (>= shards+2): below that every process timeshares the same cores
+    and the extra per-tick syscalls of 4 sockets dominate (see
+    MICROBENCH control_plane notes)."""
+    from ray_tpu.scalesim.harness import run_scalesim
+
+    kwargs = dict(shards=4, raylets=4, windows=3, window_s=0.5,
+                  client_procs=2, kill_shard=True, pool_size=16, seed=7)
+    try:
+        result = run_scalesim(**kwargs)
+    except (RuntimeError, TimeoutError):
+        # one retry: control-plane spawn can time out under residual
+        # box load from a previous test's teardown — the properties
+        # under test are unaffected
+        time.sleep(2.0)
+        result = run_scalesim(**kwargs)
+    kill = result["kill"]
+    assert kill["lost_ops"] == 0
+    assert kill["acked_ops_verified"] > 0
+    assert kill["replay_identical"] is True
+    # director bypass: steady-state table ops route around the director
+    ratio = result["director_bypass_ratio"]
+    assert ratio < 0.5, (
+        f"sharded arm still burns {ratio:.0%} of the legacy arm's "
+        f"director CPU per op — shard routing is not bypassing it")
+    if (os.cpu_count() or 2) >= result["shards"] + 2:
+        a = result["arms"][f"shards{result['shards']}"]
+        b = result["arms"]["shards1"]
+        assert (a["gcs_ops_per_s"]["median"]
+                >= b["gcs_ops_per_s"]["median"]), result["arms"]
+
+
+# ---------------------------------------------------------------------------
+# chaos sweep: shard/director primaries killed mid-workload (slow tier)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("seed", [0, 1, 2, 3, 4])
+def test_chaos_shard_and_director_kill(seed):
+    """5-seeded: kill a store-shard primary (and on odd seeds the
+    director too) mid-workload. Every workload completes or raises a
+    typed error within deadline — no hangs, no lost acked KV."""
+    import random
+
+    rng = random.Random(seed)
+    ray_tpu.init(num_cpus=2, _system_config={"gcs_shards": 2})
+    node = _api._global_node
+    try:
+        @ray_tpu.remote
+        def churn(i):
+            return i * i
+
+        acked = {}
+        deadline = time.monotonic() + scale_timeout(120)
+        victim = rng.randrange(2)
+        kill_director = bool(seed % 2)
+        for round_no in range(3):
+            refs = [churn.remote(i) for i in range(20)]
+            for i in range(6):
+                key = f"chaos-{seed}-{round_no}-{i}"
+                internal_kv._kv_put(key, b"%d" % i)
+                acked[key] = b"%d" % i
+            if round_no == 1:
+                node.kill_gcs_shard(victim)
+                if kill_director:
+                    node.kill_gcs()
+            got = ray_tpu.get(refs, timeout=max(
+                5.0, deadline - time.monotonic()))
+            assert got == [i * i for i in range(20)]
+        # acked KV must be readable after the kills (journal replay /
+        # director restart against its WAL) — retry while the monitor
+        # finishes restarting
+        while True:
+            try:
+                for key, val in acked.items():
+                    assert internal_kv._kv_get(key) == val
+                break
+            except AssertionError:
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.5)
+    finally:
+        ray_tpu.shutdown()

@@ -25,6 +25,32 @@ def _replicas(client, name):
     return client.get_backend_config(name).num_replicas
 
 
+def test_idle_backend_scales_down_without_router_traffic(serve_client):
+    """Regression: _maybe_autoscale used to run ONLY inside router
+    queue-length reports, so a deployment with no router traffic (here:
+    no endpoint at all, the handle-only shape) never converged — it sat
+    at its initial replica count forever. The controller's periodic
+    control-loop tick must shrink it to min_replicas by itself."""
+    client = serve_client
+
+    def noop(data):
+        return "ok"
+
+    client.create_backend("idle", noop, config=BackendConfig(
+        num_replicas=3, autoscaling=AutoscalingConfig(
+            min_replicas=1, max_replicas=3, target_queued=1.0,
+            downscale_delay_s=0.5).to_dict()))
+    assert _replicas(client, "idle") == 3
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        if _replicas(client, "idle") == 1:
+            break
+        time.sleep(0.2)
+    assert _replicas(client, "idle") == 1, (
+        "idle deployment never scaled down to min_replicas "
+        "(autoscale tick missing)")
+
+
 def test_scale_up_under_load_then_down(serve_client):
     client = serve_client
 
