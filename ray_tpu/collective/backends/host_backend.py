@@ -6,8 +6,18 @@ stub). Rendezvous goes through the GCS KV (the reference used a named
 "Info" actor, util.py) — rank 0 binds a TCP hub, publishes its address
 under `collective/<group>`, and every other rank connects.
 
-Three transports, selected per op by payload size and node placement:
+Four transports, selected per op by payload placement, size and node
+placement:
 
+device — the accelerator plane: when every rank's payload is a
+        jax.Array and the group's processes share one jax.distributed
+        runtime (parallel/multihost), the op dispatches through
+        xla_backend.DeviceTransport — cached jitted shard_map
+        collectives over a one-device-per-process mesh — so bytes ride
+        ICI/XLA and never touch host RAM. The vote is per op and
+        unanimous (a 1-byte kind-tagged hub ctl round, like the shm
+        ok-flag exchange); any rank holding a host array vetoes and the
+        op falls back to the tiers below.
 hub   — star topology, all contributions through rank 0's socket +
         shared op table. Latency-optimal for control-sized tensors
         (metrics, barriers, rendezvous); carries every op kind.
@@ -19,7 +29,11 @@ ring  — direct rank-to-rank TCP ring for large tensors: reduce-scatter
         work buffer go straight to sendall; recv_into fills scratch or
         the destination — no tobytes per step). The unpipelined ring
         allreduce is preserved verbatim as `ring_unpipelined`, the
-        control arm of the perf A/B.
+        control arm of the perf A/B. With `quantize="int8"` the
+        allreduce wire format becomes block-scaled int8 (EQuARX-style:
+        per-QUANT_BLOCK f32 scales ride ahead of each chunk's int8
+        payload, the reduce runs on dequantized float32) — ~4x fewer
+        socket bytes for float32 gradients.
 shm   — ranks that rendezvous on the same node map one tmpfs segment
         (native/store segment alloc) and collectives become pure memory
         traffic: write slot, counter-barrier, reduce a 1/w stripe,
@@ -29,7 +43,9 @@ shm   — ranks that rendezvous on the same node map one tmpfs segment
 Every tier keeps the abort-not-hang contract: a dead peer turns into a
 TimeoutError within the group timeout on every survivor (hub per-op
 timeouts, ring socket timeouts + teardown, shm barrier deadline + abort
-word), so the SGD layer above can resize the group.
+word, device vote round bounded by the hub deadline — a rank that dies
+inside an in-flight XLA collective is bounded by the device runtime's
+own failure detection), so the SGD layer above can resize the group.
 """
 
 from __future__ import annotations
@@ -43,9 +59,34 @@ import time
 import msgpack
 import numpy as np
 
-from ray_tpu.collective.types import _NUMPY_REDUCE, ReduceOp, Transport
+from ray_tpu._private import failpoints as _fp
+from ray_tpu.collective.types import (_NUMPY_REDUCE, QUANT_BLOCK, ReduceOp,
+                                      Transport, normalize_quantize)
 
 _HDR = struct.Struct(">I")
+
+# ops the int8 block-scaled wire format can carry (the reduce happens on
+# dequantized float32; PRODUCT would compound the per-hop error
+# multiplicatively, so it stays exact)
+_QUANT_OPS = (ReduceOp.SUM, ReduceOp.MEAN, ReduceOp.MAX, ReduceOp.MIN)
+
+
+def _quant_np(x: np.ndarray):
+    """Block-scaled symmetric int8 (numpy twin of
+    xla_backend.quantize_blocks — same block size and scale rule, so the
+    host-ring and device-ring formats agree, and so does the analytic
+    error bound): flat float32 [n] (n % QUANT_BLOCK == 0) ->
+    (int8 [n], float32 scales [n // QUANT_BLOCK])."""
+    b = x.reshape(-1, QUANT_BLOCK)
+    absmax = np.max(np.abs(b), axis=1)
+    scale = np.where(absmax > 0, absmax / 127.0, 1.0).astype(np.float32)
+    q = np.clip(np.rint(b / scale[:, None]), -127, 127).astype(np.int8)
+    return q.reshape(-1), scale
+
+
+def _dequant_np(q: np.ndarray, scale: np.ndarray) -> np.ndarray:
+    return (q.reshape(-1, QUANT_BLOCK).astype(np.float32)
+            * scale[:, None]).reshape(-1)
 
 
 def _send_msg(sock: socket.socket, header: dict, payload: bytes = b""):
@@ -179,7 +220,7 @@ class _CollectiveState:
                     "payload": out.tobytes(),
                     "dst": metas[ranks[0]].get("dst", -1)}
         if kind in ("allgather", "allgather_ctl_shm",
-                    "allgather_ctl_ring"):
+                    "allgather_ctl_ring", "allgather_ctl_device"):
             # ctl kinds: transport-plumbing exchanges (ring addresses,
             # shm ok flags), one kind EACH so a rank whose ROUTE diverged
             # (ragged sizes straddling RING_MIN_BYTES) pairs with a real
@@ -225,7 +266,8 @@ class _CollectiveState:
 
 class HostGroup:
     def __init__(self, group_name: str, world_size: int, rank: int,
-                 timeout: float = 60.0, transport: str = "auto"):
+                 timeout: float = 60.0, transport: str = "auto",
+                 quantize=None):
         from ray_tpu.experimental import internal_kv
 
         self.group_name = group_name
@@ -242,6 +284,18 @@ class HostGroup:
         # (tests/benchmarks); "auto" routes by size and node placement.
         tr = Transport(transport)
         self.force_transport = None if tr == Transport.AUTO else tr.value
+        # Group-default wire quantization (per-op quantize= overrides)
+        self.quantize = normalize_quantize(quantize)
+        # DEVICE tier state: built lazily on the first unanimous vote;
+        # _device_shaped is the group-uniform round-entry gate, decided
+        # ONCE at construction (ranks create the group at the same
+        # protocol step, so the multihost-runtime facts they read here
+        # agree by contract — a lazy read could catch ranks on opposite
+        # sides of a late multihost.initialize); _device_disabled is
+        # this rank's veto after a device failure
+        self._device = None
+        self._device_disabled = False
+        self._device_shaped: bool = self._compute_device_shaped()
         self._shm = None
         self._shm_gen = 0
         self._shm_disabled = False
@@ -448,6 +502,113 @@ class HostGroup:
                 e, TimeoutError):
             raise TimeoutError(f"collective aborted: {e}") from e
         raise e
+
+    # ---- device (ICI/XLA) data plane ----
+
+    @staticmethod
+    def _is_device_array(arr) -> bool:
+        from ray_tpu.collective.types import is_jax_array
+
+        return is_jax_array(arr)
+
+    def _to_host(self, arr) -> np.ndarray:
+        if not isinstance(arr, np.ndarray):
+            arr = np.asarray(arr)  # device arrays fall back to host here
+        return np.ascontiguousarray(arr)
+
+    def _quantize_mode(self, quantize):
+        """Per-op override (False forces exact) else the group default."""
+        return (self.quantize if quantize is None
+                else normalize_quantize(quantize))
+
+    def _compute_device_shaped(self) -> bool:
+        """Whether this GROUP enters the per-op device vote round. Only
+        stable, group-uniform facts are read — the multihost runtime
+        being active and sized to the group is the same on every rank
+        at group creation by contract, so every rank enters (or skips)
+        the ctl round together. Volatile, rank-local facts
+        (rank/process_index alignment, a one-sided device failure)
+        express themselves as a 0 VOTE inside the round instead, so
+        they degrade to a clean host-tier fallback rather than a
+        ctl-kind mismatch. Free for plain host groups — the multihost
+        flag check short-circuits before jax is touched."""
+        if self.world_size <= 1:
+            return False
+        try:
+            from ray_tpu.parallel import multihost
+
+            if not multihost.is_initialized():
+                return False
+            import jax
+
+            return jax.process_count() == self.world_size
+        except Exception:
+            return False
+
+    def _device_group_shaped(self) -> bool:
+        return bool(self._device_shaped) and not self._destroyed
+
+    def _ensure_device(self):
+        if self._device is None:
+            from ray_tpu.collective.backends.xla_backend import (
+                DeviceTransport)
+
+            # raises when rank != process_index — surfaces as a 0 vote
+            self._device = DeviceTransport(self.world_size, self.rank)
+        return self._device
+
+    def _device_route(self, arr) -> bool:
+        """Per-op DEVICE-tier agreement. True when EVERY rank voted
+        device (its payload is a jax.Array of a device-safe dtype, or
+        the tier is forced). The vote rides a 1-byte hub ctl round with
+        its own kind tag — like the shm ok-flag exchange — so a rank
+        whose route diverged pairs as a loud kind mismatch, never a
+        silent payload swap. Only multihost-shaped groups pay the
+        round; any host-array (or device-incapable) rank vetoes and
+        every rank falls back together."""
+        forced = self._forced()
+        if forced is not None and forced != Transport.DEVICE.value:
+            return False
+        if not self._device_group_shaped():
+            if forced == Transport.DEVICE.value:
+                self._forced_unavailable(forced)
+            return False
+        if _fp.ARMED:
+            # fires BEFORE the agreement round: a rank hard-killed here
+            # leaves every survivor timing out in the hub exchange
+            # (abort-not-hang). Once ranks enter the XLA dispatch the op
+            # inherits the device runtime's own failure detection.
+            _fp.fire_strict("collective.device_dispatch")
+        vote = 0
+        if not self._device_disabled and (
+                forced == Transport.DEVICE.value
+                or self._is_device_array(arr)):
+            try:
+                dev = self._ensure_device()
+                vote = 1 if dev.dtype_ok(arr.dtype) else 0
+            except Exception:
+                self._device_disabled = True
+        flags = self._hub_allgather(np.array([vote], np.uint8),
+                                    kind="allgather_ctl_device")
+        agreed = all(int(f[0]) for f in flags)
+        if not agreed and forced == Transport.DEVICE.value:
+            raise RuntimeError(
+                f"forced collective transport 'device' is unavailable "
+                f"for group {self.group_name!r}: the placement/dtype "
+                f"vote was not unanimous")
+        return agreed
+
+    def _device_op(self, fn):
+        from ray_tpu.collective import metrics  # noqa: F401 (register)
+
+        try:
+            return fn()
+        except Exception as e:
+            # a failed/interrupted device op leaves the runtime's
+            # collective state unknown: stop routing this group to the
+            # device plane and surface abort-not-hang semantics
+            self._device_disabled = True
+            self._abort_not_hang(e)
 
     def _shm_op(self, fn):
         try:
@@ -990,6 +1151,132 @@ class HostGroup:
         # fresh writable result on every rank/tier, like the hub
         return out.copy() if out is arr else out
 
+    # -- quantized (int8 block-scaled) pipelined ring ------------------
+
+    def _fire_quantize(self):
+        if _fp.ARMED:
+            _fp.fire_strict("collective.quantize")
+
+    def _ring_send_seq_async(self, parts: list[memoryview]):
+        """Stream a sequence of buffers (scales header, then payload) to
+        the next rank in order. Like _ring_send_async, tiny totals send
+        inline; anything larger rides one thread — the HEADER must not
+        be a blocking main-thread sendall, or every rank can sit in it
+        simultaneously once scales outgrow the socket buffers (circular
+        stall, spurious timeout) while nobody drains its peer."""
+        if sum(len(p) for p in parts) <= (1 << 14):
+            for p in parts:
+                self._ring_next.sendall(p)
+            return None, []
+        err: list = []
+
+        def _send():
+            try:
+                for p in parts:
+                    off, n = 0, len(p)
+                    while off < n:
+                        self._ring_next.sendall(
+                            p[off:off + self._PIPE_BYTES])
+                        off += self._PIPE_BYTES
+            except Exception as e:
+                err.append(e)
+
+        t = threading.Thread(target=_send, daemon=True)
+        t.start()
+        return t, err
+
+    def _ring_step_qreduce(self, send_chunk: np.ndarray, dst: np.ndarray,
+                           combine):
+        """One quantized ring step: quantize and stream the outgoing
+        chunk (per-block f32 scales ride ahead of the int8 payload)
+        while receiving the peer's, dequantizing and combining
+        pipeline-slice by slice into `dst` (float32). Wire bytes per
+        chunk: elems * (1 + 4/QUANT_BLOCK) instead of elems * 4."""
+        self._fire_quantize()
+        q, scales = _quant_np(send_chunk)
+        t, err = self._ring_send_seq_async(
+            [memoryview(scales).cast("B"), memoryview(q).cast("B")])
+        n = dst.size  # elements == int8 payload bytes
+        rscales = np.empty(n // QUANT_BLOCK, np.float32)
+        self._ring_recv_into(memoryview(rscales).cast("B"))
+        rq = np.empty(min(self._PIPE_BYTES, n), np.int8)
+        off = 0
+        while off < n:  # slices stay QUANT_BLOCK-aligned (2^18 % 256 == 0)
+            k = min(self._PIPE_BYTES, n - off)
+            self._ring_recv_into(memoryview(rq).cast("B")[:k])
+            deq = (rq[:k].reshape(-1, QUANT_BLOCK).astype(np.float32)
+                   * rscales[off // QUANT_BLOCK:
+                             (off + k) // QUANT_BLOCK, None]).reshape(-1)
+            combine(dst[off:off + k], deq, out=dst[off:off + k])
+            off += k
+        self._ring_join(t, err)
+
+    def _ring_allreduce_quantized(self, arr: np.ndarray,
+                                  op: ReduceOp) -> np.ndarray:
+        """EQuARX-style quantized pipelined ring allreduce: every hop of
+        the reduce-scatter phase re-quantizes the partial chunk to
+        int8 + per-block f32 scales and combines on the dequantized
+        float32 values; the allgather phase quantizes the reduced chunk
+        ONCE and relays the same bytes, so every rank dequantizes
+        identical data and the (lossy) result agrees bitwise across
+        ranks. Analytic error bound: each of the <= world quantization
+        steps that touch an output element perturbs it by at most
+        scale/2 <= absmax/254 of the partial it quantized."""
+        w = self.world_size
+        in_dt = arr.dtype
+        n = arr.size
+        # uniform block-aligned chunks (zero padding never inflates a
+        # block's absmax, and the pad region is sliced off at the end)
+        per_rank = -(-n // w)
+        C = -(-per_rank // QUANT_BLOCK) * QUANT_BLOCK
+        work = np.zeros(w * C, np.float32)
+        work[:n] = arr.reshape(-1)
+        combine = getattr(np, _NUMPY_REDUCE[
+            ReduceOp.SUM if op == ReduceOp.MEAN else ReduceOp(op)])
+
+        def chunk(i):
+            i %= w
+            return work[i * C:(i + 1) * C]
+
+        # reduce-scatter (delta=0 schedule): w-1 quantized hops — rank r
+        # ends holding the fully-reduced chunk (r+1) % w
+        for step in range(w - 1):
+            send_i = self.rank - step
+            self._ring_step_qreduce(chunk(send_i), chunk(send_i - 1),
+                                    combine)
+        # allgather: quantize the reduced chunk once, relay the same
+        # bytes around the ring; the own chunk goes through the same
+        # dequant so all ranks hold bit-identical results
+        self._fire_quantize()
+        own = (self.rank + 1) % w
+        q, scales = _quant_np(chunk(own))
+        work[own * C:(own + 1) * C] = _dequant_np(q, scales)
+        rq = np.empty(C, np.int8)
+        rscales = np.empty(C // QUANT_BLOCK, np.float32)
+        for step in range(w - 1):
+            t, err = self._ring_send_seq_async(
+                [memoryview(scales).cast("B"), memoryview(q).cast("B")])
+            self._ring_recv_into(memoryview(rscales).cast("B"))
+            self._ring_recv_into(memoryview(rq).cast("B"))
+            self._ring_join(t, err)
+            idx = (self.rank - step) % w
+            work[idx * C:(idx + 1) * C] = _dequant_np(rq, rscales)
+            q, scales = rq.copy(), rscales.copy()  # relay onward
+        # socket bytes saved vs the exact tier's wire dtype
+        wire_elems = 2 * (w - 1) * C
+        exact_item = (4 if (op == ReduceOp.MEAN and in_dt == np.float16)
+                      else in_dt.itemsize)
+        saved = wire_elems * exact_item - wire_elems * (
+            1 + 4 / QUANT_BLOCK)
+        if saved > 0:
+            from ray_tpu.collective import metrics as _cm
+
+            _cm.QUANT_SAVED.inc(int(saved))
+        out = work[:n]
+        if op == ReduceOp.MEAN:
+            out = out / w
+        return out.astype(in_dt, copy=False).reshape(arr.shape).copy()
+
     # ---- collectives (routed) ----
 
     def _run_routed(self, arr: np.ndarray, shm_need: int, shm_fn, ring_fn,
@@ -1013,9 +1300,14 @@ class HostGroup:
             return hub_fn()
         raise RuntimeError("no collective transport available")
 
-    def allreduce(self, arr: np.ndarray, op: ReduceOp = ReduceOp.SUM):
-        arr = np.ascontiguousarray(arr)
+    def allreduce(self, arr: np.ndarray, op: ReduceOp = ReduceOp.SUM,
+                  quantize=None):
         op = ReduceOp(op)
+        q = self._quantize_mode(quantize)
+        if self._device_route(arr):
+            return self._device_op(
+                lambda: self._device.allreduce(arr, op, quantize=q))
+        arr = self._to_host(arr)
 
         def hub():
             reply, data = self._collective(
@@ -1023,17 +1315,24 @@ class HostGroup:
                 arr.tobytes())
             return _arr_from(reply["meta"], data)
 
+        def ring(pipelined):
+            # the quantized wire format lives on the pipelined ring (the
+            # unpipelined arm is the exact A/B control); int payloads
+            # and PRODUCT stay exact by definition
+            if (pipelined and q and op in _QUANT_OPS
+                    and np.issubdtype(arr.dtype, np.floating)):
+                return self._ring_allreduce_quantized(arr, op)
+            return (self._ring_allreduce_pipelined(arr, op) if pipelined
+                    else self._ring_allreduce(arr, op))
+
         return self._run_routed(
             arr, self._shm_need(arr, op),
             lambda t: t.allreduce(arr, op),
-            lambda pipelined: (self._ring_allreduce_pipelined(arr, op)
-                               if pipelined else
-                               self._ring_allreduce(arr, op)),
-            hub)
+            ring, hub)
 
     def reduce(self, arr: np.ndarray, dst_rank: int = 0,
                op: ReduceOp = ReduceOp.SUM):
-        arr = np.ascontiguousarray(arr)
+        arr = self._to_host(arr)
         reply, data = self._collective(
             "reduce", {**_arr_meta(arr), "op": op.value, "dst": dst_rank},
             arr.tobytes())
@@ -1042,7 +1341,10 @@ class HostGroup:
         return arr
 
     def broadcast(self, arr: np.ndarray, src_rank: int = 0):
-        arr = np.ascontiguousarray(arr)
+        if self._device_route(arr):
+            return self._device_op(
+                lambda: self._device.broadcast(arr, src_rank))
+        arr = self._to_host(arr)
 
         def hub():
             payload = arr.tobytes() if self.rank == src_rank else b""
@@ -1065,11 +1367,17 @@ class HostGroup:
         # ragged gathers natively) otherwise. One extra control
         # round-trip, paid once, instead of per-tier probing — and no
         # route divergence is possible.
-        arr = np.ascontiguousarray(arr)
+        if not self._is_device_array(arr):
+            arr = np.ascontiguousarray(arr)
         if self.world_size == 1 or self._destroyed:
-            return self._hub_allgather(arr)
+            return self._hub_allgather(self._to_host(arr))
         metas = self._hub_allgather_meta(arr)
         uniform = all(m == metas[0] for m in metas[1:])
+        # the device vote only happens on the uniform path, so every
+        # rank enters (or skips) the ctl round together
+        if uniform and self._device_route(arr):
+            return self._device_op(lambda: self._device.allgather(arr))
+        arr = self._to_host(arr)
         for tr in self._route(arr) if uniform else [Transport.HUB.value]:
             if tr == Transport.SHM.value:
                 t = self._ensure_shm(self._shm_need(arr, None))
@@ -1091,9 +1399,14 @@ class HostGroup:
         # the hub is the only tier that can express it
         return self._hub_allgather(arr)
 
-    def reducescatter(self, arr: np.ndarray, op: ReduceOp = ReduceOp.SUM):
-        arr = np.ascontiguousarray(arr)
+    def reducescatter(self, arr: np.ndarray, op: ReduceOp = ReduceOp.SUM,
+                      quantize=None):
         op = ReduceOp(op)
+        if self._device_route(arr):
+            return self._device_op(
+                lambda: self._device.reducescatter(
+                    arr, op, quantize=self._quantize_mode(quantize)))
+        arr = self._to_host(arr)
 
         def hub():
             reply, data = self._collective(
@@ -1231,6 +1544,12 @@ class HostGroup:
             return
         self._destroyed = True
         self._ring_teardown()
+        if self._device is not None:
+            try:
+                self._device.destroy()  # drops the jit cache; the jax
+            except Exception:           # runtime itself outlives groups
+                pass
+            self._device = None
         with self._p2p_lock:
             pending = list(self._p2p_direct.values())
             self._p2p_direct.clear()

@@ -7,111 +7,334 @@ corresponding lax collective (psum / all_gather / psum_scatter / ppermute),
 so on TPU the transfer rides ICI links and fuses with surrounding
 computation when called under jit.
 
-Single-controller model: one process drives all devices in the group
-("ranks" = devices, not processes). The caller holds a stacked array whose
-leading axis is the rank axis; each op returns the per-rank results stacked
-the same way. For multi-host pods the same code runs under
-jax.distributed with a global mesh (see ray_tpu.parallel.multihost).
+One implementation, three front doors (the former xla_global.py global-mesh
+group is unified here — the shard_map plumbing exists exactly once):
+
+- `XlaGroup` — single-controller: one process drives all devices in the
+  group ("ranks" = devices). The caller holds a stacked array whose
+  leading axis is the rank axis; each op returns per-rank results stacked
+  the same way.
+- `ProcessMeshGroup` (alias `GlobalMeshGroup`) — Backend.XLA across actor
+  PROCESSES: N actors joined one jax.distributed runtime
+  (parallel/multihost) are one rank each; ops ride the global mesh.
+- `DeviceTransport` — the HOST backend's Transport.DEVICE tier
+  (host_backend._device_route): per-op dispatch of a host collective
+  group onto the device plane when every rank holds a jax.Array and the
+  runtime spans the group.
+
+All three share `_DeviceOps`, a cache of jitted shard_map bodies keyed by
+(op kind, dtype, shape-class): flat payloads pad to the next power of two
+so nearby sizes reuse one compiled body and the cache stays O(log size)
+per op/dtype instead of one entry per exact shape.
+
+Quantized allreduce (`quantize="int8"`, EQuARX-style — PAPERS.md): the
+payload is cut into QUANT_BLOCK-element blocks, each carried as int8
+values plus one float32 scale (absmax/127), and the op runs as a
+ppermute ring inside one shard_map body — the reduce-scatter phase
+re-quantizes the partial sum every hop and accumulates on the
+dequantized float32 values; the allgather phase quantizes the reduced
+chunk once and relays the same bytes, so every rank dequantizes
+identical data and outputs agree bitwise across ranks. ICI transfer
+volume drops ~4x for float32 (int8 payload + one f32 scale per block).
 """
 
 from __future__ import annotations
 
-import functools
+import numpy as np
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, PartitionSpec as P
 
-from ray_tpu.collective.types import ReduceOp
+from ray_tpu.collective.types import (QUANT_BLOCK, ReduceOp,
+                                      normalize_quantize)
 
 AXIS = "ranks"
 
 
 def _shard_map(fn, mesh, in_specs, out_specs):
-    return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
-                         out_specs=out_specs, check_vma=False)
+    # the one version-portable shim, shared with the sharded kernels
+    from ray_tpu.parallel.mesh import shard_map
+
+    return shard_map(fn, mesh, in_specs, out_specs)
+
+
+def _bucket(n: int) -> int:
+    """Shape-class for the jit cache: next power of two >= n (floor 16)."""
+    return 1 << max(4, (max(n, 1) - 1).bit_length())
+
+
+def quantize_blocks(x, block: int = QUANT_BLOCK):
+    """Block-scaled symmetric int8: flat float [n] (n % block == 0) ->
+    (int8 [n], float32 scales [n // block]); scale = absmax/127 per
+    block (1.0 for all-zero blocks so dequant stays exact zeros)."""
+    b = x.reshape(-1, block)
+    absmax = jnp.max(jnp.abs(b), axis=1)
+    scale = jnp.where(absmax > 0, absmax / 127.0, 1.0).astype(jnp.float32)
+    q = jnp.clip(jnp.round(b / scale[:, None]), -127, 127).astype(jnp.int8)
+    return q.reshape(-1), scale
+
+
+def dequantize_blocks(q, scale, block: int = QUANT_BLOCK):
+    return (q.reshape(-1, block).astype(jnp.float32)
+            * scale[:, None]).reshape(-1)
+
+
+# combine step for the quantized ring (MEAN accumulates with add; the
+# caller divides by world size at the end)
+_QRING_COMBINE = {
+    ReduceOp.SUM: jnp.add,
+    ReduceOp.MEAN: jnp.add,
+    ReduceOp.MAX: jnp.maximum,
+    ReduceOp.MIN: jnp.minimum,
+}
+
+
+class _DeviceOps:
+    """Cached jitted shard_map collectives over one mesh axis.
+
+    Bodies operate on the flat [world, B] layout (each rank holds one
+    [1, B] row of an axis-sharded global array); the cache key is
+    (op kind, dtype, shape-class, static extras), so compilation is paid
+    once per size class and shared by every caller of the mesh."""
+
+    def __init__(self, mesh, axis: str, world: int):
+        self.mesh = mesh
+        self.axis = axis
+        self.world = world
+        self._cache: dict = {}
+
+    def _jit(self, key, body, out_specs=None):
+        fn = self._cache.get(key)
+        if fn is None:
+            from jax.sharding import PartitionSpec as P
+
+            fn = self._cache[key] = jax.jit(_shard_map(
+                body, self.mesh, P(self.axis, None),
+                out_specs if out_specs is not None
+                else P(self.axis, None)))
+        return fn
+
+    # -- exact bodies ---------------------------------------------------
+
+    def allreduce(self, garr, op: ReduceOp):
+        axis = self.axis
+        op = ReduceOp(op)
+        kind = ReduceOp.SUM if op == ReduceOp.MEAN else op
+        key = ("ar", kind.value, garr.dtype.name, garr.shape[1])
+        if op in (ReduceOp.SUM, ReduceOp.MEAN):
+            def body(x):
+                return jax.lax.psum(x, axis)
+        elif op == ReduceOp.MAX:
+            def body(x):
+                return jax.lax.pmax(x, axis)
+        elif op == ReduceOp.MIN:
+            def body(x):
+                return jax.lax.pmin(x, axis)
+        else:  # PRODUCT: no lax primitive — gather rows, multiply local
+            def body(x):
+                return jnp.prod(jax.lax.all_gather(x[0], axis), axis=0)[None]
+        return self._jit(key, body)(garr)
+
+    def allgather(self, garr):
+        from jax.sharding import PartitionSpec as P
+
+        axis = self.axis
+        key = ("ag", garr.dtype.name, garr.shape[1])
+
+        def body(x):
+            return jax.lax.all_gather(x[0], axis)[None]
+
+        return self._jit(key, body, P(axis, None, None))(garr)
+
+    def reducescatter_even(self, garr):
+        """[w, P] -> [w, P//w]: rank r's row is the sum of everyone's
+        chunk r (psum_scatter; P must divide by world)."""
+        axis = self.axis
+        key = ("rs", garr.dtype.name, garr.shape[1])
+
+        def body(x):
+            return jax.lax.psum_scatter(x[0], axis, scatter_dimension=0,
+                                        tiled=True)[None]
+
+        return self._jit(key, body)(garr)
+
+    def broadcast(self, garr, src: int):
+        axis = self.axis
+        key = ("bc", src, garr.dtype.name, garr.shape[1])
+
+        def body(x):
+            r = jax.lax.axis_index(axis)
+            return jax.lax.psum(
+                jnp.where(r == src, x, jnp.zeros_like(x)), axis)
+
+        return self._jit(key, body)(garr)
+
+    def shift_right(self, garr):
+        axis, w = self.axis, self.world
+        perm = [(i, (i + 1) % w) for i in range(w)]
+        key = ("shift", garr.dtype.name, garr.shape[1])
+
+        def body(x):
+            return jax.lax.ppermute(x, axis, perm)
+
+        return self._jit(key, body)(garr)
+
+    # -- quantized ring -------------------------------------------------
+
+    def allreduce_quantized(self, garr, op: ReduceOp):
+        """garr: [w, w*C] float32, C % QUANT_BLOCK == 0. Block-scaled
+        int8 ppermute ring: w-1 reduce hops (re-quantize the partial
+        each hop, combine dequantized f32), then quantize the reduced
+        chunk once and relay the same bytes w-1 gather hops — all ranks
+        dequantize identical data, so outputs agree bitwise."""
+        axis, w = self.axis, self.world
+        cmb = _QRING_COMBINE[ReduceOp(op)]
+        C = garr.shape[1] // w
+        perm = [(i, (i + 1) % w) for i in range(w)]
+        key = ("qar", ReduceOp(op).value if cmb is not jnp.add else "add",
+               garr.dtype.name, garr.shape[1])
+
+        def body(x):
+            r = jax.lax.axis_index(axis)
+            chunks = x[0].reshape(w, C)
+
+            def fwd(v):
+                return jax.lax.ppermute(v, axis, perm)
+
+            # reduce-scatter: after w-1 hops rank r holds chunk (r+1)%w
+            acc = jnp.take(chunks, r, axis=0)
+            for s in range(1, w):
+                q, sc = quantize_blocks(acc)
+                q, sc = fwd(q), fwd(sc)
+                acc = cmb(dequantize_blocks(q, sc),
+                          jnp.take(chunks, (r - s) % w, axis=0))
+            # allgather: quantize once, relay the same bytes
+            q, sc = quantize_blocks(acc)
+            out = jnp.zeros((w, C), jnp.float32)
+            out = out.at[(r + 1) % w].set(dequantize_blocks(q, sc))
+            for s in range(1, w):
+                q, sc = fwd(q), fwd(sc)
+                out = out.at[(r - s + 1) % w].set(dequantize_blocks(q, sc))
+            return out.reshape(1, w * C)
+
+        return self._jit(key, body)(garr)
+
+
+def _qring_pad(n: int, w: int) -> int:
+    """Padded per-rank payload length for the quantized ring: bucket the
+    size class, then round the per-rank chunk up to the quant block."""
+    c = -(-_bucket(n) // w)
+    c = -(-c // QUANT_BLOCK) * QUANT_BLOCK
+    return w * c
+
+
+def _qring_saved_bytes(n_padded: int, w: int, in_dtype, op) -> int:
+    """Wire bytes the int8 format avoids for one quantized ring
+    allreduce: 2(w-1) chunk hops of C elements each, the EXACT tier's
+    wire dtype (input dtype, except f16 MEAN which accumulates f32 on
+    the exact paths) vs int8 payload + one f32 scale per block."""
+    if ReduceOp(op) == ReduceOp.MEAN and np.dtype(in_dtype) == np.float16:
+        itemsize = 4
+    else:
+        itemsize = np.dtype(in_dtype).itemsize
+    c = n_padded // w
+    hops = 2 * max(w - 1, 0)
+    exact = hops * c * itemsize
+    quant = hops * (c + 4 * (c // QUANT_BLOCK))
+    return max(exact - quant, 0)
 
 
 class XlaGroup:
-    def __init__(self, group_name: str, devices=None):
+    """Single-controller device group: one process drives all devices
+    ("ranks" = devices, not processes). The caller holds a stacked array
+    whose leading axis is the rank axis; each op returns the per-rank
+    results stacked the same way."""
+
+    def __init__(self, group_name: str, devices=None, quantize=None):
+        from jax.sharding import Mesh
+
         self.group_name = group_name
         self.devices = list(devices) if devices is not None else jax.devices()
         self.world_size = len(self.devices)
-        self.mesh = Mesh(self.devices, (AXIS,))
+        self.quantize = normalize_quantize(quantize)
+        self.mesh = Mesh(np.asarray(self.devices), (AXIS,))
+        self._ops = _DeviceOps(self.mesh, AXIS, self.world_size)
 
-    # Each op: stacked input of shape [world_size, ...] -> stacked output.
+    def _flat(self, stacked, pad_to: int | None = None, dtype=None):
+        """[w, ...] -> (mesh-sharded [w, B], n, trailing shape)."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
 
-    @functools.cached_property
-    def _allreduce_sum(self):
-        return jax.jit(_shard_map(
-            lambda x: jax.lax.psum(x, AXIS), self.mesh, P(AXIS), P(AXIS)))
+        x = jnp.asarray(stacked)
+        if dtype is not None:
+            x = x.astype(dtype)
+        trailing = x.shape[1:]
+        n = int(np.prod(trailing)) if trailing else 1
+        flat = x.reshape(self.world_size, n)
+        B = pad_to if pad_to is not None else _bucket(n)
+        if n < B:
+            flat = jnp.pad(flat, ((0, 0), (0, B - n)))
+        flat = jax.device_put(flat, NamedSharding(self.mesh, P(AXIS, None)))
+        return flat, n, trailing
 
-    @functools.cached_property
-    def _allreduce_max(self):
-        return jax.jit(_shard_map(
-            lambda x: jax.lax.pmax(x, AXIS), self.mesh, P(AXIS), P(AXIS)))
-
-    @functools.cached_property
-    def _allreduce_min(self):
-        return jax.jit(_shard_map(
-            lambda x: jax.lax.pmin(x, AXIS), self.mesh, P(AXIS), P(AXIS)))
-
-    @functools.cached_property
-    def _allreduce_mean(self):
-        return jax.jit(_shard_map(
-            lambda x: jax.lax.pmean(x, AXIS), self.mesh, P(AXIS), P(AXIS)))
-
-    def allreduce(self, stacked, op: ReduceOp = ReduceOp.SUM):
+    def allreduce(self, stacked, op: ReduceOp = ReduceOp.SUM, quantize=None):
         """stacked: [world, ...]; returns [world, ...] where every slice is
         the reduction across the leading axis."""
-        fn = {
-            ReduceOp.SUM: self._allreduce_sum,
-            ReduceOp.MAX: self._allreduce_max,
-            ReduceOp.MIN: self._allreduce_min,
-            ReduceOp.MEAN: self._allreduce_mean,
-        }[ReduceOp(op)]
-        return fn(stacked)
+        op = ReduceOp(op)
+        q = normalize_quantize(
+            self.quantize if quantize is None else quantize)
+        stacked = jnp.asarray(stacked)
+        in_dt = stacked.dtype
+        if (q and op in _QRING_COMBINE
+                and jnp.issubdtype(in_dt, jnp.floating)):
+            n = int(np.prod(stacked.shape[1:])) if stacked.ndim > 1 else 1
+            flat, n, trailing = self._flat(
+                stacked, pad_to=_qring_pad(n, self.world_size),
+                dtype=jnp.float32)
+            out = self._ops.allreduce_quantized(flat, op)
+            from ray_tpu.collective import metrics as _cm
 
-    @functools.cached_property
-    def _allgather(self):
-        # per-rank shard [1, ...] -> full copy on every rank
-        def body(x):
-            return jax.lax.all_gather(x[0], AXIS)[None]
-
-        return jax.jit(_shard_map(body, self.mesh, P(AXIS), P(AXIS)))
+            _cm.QUANT_SAVED.inc(_qring_saved_bytes(
+                flat.shape[1], self.world_size, in_dt, op))
+            out = out[:, :n]
+            if op == ReduceOp.MEAN:
+                out = out / self.world_size
+            return out.astype(in_dt).reshape(
+                (self.world_size,) + trailing)
+        flat, n, trailing = self._flat(stacked)
+        out = self._ops.allreduce(flat, op)
+        out = out[:, :n]
+        if op == ReduceOp.MEAN:
+            out = out / self.world_size
+            out = out.astype(in_dt) if jnp.issubdtype(
+                in_dt, jnp.floating) else out
+        return out.reshape((self.world_size,) + trailing)
 
     def allgather(self, stacked):
         """[world, ...] -> [world, world, ...]: every rank sees all slices."""
-        return self._allgather(stacked)
+        flat, n, trailing = self._flat(stacked)
+        out = self._ops.allgather(flat)  # [w, w, B]
+        w = self.world_size
+        return out[:, :, :n].reshape((w, w) + trailing)
 
-    @functools.cached_property
-    def _reducescatter(self):
-        def body(x):
-            # x: [1, world*chunk, ...] per rank; scatter the sum along axis 1
-            return jax.lax.psum_scatter(x[0], AXIS, scatter_dimension=0,
-                                        tiled=False)
-
-        return jax.jit(_shard_map(body, self.mesh, P(AXIS), P(AXIS)))
-
-    def reducescatter(self, stacked):
+    def reducescatter(self, stacked, op: ReduceOp = ReduceOp.SUM,
+                      quantize=None):
         """[world, world, ...] -> [world, ...]: rank r holds sum of
-        stacked[:, r]."""
-        out = self._reducescatter(stacked)
-        return out
-
-    @functools.cached_property
-    def _ppermute_right(self):
-        perm = [(i, (i + 1) % self.world_size)
-                for i in range(self.world_size)]
-
-        def body(x):
-            return jax.lax.ppermute(x, AXIS, perm)
-
-        return jax.jit(_shard_map(body, self.mesh, P(AXIS), P(AXIS)))
+        stacked[:, r] (psum_scatter over the tiled flat layout)."""
+        if ReduceOp(op) != ReduceOp.SUM:
+            raise NotImplementedError(
+                "single-controller reducescatter lowers to psum_scatter "
+                "(SUM only)")
+        w = self.world_size
+        x = jnp.asarray(stacked)
+        flat = x.reshape(w, -1)  # [w, w*T] — tiled chunks line up with
+        out = self._ops.reducescatter_even(flat)   # the stacked rows
+        return out.reshape((w,) + x.shape[2:])
 
     def shift_right(self, stacked):
         """Ring permute: rank r's slice moves to rank (r+1) % world."""
-        return self._ppermute_right(stacked)
+        flat, n, trailing = self._flat(stacked)
+        out = self._ops.shift_right(flat)
+        return out[:, :n].reshape((self.world_size,) + trailing)
 
     def broadcast(self, value, src_rank: int = 0):
         src = value[src_rank] if value.ndim and value.shape[0] == \
@@ -124,4 +347,261 @@ class XlaGroup:
         jax.block_until_ready(self.allreduce(x))
 
     def destroy(self):
-        pass
+        self._ops._cache.clear()
+
+
+class DeviceTransport:
+    """Transport.DEVICE: one collective RANK per PROCESS of the active
+    jax.distributed runtime (parallel/multihost). Each rank's payload
+    becomes one row of a [world, B] global array sharded over a
+    one-device-per-process mesh; ops are the cached `_DeviceOps` bodies,
+    so on TPU pods the bytes ride ICI/DCN through XLA's compiled
+    collectives without touching host RAM. Serves as the data plane of
+    ProcessMeshGroup (backend="xla" across actors) and as the HOST
+    backend's per-op DEVICE tier (host_backend._device_route)."""
+
+    AXIS = "proc"
+
+    def __init__(self, world_size: int, rank: int):
+        n_proc = jax.process_count()
+        if world_size != n_proc:
+            raise ValueError(
+                f"device collective group needs one rank per joined "
+                f"process: world_size={world_size} but "
+                f"jax.process_count()={n_proc}")
+        if rank != jax.process_index():
+            raise ValueError(
+                f"rank {rank} must equal jax.process_index() "
+                f"{jax.process_index()} — the global runtime fixes rank "
+                "order")
+        self.world_size = world_size
+        self.rank = rank
+        by_proc: dict[int, list] = {}
+        for d in jax.devices():
+            by_proc.setdefault(d.process_index, []).append(d)
+        if len(by_proc) != n_proc:
+            raise ValueError(
+                f"expected devices from {n_proc} processes, saw "
+                f"{len(by_proc)}")
+        # one device per process: the rank axis maps 1:1 onto processes
+        # and a rank's row never replicates across sibling local devices
+        from jax.sharding import Mesh
+
+        devs = [by_proc[p][0] for p in sorted(by_proc)]
+        self._local_dev = devs[rank]
+        self.mesh = Mesh(np.asarray(devs), (self.AXIS,))
+        self._ops = _DeviceOps(self.mesh, self.AXIS, world_size)
+        self._dtype_ok_cache: dict = {}
+
+    # -- plumbing -------------------------------------------------------
+
+    def dtype_ok(self, dtype) -> bool:
+        """jax must preserve the payload dtype (with x64 disabled f64/i64
+        silently demote to 32-bit, which would break cross-tier
+        exactness — such payloads stay on the host tiers)."""
+        dtype = np.dtype(dtype)
+        ok = self._dtype_ok_cache.get(dtype.str)
+        if ok is None:
+            try:
+                ok = jnp.asarray(np.empty(0, dtype)).dtype == dtype
+            except (TypeError, ValueError):
+                ok = False
+            self._dtype_ok_cache[dtype.str] = ok
+        return ok
+
+    def _lift(self, flat, B: int, dtype) -> jax.Array:
+        """Local flat [n] payload -> this rank's [1, B] row of the
+        [world, B] global array. Device-resident inputs move
+        device-to-device; host arrays upload once."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        x = jnp.asarray(flat, dtype)
+        n = x.shape[0]
+        if n < B:
+            x = jnp.pad(x, (0, B - n))
+        x = jax.device_put(x.reshape(1, B), self._local_dev)
+        sharding = NamedSharding(self.mesh, P(self.AXIS, None))
+        return jax.make_array_from_single_device_arrays(
+            (self.world_size, B), sharding, [x])
+
+    @staticmethod
+    def _local_row(garr) -> jax.Array:
+        """This process's row of a P(proc, ...) sharded output."""
+        return garr.addressable_shards[0].data[0]
+
+    @staticmethod
+    def _is_np_in(arr) -> bool:
+        from ray_tpu.collective.types import is_jax_array
+
+        return not is_jax_array(arr)
+
+    @staticmethod
+    def _deliver(x, np_out: bool):
+        return np.asarray(x) if np_out else x
+
+    def _counted(self):
+        from ray_tpu.collective import metrics as _cm
+
+        _cm.DEVICE_OPS.inc()
+
+    # -- op surface (mirrors host_backend semantics) --------------------
+
+    def allreduce(self, arr, op: ReduceOp = ReduceOp.SUM, quantize=None):
+        op = ReduceOp(op)
+        q = normalize_quantize(quantize)
+        np_in = self._is_np_in(arr)
+        in_dt = np.dtype(arr.dtype)
+        shape, n = tuple(arr.shape), int(arr.size)
+        floating = np.issubdtype(in_dt, np.floating)
+        flat = arr.reshape(-1)
+        self._counted()
+        if q and floating and op in _QRING_COMBINE:
+            return self._allreduce_quantized(flat, n, shape, in_dt, op,
+                                             np_in)
+        if op == ReduceOp.MEAN and not floating:
+            # hub semantics: integer MEAN promotes to float64 — the exact
+            # integer SUM runs on device, the division on the host (f64
+            # doesn't exist on device with x64 off, so promotion leaves
+            # the device plane by definition)
+            total = np.asarray(
+                self.allreduce(arr, ReduceOp.SUM), np.float64)
+            return total / self.world_size
+        work_dt = in_dt
+        if op == ReduceOp.MEAN and in_dt == np.float16:
+            work_dt = np.dtype(np.float32)  # f32 accumulate, f16 out
+        garr = self._lift(flat, _bucket(n), work_dt)
+        row = self._local_row(self._ops.allreduce(garr, op))[:n]
+        if op == ReduceOp.MEAN:
+            row = (row / self.world_size).astype(in_dt)
+        return self._deliver(row.reshape(shape), np_in)
+
+    def _allreduce_quantized(self, flat, n, shape, in_dt, op, np_in):
+        from ray_tpu._private import failpoints as _fp
+
+        if _fp.ARMED:
+            _fp.fire_strict("collective.quantize")
+        w = self.world_size
+        padded = _qring_pad(n, w)
+        garr = self._lift(flat, padded, np.dtype(np.float32))
+        row = self._local_row(self._ops.allreduce_quantized(garr, op))[:n]
+        from ray_tpu.collective import metrics as _cm
+
+        _cm.QUANT_SAVED.inc(_qring_saved_bytes(padded, w, in_dt, op))
+        if op == ReduceOp.MEAN:
+            row = row / w
+        return self._deliver(row.astype(in_dt).reshape(shape), np_in)
+
+    def reduce(self, arr, dst_rank: int = 0,
+               op: ReduceOp = ReduceOp.SUM, quantize=None):
+        out = self.allreduce(arr, op, quantize=quantize)
+        return out if self.rank == dst_rank else arr
+
+    def broadcast(self, arr, src_rank: int = 0):
+        np_in = self._is_np_in(arr)
+        in_dt = np.dtype(arr.dtype)
+        shape, n = tuple(arr.shape), int(arr.size)
+        self._counted()
+        garr = self._lift(arr.reshape(-1), _bucket(n), in_dt)
+        row = self._local_row(self._ops.broadcast(garr, src_rank))[:n]
+        return self._deliver(row.reshape(shape), np_in)
+
+    def allgather(self, arr) -> list:
+        np_in = self._is_np_in(arr)
+        shape, n = tuple(arr.shape), int(arr.size)
+        self._counted()
+        garr = self._lift(arr.reshape(-1), _bucket(n), np.dtype(arr.dtype))
+        local = self._local_row(self._ops.allgather(garr))  # [w, B]
+        return [self._deliver(local[i, :n].reshape(shape), np_in)
+                for i in range(self.world_size)]
+
+    def reducescatter(self, arr, op: ReduceOp = ReduceOp.SUM,
+                      quantize=None):
+        # hub semantics: reduce, then np.array_split along axis 0
+        from ray_tpu.collective.backends.shm_transport import split_bounds
+
+        op = ReduceOp(op)
+        np_in = self._is_np_in(arr)
+        w = self.world_size
+        rows = arr.shape[0] if arr.ndim else 1
+        rb = split_bounds(rows, w)
+        if (op == ReduceOp.SUM and arr.ndim and rows and rows % w == 0
+                and not normalize_quantize(quantize)):
+            # even split: one psum_scatter moves 1/w of the bytes an
+            # allreduce would
+            self._counted()
+            n = int(arr.size)
+            garr = self._lift(arr.reshape(-1), n, np.dtype(arr.dtype))
+            mine = self._local_row(self._ops.reducescatter_even(garr))
+            return self._deliver(
+                mine.reshape((rows // w,) + tuple(arr.shape[1:])), np_in)
+        total = self.allreduce(arr, op, quantize=quantize)
+        return total[rb[self.rank]:rb[self.rank + 1]]
+
+    def barrier(self):
+        np.asarray(self.allreduce(np.zeros(1, np.float32)))
+
+    def send(self, arr, dst_rank: int, tag: int = 0):
+        raise NotImplementedError(
+            "point-to-point ops are HOST-backend only; the device mesh "
+            "expresses transfers as collectives")
+
+    recv = send
+
+    def destroy(self):
+        self._ops._cache.clear()
+
+
+class ProcessMeshGroup:
+    """Backend.XLA across actor PROCESSES (the former
+    xla_global.GlobalMeshGroup): N actors joined one jax.distributed
+    runtime are one collective rank each; every op delegates to the
+    shared DeviceTransport over the global mesh, so cross-host traffic
+    is XLA's compiled collectives (ICI/DCN), never the HOST TCP hub."""
+
+    def __init__(self, group_name: str, world_size: int, rank: int,
+                 quantize=None):
+        self.group_name = group_name
+        self.world_size = world_size
+        self.rank = rank
+        self.quantize = normalize_quantize(quantize)
+        self.transport = DeviceTransport(world_size, rank)
+        self.mesh = self.transport.mesh
+
+    def _q(self, quantize):
+        return self.quantize if quantize is None else quantize
+
+    def allreduce(self, arr, op: ReduceOp = ReduceOp.SUM, quantize=None):
+        return self.transport.allreduce(arr, op, quantize=self._q(quantize))
+
+    def reduce(self, arr, dst_rank: int = 0, op: ReduceOp = ReduceOp.SUM,
+               quantize=None):
+        return self.transport.reduce(arr, dst_rank, op,
+                                     quantize=self._q(quantize))
+
+    def broadcast(self, arr, src_rank: int = 0):
+        return self.transport.broadcast(arr, src_rank)
+
+    def allgather(self, arr) -> list:
+        return self.transport.allgather(arr)
+
+    def reducescatter(self, arr, op: ReduceOp = ReduceOp.SUM,
+                      quantize=None):
+        return self.transport.reducescatter(arr, op,
+                                            quantize=self._q(quantize))
+
+    def barrier(self):
+        self.transport.barrier()
+
+    def send(self, arr, dst_rank: int, tag: int = 0):
+        raise NotImplementedError(
+            "point-to-point ops are HOST-backend only; the global mesh "
+            "expresses transfers as collectives")
+
+    recv = send
+
+    def destroy(self):
+        self.transport.destroy()
+
+
+# continuity alias: the global-mesh group used to live in xla_global.py
+GlobalMeshGroup = ProcessMeshGroup

@@ -11,7 +11,8 @@ from typing import Any
 
 import numpy as np
 
-from ray_tpu.collective.types import Backend, ReduceOp, Transport
+from ray_tpu.collective.types import (Backend, ReduceOp, Transport,
+                                      is_jax_array, normalize_quantize)
 
 
 class GroupManager:
@@ -24,8 +25,9 @@ class GroupManager:
 
     def create_group(self, group_name: str, world_size: int, rank: int,
                      backend: Backend, timeout: float = 60.0,
-                     transport: str = "auto"):
+                     transport: str = "auto", quantize=None):
         backend = Backend(backend)
+        quantize = normalize_quantize(quantize)
         if backend == Backend.AUTO:
             backend = Backend.XLA if world_size == 1 else Backend.HOST
         with self._lock:
@@ -35,7 +37,8 @@ class GroupManager:
             from ray_tpu.collective.backends.host_backend import HostGroup
 
             group = HostGroup(group_name, world_size, rank, timeout=timeout,
-                              transport=Transport(transport).value)
+                              transport=Transport(transport).value,
+                              quantize=quantize)
         else:
             from ray_tpu.parallel import multihost
 
@@ -48,18 +51,19 @@ class GroupManager:
                 # mesh; other sizes are single-controller device groups
                 return world_size == jax.process_count()
 
+            # both device-group flavors live in xla_backend.py (the
+            # former xla_global.GlobalMeshGroup is unified there)
+            from ray_tpu.collective.backends.xla_backend import (
+                ProcessMeshGroup, XlaGroup)
+
             if _spans_processes():
                 # N actor processes joined one jax.distributed runtime:
                 # group ops ride XLA collectives over the global mesh
-                # (the NCCL-across-actors capability; weak #8)
-                from ray_tpu.collective.backends.xla_global import (
-                    GlobalMeshGroup)
-
-                group = GlobalMeshGroup(group_name, world_size, rank)
+                # (the NCCL-across-actors capability)
+                group = ProcessMeshGroup(group_name, world_size, rank,
+                                         quantize=quantize)
             else:
-                from ray_tpu.collective.backends.xla_backend import XlaGroup
-
-                group = XlaGroup(group_name)
+                group = XlaGroup(group_name, quantize=quantize)
         with self._lock:
             self._groups[group_name] = group
         return group
@@ -87,20 +91,27 @@ def init_collective_group(world_size: int, rank: int,
                           backend: str = "host",
                           group_name: str = "default",
                           timeout: float = 60.0,
-                          transport: str = "auto"):
+                          transport: str = "auto",
+                          quantize=None):
     """Initialize this process's membership in a collective group
     (reference: collective.py:93). Call from inside each participating
     actor/task with its rank. `transport` pins the HOST data plane to
-    one tier (hub/ring/ring_unpipelined/shm); "auto" routes per op."""
+    one tier (hub/ring/ring_unpipelined/shm/device); "auto" routes per
+    op. `quantize="int8"` makes this group's default allreduce wire
+    format block-scaled int8 (EQuARX-style, lossy) on the tiers that
+    have a wire (ring/device); per-op `allreduce(..., quantize=...)`
+    overrides it."""
     return _manager.create_group(group_name, world_size, rank,
                                  Backend(backend), timeout=timeout,
-                                 transport=transport)
+                                 transport=transport, quantize=quantize)
 
 
 def create_collective_group(actors, world_size: int, ranks: list[int],
                             backend: str = "host",
                             group_name: str = "default",
-                            timeout: float = 60.0):
+                            timeout: float = 60.0,
+                            quantize=None,
+                            transport: str = "auto"):
     """Driver-side declarative setup (reference: collective.py:126): tells
     every actor in `actors` to init the group with its rank."""
     import ray_tpu
@@ -109,7 +120,8 @@ def create_collective_group(actors, world_size: int, ranks: list[int],
         raise ValueError("actors/ranks/world_size mismatch")
     refs = [
         actor.__ray_collective_init__.remote(world_size, rank, backend,
-                                             group_name, timeout)
+                                             group_name, timeout, quantize,
+                                             transport)
         for actor, rank in zip(actors, ranks)
     ]
     return ray_tpu.get(refs, timeout=120)
@@ -149,6 +161,16 @@ def _as_numpy(tensor) -> np.ndarray:
     return np.asarray(tensor)
 
 
+def _prep(tensor):
+    """Normalize an op payload WITHOUT forcing device arrays to host:
+    jax.Arrays pass through untouched (the DEVICE tier and the XLA
+    backend consume them in place — pulling them to numpy here would
+    defeat the whole ICI plane), everything else becomes numpy."""
+    if isinstance(tensor, np.ndarray) or is_jax_array(tensor):
+        return tensor
+    return np.asarray(tensor)
+
+
 def _traced_op(name: str, group_name: str, fn, nbytes: int | None = None):
     """Collective trace entry point (tracing.py): continues an ambient
     trace (op inside a traced task/replica call) or head-samples a fresh
@@ -166,31 +188,36 @@ def _traced_op(name: str, group_name: str, fn, nbytes: int | None = None):
 
 
 def allreduce(tensor, group_name: str = "default",
-              op: ReduceOp = ReduceOp.SUM):
+              op: ReduceOp = ReduceOp.SUM, quantize=None):
+    """`quantize` (None = the group's default; "int8" = block-scaled
+    int8 wire format; False = force exact) applies on the tiers that
+    have a wire to compress — the DEVICE ppermute ring and the host
+    TCP ring. hub/shm always carry exact payloads."""
     group = _manager.get_group(group_name)
-    t = _as_numpy(tensor)
+    t = _prep(tensor)
     return _traced_op("collective.allreduce", group_name,
-                      lambda: group.allreduce(t, op), t.nbytes)
+                      lambda: group.allreduce(t, op, quantize=quantize),
+                      t.nbytes)
 
 
 def reduce(tensor, dst_rank: int = 0, group_name: str = "default",
            op: ReduceOp = ReduceOp.SUM):
     group = _manager.get_group(group_name)
-    t = _as_numpy(tensor)
+    t = _prep(tensor)
     return _traced_op("collective.reduce", group_name,
                       lambda: group.reduce(t, dst_rank, op), t.nbytes)
 
 
 def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
     group = _manager.get_group(group_name)
-    t = _as_numpy(tensor)
+    t = _prep(tensor)
     return _traced_op("collective.broadcast", group_name,
                       lambda: group.broadcast(t, src_rank), t.nbytes)
 
 
 def allgather(tensor, group_name: str = "default"):
     group = _manager.get_group(group_name)
-    t = _as_numpy(tensor)
+    t = _prep(tensor)
     return _traced_op("collective.allgather", group_name,
                       lambda: group.allgather(t), t.nbytes)
 
@@ -198,7 +225,7 @@ def allgather(tensor, group_name: str = "default"):
 def reducescatter(tensor, group_name: str = "default",
                   op: ReduceOp = ReduceOp.SUM):
     group = _manager.get_group(group_name)
-    t = _as_numpy(tensor)
+    t = _prep(tensor)
     return _traced_op("collective.reducescatter", group_name,
                       lambda: group.reducescatter(t, op), t.nbytes)
 
@@ -221,7 +248,9 @@ class CollectiveActorMixin:
     create_collective_group."""
 
     def __ray_collective_init__(self, world_size, rank, backend, group_name,
-                                timeout=60.0):
+                                timeout=60.0, quantize=None,
+                                transport="auto"):
         init_collective_group(world_size, rank, backend, group_name,
-                              timeout=timeout)
+                              timeout=timeout, quantize=quantize,
+                              transport=transport)
         return rank

@@ -1,0 +1,23 @@
+"""Collective data-plane counters (registered at import so the
+metrics-registry drift gate — tests/test_observability.py — can hold
+ARCHITECTURE.md to them).
+
+device_ops_total counts ops dispatched on the DEVICE (ICI/XLA) tier;
+quantized_bytes_saved_total accumulates wire bytes the int8 block-scaled
+format avoided sending versus the exact dtype (host ring: real socket
+bytes; device tier: ICI transfer bytes the quantized ppermute ring
+skipped).
+"""
+
+from __future__ import annotations
+
+from ray_tpu._private import stats
+
+DEVICE_OPS = stats.Count(
+    "collective.device_ops_total",
+    "collective ops dispatched on the DEVICE (ICI/XLA) transport tier")
+
+QUANT_SAVED = stats.Count(
+    "collective.quantized_bytes_saved_total",
+    "wire bytes avoided by int8 block-scaled quantized collectives "
+    "(exact-dtype bytes minus quantized payload+scale bytes)")

@@ -37,7 +37,15 @@ class Transport(str, enum.Enum):
           they run the pipelined ring data plane.
     SHM — one mmap'd tmpfs segment per group when every rank shares a
           node: collectives become pure memory traffic.
-    AUTO — shm when node-local, else ring, else hub.
+    DEVICE — the accelerator's own interconnect: when every rank's
+          payload is a jax.Array and the group's processes share one
+          jax runtime (parallel/multihost), ops dispatch through cached
+          jitted shard_map collectives (psum/all_gather/psum_scatter)
+          so bytes ride ICI/XLA without touching host RAM
+          (backends/xla_backend.DeviceTransport).
+    AUTO — device when every rank holds a device array and the runtime
+          spans the group, else shm when node-local, else ring, else
+          hub.
     """
 
     AUTO = "auto"
@@ -45,6 +53,7 @@ class Transport(str, enum.Enum):
     RING = "ring"
     RING_UNPIPELINED = "ring_unpipelined"
     SHM = "shm"
+    DEVICE = "device"
 
 
 class ReduceOp(str, enum.Enum):
@@ -61,3 +70,37 @@ _NUMPY_REDUCE = {
     ReduceOp.MIN: "minimum",
     ReduceOp.MAX: "maximum",
 }
+
+# Block-scaled int8 quantization (EQuARX-style): payloads are cut into
+# QUANT_BLOCK-element blocks, each carried on the wire as int8 values
+# plus one float32 scale (absmax/127); the reduce happens on the
+# dequantized float32 values.  Shared by the host ring's quantized chunk
+# format and the device tier's quantized ppermute ring so both planes
+# agree on the wire granularity (and the analytic error bound).
+QUANT_BLOCK = 256
+QUANTIZE_INT8 = "int8"
+
+
+def is_jax_array(tensor) -> bool:
+    """True for jax.Arrays WITHOUT importing jax in pure-host processes:
+    if jax was never imported, the payload cannot be one. The single
+    probe behind the public-API payload prep and the DEVICE-tier
+    routing — they must never disagree about what counts as a device
+    array."""
+    import sys
+
+    jmod = sys.modules.get("jax")
+    return jmod is not None and isinstance(tensor, jmod.Array)
+
+
+def normalize_quantize(quantize) -> str | None:
+    """Canonicalize the `quantize=` knob: None/""/"none"/False mean
+    exact; "int8" selects block-scaled int8. Anything else is a typo
+    that must fail loudly (a silently-ignored lossy knob would corrupt
+    an A/B)."""
+    if quantize in (None, False, "", "none"):
+        return None
+    if str(quantize).lower() == QUANTIZE_INT8:
+        return QUANTIZE_INT8
+    raise ValueError(
+        f"unknown quantize mode {quantize!r} (expected None or 'int8')")

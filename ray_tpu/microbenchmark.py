@@ -491,11 +491,23 @@ def _collective_bench(results: list[dict], nbytes: int = 16 * 1024 * 1024,
     inside the SAME window (interleaved — a box-load swing hits all arms
     equally), median of N windows, GB/s/rank. `ring_unpipelined` is the
     preserved pre-pipelining control arm; the small-hub case guards
-    control-plane latency against regressions from the routing layer."""
+    control-plane latency against regressions from the routing layer.
+    Round-12 arms: `device` (the Transport.DEVICE tier over the shared
+    jax runtime — device-resident payload, timed to block_until_ready)
+    and `ring_quantized` (int8 block-scaled wire format on the pipelined
+    ring; same payload, ~4x fewer socket bytes)."""
     from ray_tpu.collective import collective as col
 
     @ray_tpu.remote(num_cpus=0)
     class BenchRank(col.CollectiveActorMixin):
+        def join_runtime(self, world, rank):
+            # BEFORE first jax backend use: makes the group
+            # device-capable so the 'device' arm is forcible
+            from ray_tpu.parallel import multihost
+
+            multihost.initialize("bench_mh", world, rank)
+            return True
+
         def timed_allreduce(self, transport, n_elems):
             import time as _t
 
@@ -504,12 +516,31 @@ def _collective_bench(results: list[dict], nbytes: int = 16 * 1024 * 1024,
             from ray_tpu.collective import collective as C
 
             group = C._manager.get_group("bench_col")
-            arr = _np.ones(n_elems, _np.float32)
+            quantize = None
+            if transport == "ring_quantized":
+                transport, quantize = "ring", "int8"
             group.barrier()  # hub-direct: lines ranks up, never routed
             group.force_transport = transport
+            if transport == "device":
+                import jax
+                import jax.numpy as jnp
+
+                arr = jnp.ones(n_elems, jnp.float32)
+                jax.block_until_ready(arr)
+                t0 = _t.perf_counter()
+                out = group.allreduce(arr)
+                jax.block_until_ready(out)
+                return _t.perf_counter() - t0
+            arr = _np.ones(n_elems, _np.float32)
             t0 = _t.perf_counter()
-            group.allreduce(arr)
+            group.allreduce(arr, quantize=quantize)
             return _t.perf_counter() - t0
+
+        def read_counter(self, name):
+            from ray_tpu._private import stats
+
+            snap = stats.snapshot().get(name)
+            return float(snap["value"]) if snap else 0.0
 
         def teardown(self):
             from ray_tpu.collective import collective as C
@@ -518,13 +549,16 @@ def _collective_bench(results: list[dict], nbytes: int = 16 * 1024 * 1024,
             return True                              # the shm segment
 
     ranks = [BenchRank.remote() for _ in range(world)]
+    ray_tpu.get([r.join_runtime.remote(world, i)
+                 for i, r in enumerate(ranks)], timeout=300)
     col.create_collective_group(ranks, world, list(range(world)),
                                 backend="host", group_name="bench_col")
-    cases = ["shm", "ring", "ring_unpipelined", "hub"]
+    cases = ["shm", "ring", "ring_quantized", "ring_unpipelined", "hub",
+             "device"]
     for tr in cases:  # warm at FULL size: segment sized+faulted in, ring
-        ray_tpu.get(   # built, hub buffers grown — no setup in the windows
+        ray_tpu.get(   # built, hub buffers grown, device bodies jitted —
             [r.timed_allreduce.remote(tr, nbytes // 4) for r in ranks],
-            timeout=300)
+            timeout=300)  # no setup in the windows
     samples: dict[str, list[float]] = {tr: [] for tr in cases}
     small: list[float] = []
     for _ in range(windows):
@@ -552,6 +586,24 @@ def _collective_bench(results: list[dict], nbytes: int = 16 * 1024 * 1024,
     results.append({"name": "collective_allreduce_hub_small",
                     "per_second": 1.0 / med, "sd": float(np.std(small)),
                     "trials": [round(t, 5) for t in small]})
+    # counter-verify the quantized wire reduction: saved bytes per op
+    # per rank vs the exact f32 wire the same schedule would have sent
+    saved = ray_tpu.get([r.read_counter.remote(
+        "collective.quantized_bytes_saved_total") for r in ranks],
+        timeout=60)
+    q_ops = windows + 1  # warm + one per window
+    chunk = (nbytes // 4) // world
+    exact_wire = 2 * (world - 1) * chunk * 4
+    saved_per_op = float(np.mean(saved)) / q_ops
+    reduction = exact_wire / max(exact_wire - saved_per_op, 1.0)
+    for row in results:
+        if row["name"] == "collective_allreduce_ring_quantized":
+            row["wire_bytes_exact"] = exact_wire
+            row["wire_bytes_saved_per_op"] = int(saved_per_op)
+            row["wire_reduction_x"] = round(reduction, 2)
+    print(f"collective_allreduce_ring_quantized wire reduction "
+          f"{reduction:.2f}x (counter-verified, saved "
+          f"{saved_per_op / 1e6:.1f}MB/op/rank of {exact_wire / 1e6:.1f}MB)")
     ray_tpu.get([r.teardown.remote() for r in ranks], timeout=60)
     for r in ranks:
         ray_tpu.kill(r)

@@ -43,7 +43,9 @@ def _moe_local(x, router_w, w_in, w_out, *, axis_name: str,
     """Inside shard_map over ep. x: [N_local, D] local tokens; router_w:
     [D, E_total]; w_in/w_out: this shard's experts [E_local, D, F] /
     [E_local, F, D]."""
-    ep = jax.lax.axis_size(axis_name)
+    from ray_tpu.parallel.mesh import axis_size
+
+    ep = axis_size(axis_name)
     n_local, d = x.shape
     e_local = w_in.shape[0]
     e_total = e_local * ep
@@ -78,13 +80,14 @@ def moe_apply(x, router_w, w_in, w_out, *, mesh: Mesh,
               token_axis: str = "dp"):
     """Driver-level entry. x: [N, D] tokens (sharded over dp); w_in/w_out:
     [E, D, F] / [E, F, D] sharded over ep on the expert axis."""
-    fn = jax.shard_map(
+    from ray_tpu.parallel.mesh import shard_map
+
+    fn = shard_map(
         functools.partial(_moe_local, axis_name=axis_name,
                           capacity_factor=capacity_factor),
         mesh=mesh,
         in_specs=(P(token_axis, None), P(), P(axis_name), P(axis_name)),
         out_specs=(P(token_axis, None), P(token_axis)),
-        check_vma=False,
     )
     out, aux = fn(x, router_w, w_in, w_out)
     return out, jnp.mean(aux)

@@ -16,6 +16,7 @@ here. Works identically on TPU pods (PJRT distributed) and in tests
 from __future__ import annotations
 
 import logging
+import os
 import socket
 import time
 
@@ -83,6 +84,17 @@ def initialize(group_name: str, world_size: int, rank: int,
 
     import jax
 
+    if "cpu" in os.environ.get("JAX_PLATFORMS", "").lower():
+        # CPU runtimes (tests under --xla_force_host_platform_device_count)
+        # need the gloo collective implementation wired in BEFORE backend
+        # init, or every cross-process computation fails with
+        # "Multiprocess computations aren't implemented on the CPU
+        # backend" — which also starves the collective DEVICE tier.
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:
+            logger.debug("jax_cpu_collectives_implementation knob absent; "
+                         "assuming this jax defaults to a working one")
     jax.distributed.initialize(
         coordinator_address=addr, num_processes=world_size,
         process_id=rank, local_device_ids=local_device_ids)

@@ -57,7 +57,9 @@ def ring_attention(q, k, v, *, axis_name: str = "sp", causal: bool = True,
                    scale: float | None = None):
     """Call INSIDE shard_map: q,k,v are local blocks [B, T_local, H, D]
     sharded along T over `axis_name`. Returns the local output block."""
-    sp = jax.lax.axis_size(axis_name)
+    from ray_tpu.parallel.mesh import axis_size
+
+    sp = axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     b, t_local, h, d = q.shape
     if scale is None:
@@ -84,10 +86,11 @@ def ring_attention_sharded(q, k, v, mesh: Mesh, *, causal: bool = True,
     """Driver-level entry: q,k,v are global [B, T, H, D]; batch sharded over
     dp, sequence over sp, heads over tp."""
     spec = P(batch_axis, seq_axis, head_axis, None)
-    fn = jax.shard_map(
+    from ray_tpu.parallel.mesh import shard_map
+
+    fn = shard_map(
         functools.partial(ring_attention, axis_name=seq_axis, causal=causal),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=False)
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
     return fn(q, k, v)
 
 

@@ -178,11 +178,14 @@ class TrainingOperator:
             self._jit_eval = jax.jit(
                 lambda params, batch: {"val_loss": loss_fn(params, batch)})
 
-    def _allreduce_grads(self, flat_grads: jax.Array) -> np.ndarray:
+    def _allreduce_grads(self, flat_grads: jax.Array):
         from ray_tpu.collective import collective as col
 
-        avg = col.allreduce(np.asarray(flat_grads),
-                            group_name=self._group_name)
+        # the gradient bucket stays a device array: a device-capable
+        # group (Transport.DEVICE) reduces it over ICI with zero host
+        # copies; host groups convert internally. The group's quantize
+        # default (Trainer(quantize="int8")) applies to the wire here.
+        avg = col.allreduce(flat_grads, group_name=self._group_name)
         return avg / self.world_size
 
     # ------------------------------------------------------------------

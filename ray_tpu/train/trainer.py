@@ -63,6 +63,13 @@ class TrainWorker(CollectiveActorMixin):
         self.operator.load_state_dict(state)
         return True
 
+    def read_counter(self, name: str) -> float:
+        """Worker-process metric readback (wire A/B verification)."""
+        from ray_tpu._private import stats
+
+        snap = stats.snapshot().get(name)
+        return float(snap["value"]) if snap else 0.0
+
     def sync_state(self, src_rank: int = 0):
         """Collectively broadcast the full training state from src_rank
         over the group's data plane (shm segment / pipelined ring for
@@ -103,9 +110,19 @@ class Trainer:
                  backend: str = "host",
                  max_retries: int = 3,
                  collective_timeout: float = 30.0,
-                 setup_timeout: float = 600.0):
+                 setup_timeout: float = 600.0,
+                 quantize: str | None = None,
+                 collective_transport: str = "auto"):
+        """quantize="int8" makes the gradient-sync allreduce ride the
+        block-scaled int8 wire format (EQuARX-style) on the tiers that
+        have a wire — the collective DEVICE plane and the host TCP ring
+        — cutting gradient bytes ~4x; state sync (broadcast) and
+        node-local tiers stay exact. collective_transport pins the
+        group's data plane to one tier (tests / wire A/Bs)."""
         self._operator_cls = training_operator_cls
         self._config = config or {}
+        self._quantize = quantize
+        self._collective_transport = collective_transport
         self._num_workers = num_workers
         self._resources = dict(resources_per_worker or {"CPU": 1})
         if use_tpu:
@@ -149,7 +166,9 @@ class Trainer:
             col.create_collective_group(
                 self.workers, num_workers, list(range(num_workers)),
                 backend=self._backend, group_name=group_name,
-                timeout=self._collective_timeout)
+                timeout=self._collective_timeout,
+                quantize=self._quantize,
+                transport=self._collective_transport)
         ray_tpu.get([w.setup_operator.remote() for w in self.workers],
                     timeout=self._setup_timeout)
         self._active_workers = num_workers

@@ -149,6 +149,22 @@ def test_xla_group_ops():
     np.testing.assert_allclose(shifted[1], stacked[0])
     np.testing.assert_allclose(shifted[0], stacked[n - 1])
 
+    # PRODUCT (satellite: parity across every backend — no lax
+    # primitive, lowered as all_gather + local prod)
+    small = np.full((n, 3), 2.0, np.float32)
+    prod = np.asarray(group.allreduce(small, ReduceOp.PRODUCT))
+    np.testing.assert_allclose(prod, np.full((n, 3), 2.0 ** n))
+
+    # quantized allreduce over the device ring: lossy but within the
+    # block-scaling bound, identical on every rank
+    vals = np.linspace(-1, 1, n * 64, dtype=np.float32).reshape(n, 64)
+    qr = np.asarray(group.allreduce(vals, ReduceOp.SUM, quantize="int8"))
+    exact = np.tile(vals.sum(0), (n, 1))
+    bound = n * (n * 1.0) / 254.0 * 1.01 + 1e-6
+    assert np.max(np.abs(qr - exact)) <= bound
+    for r in range(1, n):
+        assert np.array_equal(qr[r], qr[0])
+
 
 def test_host_ring_allreduce_large(ray_start_shared):
     """Large tensors take the ring data plane (direct rank-to-rank TCP,

@@ -1,9 +1,13 @@
-"""Host collective data-plane tiers: shm segment, pipelined ring, hub.
+"""Host collective data-plane tiers: device (ICI/XLA), shm segment,
+pipelined ring, hub.
 
 Covers the transport matrix (exactness guard: bit-identical SUM/MAX/MIN
-across tiers, hub MEAN semantics), abort-not-hang fault injection (rank
-killed mid-shm-op and mid-ring-step), peer-direct send/recv, and the
-hub op-table sweep."""
+across the five tiers, hub MEAN semantics), the DEVICE tier's per-op
+placement vote + fallback, the int8 block-scaled quantized allreduce
+error-bound matrix (analytic bound; quantize=None stays bit-exact),
+MEAN/PRODUCT parity across tiers, abort-not-hang fault injection (rank
+killed mid-shm-op, mid-ring-step, mid-device-vote and mid-quantized-ring
+hop), peer-direct send/recv, and the hub op-table sweep."""
 
 import time
 
@@ -18,11 +22,20 @@ WORLD = 3  # odd on purpose: non-divisible stripes everywhere
 
 @ray_tpu.remote
 class TransportWorker:
-    def init_group(self, world, rank, group_name, timeout=60.0):
+    def init_group(self, world, rank, group_name, timeout=60.0,
+                   multihost_name=None, quantize=None):
         from ray_tpu import collective as col
 
+        if multihost_name is not None:
+            # join the shared jax.distributed runtime BEFORE any jax
+            # backend use: the group becomes device-capable and the
+            # DEVICE tier is routable/forcible
+            from ray_tpu.parallel import multihost
+
+            multihost.initialize(multihost_name, world, rank)
         col.init_collective_group(world, rank, backend="host",
-                                  group_name=group_name, timeout=timeout)
+                                  group_name=group_name, timeout=timeout,
+                                  quantize=quantize)
         self.rank = rank
         self.world = world
         self.group_name = group_name
@@ -75,23 +88,94 @@ class TransportWorker:
         return {"shm": group._shm is not None,
                 "ring": getattr(group, "_ring_next", None) is not None}
 
-    def warm(self, transport, nbytes=1 << 20):
+    def warm(self, transport, nbytes=1 << 20, quantize=None):
         group = self._group()
         group.force_transport = transport
-        group.allreduce(np.ones(nbytes // 4, np.float32))
+        group.allreduce(np.ones(nbytes // 4, np.float32),
+                        quantize=quantize)
         return True
 
-    def timed_allreduce(self, transport, nbytes):
+    def timed_allreduce(self, transport, nbytes, quantize=None):
         group = self._group()
         group.force_transport = transport
         arr = np.ones(nbytes // 4, np.float32)
         try:
             t0 = time.monotonic()
-            group.allreduce(arr)
+            group.allreduce(arr, quantize=quantize)
             return {"ok": True, "elapsed": time.monotonic() - t0}
         except TimeoutError as e:
             return {"ok": False, "elapsed": time.monotonic() - t0,
                     "error": str(e)}
+
+    def probe_device(self, use_device_array, n=1 << 14):
+        """One auto-routed allreduce; report whether the DEVICE tier
+        engaged and whether the result stayed on device."""
+        group = self._group()
+        arr = np.ones(n, np.float32)
+        if use_device_array:
+            import jax.numpy as jnp
+
+            arr = jnp.asarray(arr)
+        out = group.allreduce(arr)
+        return {"device_built": group._device is not None,
+                "out_on_device": not isinstance(out, np.ndarray),
+                "val": float(np.asarray(out)[0]),
+                "shm": group._shm is not None}
+
+    def quantized_allreduce(self, transport, dtype, opname, n, seed,
+                            quantize="int8", integral=False):
+        """Seeded deterministic inputs so the driver can rebuild the
+        exact reference and the analytic bound (integral=True draws
+        exactly-representable values for bit-exactness checks)."""
+        from ray_tpu.collective.types import ReduceOp
+
+        group = self._group()
+        group.force_transport = transport
+        rng = np.random.default_rng(seed + self.rank)
+        if integral:
+            arr = rng.integers(-64, 64, n).astype(dtype)
+        else:
+            arr = rng.uniform(-1.0, 1.0, n).astype(dtype)
+        try:
+            out = group.allreduce(arr, ReduceOp(opname), quantize=quantize)
+        finally:
+            group.force_transport = None
+        return out.tobytes(), np.dtype(out.dtype).str, tuple(out.shape)
+
+    def parity_matrix(self, transports, n):
+        """MEAN and PRODUCT on every tier (satellite: _NUMPY_REDUCE
+        special-cases must not leave semantic gaps between tiers)."""
+        from ray_tpu.collective.types import ReduceOp
+
+        group = self._group()
+        rng = np.random.default_rng(77 + self.rank)
+        cases = {
+            # 1..2 so a 3-rank product stays tiny and exact in f32/i32
+            "f32": rng.integers(1, 3, n).astype(np.float32),
+            "i32": rng.integers(1, 3, n).astype(np.int32),
+        }
+        out = {}
+        for tr in transports:
+            group.force_transport = tr
+            for name, arr in cases.items():
+                for op in (ReduceOp.MEAN, ReduceOp.PRODUCT):
+                    r = group.allreduce(arr, op)
+                    out[f"{name}/{op.value}/{tr}"] = (
+                        r.tobytes(), np.dtype(r.dtype).str, tuple(r.shape))
+        group.force_transport = None
+        return out
+
+    def read_counter(self, name):
+        from ray_tpu._private import stats
+
+        snap = stats.snapshot().get(name)
+        return float(snap["value"]) if snap else 0.0
+
+    def arm_failpoint(self, name, action, **kw):
+        from ray_tpu._private import failpoints
+
+        failpoints.arm(name, action, **kw)
+        return True
 
     def swap(self, peer, nbytes):
         """send-then-recv on both sides: must not rendezvous-deadlock."""
@@ -137,24 +221,47 @@ class TransportWorker:
         os._exit(0)
 
 
-def _make_group(n, group_name, timeout=60.0):
+def _make_group(n, group_name, timeout=60.0, multihost_name=None,
+                quantize=None):
     workers = [TransportWorker.remote() for _ in range(n)]
-    ray_tpu.get([w.init_group.remote(n, i, group_name, timeout)
-                 for i, w in enumerate(workers)], timeout=120)
+    ray_tpu.get([w.init_group.remote(n, i, group_name, timeout,
+                                     multihost_name, quantize)
+                 for i, w in enumerate(workers)], timeout=240)
     return workers
 
 
-def test_transport_exactness_matrix(ray_start_shared):
-    """shm, pipelined ring, unpipelined ring, and hub must agree
+@pytest.fixture(scope="module")
+def device_workers(ray_start_shared):
+    """One module-wide multihost worker set (jax.distributed startup is
+    the expensive part); tests lay additional groups over the same
+    actors."""
+    workers = _make_group(WORLD, "g_dev", multihost_name="devtier")
+    yield workers
+    ray_tpu.get([w.destroy_group.remote() for w in workers], timeout=60)
+    for w in workers:
+        ray_tpu.kill(w)
+
+
+def _extra_group(workers, group_name, timeout=60.0, quantize=None):
+    """Init another collective group on already-multihosted actors."""
+    ray_tpu.get([w.init_group.remote(len(workers), i, group_name, timeout,
+                                     None, quantize)
+                 for i, w in enumerate(workers)], timeout=120)
+
+
+def test_transport_exactness_matrix(device_workers):
+    """device, shm, pipelined ring, unpipelined ring, and hub must agree
     bit-for-bit on SUM/MAX/MIN (ints always; floats with exactly-
     representable values) and on MEAN semantics (float64 accumulate +
     float64 result for integer inputs) across an odd world size and a
-    non-divisible tensor length."""
-    transports = ["hub", "shm", "ring", "ring_unpipelined"]
-    workers = _make_group(WORLD, "g_exact")
+    non-divisible tensor length. (5-tier extension of the PR 2 matrix:
+    the workers share one jax.distributed runtime, so 'device' is
+    forcible and runs the same payloads over the XLA plane.)"""
+    transports = ["hub", "shm", "ring", "ring_unpipelined", "device"]
+    workers = device_workers
     outs = ray_tpu.get(
         [w.run_matrix.remote(transports, 10_007) for w in workers],
-        timeout=scale_timeout(180))
+        timeout=scale_timeout(300))
 
     hub = outs[0]
     for key, val in hub.items():
@@ -183,9 +290,7 @@ def test_transport_exactness_matrix(ray_start_shared):
     for tr in transports:
         assert hub[f"allreduce/i32/mean/{tr}"][1] == np.dtype(
             np.float64).str
-    ray_tpu.get([w.destroy_group.remote() for w in workers], timeout=60)
-    for w in workers:
-        ray_tpu.kill(w)
+    # (workers belong to the module fixture — no teardown here)
 
 
 def test_auto_routing_prefers_shm_on_one_node(ray_start_shared):
@@ -279,6 +384,340 @@ def test_rank_death_aborts_not_hangs(ray_start_shared, transport):
     ray_tpu.get([w.destroy_group.remote() for w in workers[:-1]],
                 timeout=60)
     for w in workers[:-1]:
+        ray_tpu.kill(w)
+
+
+def test_device_tier_auto_routing_and_fallback(device_workers):
+    """A device-array payload routes the op onto the DEVICE tier on a
+    unanimous vote; a numpy payload anywhere vetoes it and every rank
+    falls back to the host tiers together (same result, no hang)."""
+    workers = device_workers
+    _extra_group(workers, "g_devroute")
+    # all ranks hold jax arrays -> device tier, result stays on device
+    probes = ray_tpu.get(
+        [w.probe_device.remote(True) for w in workers],
+        timeout=scale_timeout(120))
+    for p in probes:
+        assert p["device_built"], probes
+        assert p["out_on_device"], probes
+        assert p["val"] == float(WORLD)
+    # mixed placement: rank 0 passes numpy -> unanimity fails -> host
+    # tiers carry the op and every rank still gets the right answer
+    probes = ray_tpu.get(
+        [w.probe_device.remote(i != 0) for i, w in enumerate(workers)],
+        timeout=scale_timeout(120))
+    for p in probes:
+        assert p["val"] == float(WORLD)
+        assert not p["out_on_device"], probes  # fell back to host tiers
+    # all-numpy: device never engages, shm serves the big op as before
+    probes = ray_tpu.get(
+        [w.probe_device.remote(False, n=1 << 18) for w in workers],
+        timeout=scale_timeout(120))
+    assert all(p["shm"] for p in probes), probes
+    ray_tpu.get([w.destroy_group.remote() for w in workers], timeout=60)
+
+
+def _quant_bound(w, amax, op, dtype):
+    """Analytic block-scaling bound: every output element is touched by
+    at most w quantization steps (w-1 reduce hops + 1 gather quantize),
+    each perturbing it by <= scale/2 <= partial_absmax/254, with
+    partial sums bounded by w*amax (SUM/MEAN) or amax (MAX/MIN)."""
+    if op in ("sum",):
+        bound = w * (w * amax) / 254.0
+    elif op == "mean":
+        bound = (w * (w * amax) / 254.0) / w
+    else:  # max/min: partials never exceed the input range
+        bound = w * amax / 254.0
+    if np.dtype(dtype) == np.float16:
+        # output rounding to f16 on top of the quantization error
+        bound += np.finfo(np.float16).eps * (w * amax + 1.0)
+    return bound * 1.001 + 1e-7
+
+
+@pytest.mark.parametrize("transport", ["ring", "device"])
+def test_quantized_error_bound_matrix(device_workers, transport):
+    """quantize="int8" on the pipelined ring and the device tier: the
+    lossy result stays within the analytic block-scaling bound for
+    every dtype x op, all ranks agree bitwise on the lossy result, and
+    quantize=None stays bit-exact vs the hub."""
+    workers = device_workers
+    _extra_group(workers, f"g_q_{transport}")
+    w = WORLD
+    n = 10_007
+    for dtype in ("<f4", "<f2"):
+        # the driver rebuilds every rank's input for the reference
+        inputs = [np.random.default_rng(5000 + r).uniform(-1.0, 1.0, n)
+                  .astype(np.dtype(dtype)) for r in range(w)]
+        amax = max(float(np.max(np.abs(x))) for x in inputs)
+        for opname in ("sum", "mean", "max"):
+            outs = ray_tpu.get(
+                [wk.quantized_allreduce.remote(transport, dtype, opname,
+                                               n, 5000)
+                 for wk in workers], timeout=scale_timeout(240))
+            # lossy, but identical on every rank (the gather phase
+            # relays one quantized byte stream)
+            assert all(o == outs[0] for o in outs[1:]), \
+                f"ranks diverged on quantized {opname}/{dtype}"
+            blob, dt, shape = outs[0]
+            assert np.dtype(dt) == np.dtype(dtype), (opname, dt)
+            got = np.frombuffer(blob, np.dtype(dt)).astype(np.float64)
+            stack = np.stack([x.astype(np.float64) for x in inputs])
+            exact = {"sum": stack.sum(0), "mean": stack.mean(0),
+                     "max": stack.max(0)}[opname]
+            err = float(np.max(np.abs(got - exact)))
+            bound = _quant_bound(w, amax, opname, dtype)
+            assert err <= bound, (
+                f"{transport}/{opname}/{dtype}: err {err} > analytic "
+                f"bound {bound}")
+    ray_tpu.get([w.destroy_group.remote() for w in workers], timeout=60)
+
+
+def test_quantize_none_stays_bit_exact(device_workers):
+    """Under an int8 GROUP DEFAULT: the default engages when the per-op
+    knob is None (saved-bytes counter moves), while quantize=False
+    forces the exact path, bit-identical to the hub on
+    exactly-representable payloads — for both wire tiers."""
+    workers = device_workers
+    _extra_group(workers, "g_qexact", quantize="int8")  # group default!
+    w = WORLD
+    n = 8_192
+    inputs = [np.random.default_rng(6000 + r).integers(-64, 64, n)
+              .astype(np.float32) for r in range(w)]
+    expect = np.stack(inputs).sum(0)
+    for transport in ("ring", "device"):
+        # quantize=False overrides the group default: bit-exact
+        outs = ray_tpu.get(
+            [wk.quantized_allreduce.remote(transport, "<f4", "sum", n,
+                                           6000, quantize=False,
+                                           integral=True)
+             for wk in workers], timeout=scale_timeout(180))
+        for blob, dt, shape in outs:
+            got = np.frombuffer(blob, np.dtype(dt))
+            assert got.dtype == np.float32
+            assert np.array_equal(got, expect), transport
+        # the group DEFAULT (int8) engages when quantize is None —
+        # proven by the saved-bytes counter moving
+        before = ray_tpu.get(workers[0].read_counter.remote(
+            "collective.quantized_bytes_saved_total"), timeout=30)
+        ray_tpu.get(
+            [wk.quantized_allreduce.remote(transport, "<f4", "sum", n,
+                                           6000, quantize=None)
+             for wk in workers], timeout=scale_timeout(120))
+        after = ray_tpu.get(workers[0].read_counter.remote(
+            "collective.quantized_bytes_saved_total"), timeout=30)
+        assert after > before, (transport, before, after)
+    ray_tpu.get([w.destroy_group.remote() for w in workers], timeout=60)
+
+
+def test_quantized_ring_wire_bytes_saved(ray_start_shared):
+    """The quantized ring's saved-bytes counter accounts for ~4x wire
+    reduction on float32 (int8 payload + one f32 scale per 256-element
+    block), on a plain (non-multihost) world-4 group."""
+    workers = _make_group(4, "g_qbytes")
+    n = 1 << 18  # 1MB of f32, divisible into block-aligned chunks
+    before = ray_tpu.get(
+        [w.read_counter.remote("collective.quantized_bytes_saved_total")
+         for w in workers], timeout=60)
+    outs = ray_tpu.get(
+        [w.quantized_allreduce.remote("ring", "<f4", "sum", n, 7000)
+         for w in workers], timeout=scale_timeout(180))
+    assert all(o == outs[0] for o in outs[1:])
+    after = ray_tpu.get(
+        [w.read_counter.remote("collective.quantized_bytes_saved_total")
+         for w in workers], timeout=60)
+    w_, c = 4, n // 4  # even split, already block-aligned
+    wire_elems = 2 * (w_ - 1) * c
+    expect_saved = wire_elems * 4 - wire_elems * (1 + 4 / 256)
+    for b, a in zip(before, after):
+        saved = a - b
+        assert abs(saved - expect_saved) <= 1.0, (saved, expect_saved)
+        # ~4x: quantized wire is (1 + 4/256)/4 of the exact wire
+        assert saved / (wire_elems * 4) > 0.73, saved
+    ray_tpu.get([w.destroy_group.remote() for w in workers], timeout=60)
+    for w in workers:
+        ray_tpu.kill(w)
+
+
+def test_mean_product_parity_across_tiers(device_workers):
+    """Satellite: ReduceOp.MEAN and PRODUCT agree across ALL tiers
+    (hub/shm/ring/ring_unpipelined/device) — PRODUCT bit-exact on
+    small-integer payloads, MEAN with identical promotion semantics
+    (float64 accumulate + float64 result for integer inputs)."""
+    workers = device_workers
+    _extra_group(workers, "g_parity")
+    transports = ["hub", "shm", "ring", "ring_unpipelined", "device"]
+    outs = ray_tpu.get(
+        [w.parity_matrix.remote(transports, 4_099) for w in workers],
+        timeout=scale_timeout(300))
+    for r in range(1, WORLD):  # cross-rank agreement per key
+        assert outs[r] == outs[0], f"rank {r} diverged"
+    ref = outs[0]
+    for name in ("f32", "i32"):
+        for opname in ("mean", "product"):
+            base = ref[f"{name}/{opname}/hub"]
+            for tr in transports[1:]:
+                other = ref[f"{name}/{opname}/{tr}"]
+                assert other[1] == base[1], (
+                    f"{name}/{opname}/{tr}: dtype {other[1]} != hub "
+                    f"{base[1]}")
+                assert other[2] == base[2], f"{name}/{opname}/{tr} shape"
+                if opname == "product":
+                    assert other[0] == base[0], (
+                        f"{name}/product/{tr} != hub bits")
+                else:
+                    a = np.frombuffer(base[0], np.dtype(base[1]))
+                    b = np.frombuffer(other[0], np.dtype(other[1]))
+                    np.testing.assert_allclose(a, b, rtol=1e-6)
+    # integer MEAN promoted to float64 on every tier
+    for tr in transports:
+        assert ref[f"i32/mean/{tr}"][1] == np.dtype(np.float64).str, tr
+    ray_tpu.get([w.destroy_group.remote() for w in workers], timeout=60)
+
+
+def test_device_rank_death_aborts_not_hangs(ray_start_shared):
+    """Kill a rank between device ops: survivors' next device-routed op
+    times out in the unanimity vote (abort-not-hang), and the group is
+    rebuildable at the surviving size on the host tiers."""
+    timeout = scale_timeout(8)
+    workers = _make_group(4, "g_fault_dev", timeout=timeout,
+                          multihost_name="devtier_fault")
+    assert all(ray_tpu.get([w.warm.remote("device") for w in workers],
+                           timeout=scale_timeout(240)))
+    victim = workers[-1]
+    ray_tpu.kill(victim)
+    t0 = time.monotonic()
+    outs = ray_tpu.get(
+        [w.timed_allreduce.remote("device", 1 << 20)
+         for w in workers[:-1]], timeout=scale_timeout(120))
+    wall = time.monotonic() - t0
+    for out in outs:
+        assert not out["ok"], f"survivor completed against a dead rank: {out}"
+        assert out["elapsed"] < timeout * 3 + 5, out
+    assert wall < timeout * 6 + 10
+    ray_tpu.get([w.destroy_group.remote() for w in workers[:-1]],
+                timeout=scale_timeout(60))
+    # rebuild at world 3: the 4-process runtime no longer matches, so
+    # the rebuilt group serves from the host tiers
+    ray_tpu.get([w.init_group.remote(3, i, "g_fault_dev_rebuilt", 30.0)
+                 for i, w in enumerate(workers[:-1])],
+                timeout=scale_timeout(60))
+    res = ray_tpu.get(
+        [w.timed_allreduce.remote("ring", 1 << 20)
+         for w in workers[:-1]], timeout=scale_timeout(90))
+    assert all(r["ok"] for r in res), res
+    ray_tpu.get([w.destroy_group.remote() for w in workers[:-1]],
+                timeout=60)
+    for w in workers[:-1]:
+        ray_tpu.kill(w)
+
+
+def test_quantized_ring_rank_death_aborts_not_hangs(ray_start_shared):
+    """Kill a rank mid-quantized-ring-op (failpoint collective.quantize
+    fires inside a ring hop): every survivor raises TimeoutError within
+    the group timeout and the group is rebuildable after destroy."""
+    timeout = scale_timeout(8)
+    workers = _make_group(4, "g_fault_q", timeout=timeout)
+    assert all(ray_tpu.get(
+        [w.warm.remote("ring", quantize="int8") for w in workers],
+        timeout=scale_timeout(120)))
+    victim = workers[-1]
+    # die at the second quantize seam: mid-op, after the ring is up
+    ray_tpu.get(victim.arm_failpoint.remote(
+        "collective.quantize", "exit", nth=2), timeout=30)
+    t0 = time.monotonic()
+    refs = [w.timed_allreduce.remote("ring", 1 << 20, quantize="int8")
+            for w in workers]
+    outs = []
+    for r in refs:
+        try:
+            outs.append(ray_tpu.get(r, timeout=scale_timeout(120)))
+        except Exception:  # the victim dies mid-call
+            outs.append({"ok": False, "elapsed": 0.0, "died": True})
+    wall = time.monotonic() - t0
+    survivors = outs[:-1]
+    assert all(not o["ok"] for o in survivors), outs
+    for out in survivors:
+        assert out["elapsed"] < timeout * 3 + 5, out
+    assert wall < timeout * 6 + 10
+    ray_tpu.get([w.destroy_group.remote() for w in workers[:-1]],
+                timeout=scale_timeout(60))
+    ray_tpu.get([w.init_group.remote(3, i, "g_fault_q_rebuilt", 30.0)
+                 for i, w in enumerate(workers[:-1])],
+                timeout=scale_timeout(60))
+    res = ray_tpu.get(
+        [w.timed_allreduce.remote("ring", 1 << 20, quantize="int8")
+         for w in workers[:-1]], timeout=scale_timeout(90))
+    assert all(r["ok"] for r in res), res
+    ray_tpu.get([w.destroy_group.remote() for w in workers[:-1]],
+                timeout=60)
+    for w in workers[:-1]:
+        ray_tpu.kill(w)
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("seed", [0, 1, 2, 3, 4])
+def test_chaos_device_and_quantized_kill_schedule(ray_start_shared, seed):
+    """Seeded chaos (satellite): a rank hard-killed at the
+    collective.device_dispatch seam (mid-device-op) or at the
+    collective.quantize seam (mid-quantized-ring-op) — drawn from the
+    seed — must leave every survivor with a TimeoutError within the
+    group timeout, and the group rebuildable after destroy."""
+    import random as _random
+
+    rng = _random.Random(seed)
+    point = rng.choice(["collective.device_dispatch",
+                        "collective.quantize"])
+    nth = rng.randint(1, 3)
+    if point.endswith("device_dispatch"):
+        # rank 0 hosts the jax.distributed COORDINATOR: killing it makes
+        # the surviving jax runtimes self-terminate (jax's own heartbeat
+        # fatal) — that's the multihost runtime's failure domain, not
+        # the collective layer's, so device-op chaos draws a client rank
+        victim_idx = rng.randrange(1, 4)
+    else:
+        victim_idx = rng.randrange(4)
+    timeout = scale_timeout(8)
+    name = f"g_chaos_{seed}"
+    mh = f"devchaos{seed}" if point.endswith("device_dispatch") else None
+    workers = _make_group(4, name, timeout=timeout, multihost_name=mh)
+    transport = ("device" if point.endswith("device_dispatch") else "ring")
+    quant = None if transport == "device" else "int8"
+    assert all(ray_tpu.get(
+        [w.warm.remote(transport, quantize=quant) for w in workers],
+        timeout=scale_timeout(240)))
+    ray_tpu.get(workers[victim_idx].arm_failpoint.remote(
+        point, "exit", nth=nth), timeout=30)
+    # the device seam is hit once per op, the quantize seam w+... times
+    # per op — issue rounds until the armed kill lands
+    outs = None
+    for _ in range(nth + 1):
+        refs = [w.timed_allreduce.remote(transport, 1 << 20,
+                                         quantize=quant)
+                for w in workers]
+        outs = []
+        for r in refs:
+            try:
+                outs.append(ray_tpu.get(r, timeout=scale_timeout(180)))
+            except Exception:  # the victim's own call dies with it
+                outs.append({"ok": False, "elapsed": 0.0, "died": True})
+        if not all(o["ok"] for o in outs):
+            break
+    survivors = [o for i, o in enumerate(outs) if i != victim_idx]
+    # every survivor errored (TimeoutError) within the deadline; the
+    # victim's own slot may be ok=False too (it died mid-call)
+    assert all(not o["ok"] for o in survivors), (point, nth, outs)
+    assert all(o["elapsed"] < timeout * 3 + 10 for o in survivors), outs
+    keep = [w for i, w in enumerate(workers) if i != victim_idx]
+    ray_tpu.get([w.destroy_group.remote() for w in keep],
+                timeout=scale_timeout(60))
+    ray_tpu.get([w.init_group.remote(3, i, f"{name}_rebuilt", 30.0)
+                 for i, w in enumerate(keep)], timeout=scale_timeout(60))
+    res = ray_tpu.get(
+        [w.timed_allreduce.remote("ring", 1 << 20, quantize=quant)
+         for w in keep], timeout=scale_timeout(90))
+    assert all(r["ok"] for r in res), (point, res)
+    ray_tpu.get([w.destroy_group.remote() for w in keep], timeout=60)
+    for w in keep:
         ray_tpu.kill(w)
 
 

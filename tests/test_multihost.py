@@ -149,7 +149,7 @@ def test_collective_rides_global_mesh_when_multihost(ray_start_regular):
 
         def run(self):
             from ray_tpu.collective import collective as C
-            from ray_tpu.collective.backends.xla_global import (
+            from ray_tpu.collective.backends.xla_backend import (
                 GlobalMeshGroup)
             from ray_tpu.collective.types import ReduceOp
 

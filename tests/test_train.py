@@ -67,6 +67,64 @@ def test_two_workers_allreduce(ray_start_regular):
     trainer.shutdown()
 
 
+class WideLinearOperator(TrainingOperator):
+    """Like LinearOperator but with a >=64KB gradient bucket, so the
+    flat grad allreduce is above RING_MIN_BYTES and actually rides the
+    (pinned) ring wire instead of the hub."""
+
+    def setup(self, config):
+        import jax.numpy as jnp
+        import optax
+
+        d_out = 8192  # 4*8192 f32 weights + bias: ~160KB of gradients
+
+        def model_init(rng):
+            return {"w": jnp.zeros((4, d_out)), "b": jnp.zeros(d_out)}
+
+        def loss_fn(params, batch):
+            x, y = batch
+            pred = x @ params["w"] + params["b"]
+            # sum over outputs (mean would shrink per-weight grads by
+            # 1/d_out and stall SGD), mean over the batch
+            return jnp.mean(jnp.sum((pred - y) ** 2, axis=1))
+
+        self.register(model_init=model_init, loss_fn=loss_fn,
+                      optimizer=optax.sgd(config.get("lr", 0.1)))
+        rng = np.random.RandomState(self.world_rank)
+        x = rng.randn(32, 4).astype(np.float32)
+        w_true = np.linspace(-1, 1, 4 * d_out).reshape(4, d_out)
+        y = (x @ w_true).astype(np.float32)
+        self.register_data(train_loader=[(x, y)] * 4,
+                           validation_loader=[(x, y)])
+
+
+def test_three_workers_quantized_gradient_sync(ray_start_regular):
+    """Trainer(quantize="int8", collective_transport="ring"): the
+    gradient allreduce rides the lossy block-scaled ring wire (counter-
+    verified — not the always-exact hub/shm), training converges, and
+    every replica holds bit-identical params (the gather phase relays
+    one quantized byte stream)."""
+    trainer = Trainer(WideLinearOperator, num_workers=3,
+                      config={"lr": 0.05}, quantize="int8",
+                      collective_transport="ring")
+    first = trainer.train()
+    for _ in range(5):
+        last = trainer.train()
+    # quantization noise is bounded: convergence must survive it
+    assert last["train_loss"] < first["train_loss"] * 0.5
+    states = [ray_tpu.get(w.state_dict.remote(), timeout=60)
+              for w in trainer.workers]
+    for s in states[1:]:
+        np.testing.assert_array_equal(states[0]["params"]["w"],
+                                      s["params"]["w"])
+    # the quantized wire actually engaged on every rank
+    saved = [ray_tpu.get(w.read_counter.remote(
+        "collective.quantized_bytes_saved_total"), timeout=30)
+        for w in trainer.workers]
+    assert all(s > 0 for s in saved), saved
+    trainer.shutdown()
+
+
 def test_checkpoint_roundtrip(ray_start_regular, tmp_path):
     trainer = Trainer(LinearOperator, num_workers=1)
     trainer.train()
