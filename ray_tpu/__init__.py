@@ -15,6 +15,9 @@ from ray_tpu.api import (
     cluster_events,
     cluster_metrics,
     cluster_resources,
+    cluster_state,
+    debug_stacks,
+    doctor,
     get,
     get_actor,
     init,
@@ -25,6 +28,8 @@ from ray_tpu.api import (
     remote,
     set_trace_sampling,
     shutdown,
+    start_doctor,
+    stop_doctor,
     timeline,
     trace_spans,
     wait,
@@ -41,6 +46,9 @@ __all__ = [
     "cluster_events",
     "cluster_metrics",
     "cluster_resources",
+    "cluster_state",
+    "debug_stacks",
+    "doctor",
     "exceptions",
     "exit_actor",
     "get",
@@ -53,6 +61,8 @@ __all__ = [
     "remote",
     "set_trace_sampling",
     "shutdown",
+    "start_doctor",
+    "stop_doctor",
     "timeline",
     "trace_spans",
     "wait",

@@ -22,6 +22,7 @@ import asyncio
 import concurrent.futures
 import contextlib
 import contextvars
+import itertools
 import logging
 import os
 import queue as queue_mod
@@ -35,6 +36,7 @@ import cloudpickle
 
 from ray_tpu import exceptions as exc
 from ray_tpu._private import common, global_state, rpc, serialization
+from ray_tpu._private import debug_state as _debug
 from ray_tpu._private import failpoints as _fp
 from ray_tpu._private import tracing
 from ray_tpu._private.config import Config
@@ -97,6 +99,31 @@ def _legacy_task_path() -> bool:
     hard lease requests, per-push lease-return timers, uncached specs) —
     the control arm of the microbenchmark's interleaved A/B."""
     return os.environ.get("RAY_TPU_TASK_LEGACY", "") not in ("", "0")
+
+def _collective_debug() -> list[dict]:
+    """Debug rows for this process's live collective groups — only when
+    the collective layer was actually imported (a snapshot must never be
+    the thing that pays the numpy/backends import)."""
+    mod = sys.modules.get("ray_tpu.collective.collective")
+    if mod is None:
+        return []
+    try:
+        return mod._manager.debug_state()
+    except Exception:
+        return []
+
+
+def _serve_router_debug() -> list[dict]:
+    """Live serve routers in this process (driver handles, proxy
+    actors): same only-if-imported discipline as the collective hook."""
+    mod = sys.modules.get("ray_tpu.serve.router")
+    if mod is None:
+        return []
+    try:
+        return mod.debug_routers()
+    except Exception:
+        return []
+
 
 # Task id of the async-actor coroutine currently running on the actor's
 # event loop (asyncio snapshots the context per scheduled coroutine).
@@ -240,6 +267,11 @@ class CoreWorker:
         self._actor_reorder: dict[bytes, dict] = {}  # caller -> {next, heap}
         self._async_loop: rpc.EventLoopThread | None = None
         self._exec_pool = None  # ThreadPoolExecutor when max_concurrency>1
+        # live-execution registry (debug_state): tasks currently inside
+        # _exec_scope on any execution lane, keyed by a per-entry token
+        # (GIL-atomic dict ops; no lock on the execution hot path)
+        self._executing: dict[int, dict] = {}
+        self._exec_seq = itertools.count(1)
         self._shutdown = False
         self._exiting = False
 
@@ -299,8 +331,15 @@ class CoreWorker:
             "exit": self.h_exit,
             "cancel_task": self.h_cancel_task,
             "get_stats": self.h_get_stats,
+            "debug_state": self.h_debug_state,
+            "debug_stacks": lambda conn, d: _debug.collect_stacks(),
             "ping": lambda conn, d: "pong",
         }
+
+    def h_debug_state(self, conn, d):
+        """Live-state snapshot of this process (sync handler: runs inline
+        on the read loop — a wedged dispatcher/executor can't block it)."""
+        return self.debug_state()
 
     async def h_get_stats(self, conn, d):
         """Process-local metrics snapshot — the raylet aggregates these
@@ -323,6 +362,7 @@ class CoreWorker:
 
     def _connect(self, raylet_address: str, gcs_address: str):
         async def setup():
+            _debug.start_loop_lag_monitor()
             port = await self.server.start_tcp(host=self.config.bind_host,
                                                uds_dir=self._uds_dir())
             self.address = f"{self.config.node_ip_address}:{port}"
@@ -1749,6 +1789,164 @@ class CoreWorker:
         out["raylets"] = self._io.run(_node_metrics())
         return out
 
+    # ------------------------------------------------------------------
+    # live state introspection (debug_state.py; the flight recorder)
+    # ------------------------------------------------------------------
+
+    def debug_state(self) -> dict:
+        """Cheap snapshot of every in-flight thing this process owns or
+        executes: task stages with age, lease tables, actor clients,
+        live executions, ref counts, rpc conn depth, collective groups.
+        Lock discipline: GIL-atomic dict copies plus one short _lock hop
+        for the ref counters — safe to serve inline on the read loop
+        even while the dispatcher is wedged."""
+        t_start = time.monotonic()
+        now = time.time()
+        pending_ids = set()
+        for specs in list(self._pending_by_key.values()):
+            for s in list(specs):
+                pending_ids.add(s.get("task_id"))
+        tasks = []
+        for tid, rec in list(self.submitted.items()):
+            spec = rec.get("spec") or {}
+            t0 = rec.get("t0")
+            t_push = rec.get("t_push")
+            if t_push is not None:
+                stage, since = "executing", t_push
+            elif tid in pending_ids:
+                stage, since = "lease_wait", t0
+            elif rec.get("lease") is not None:
+                stage, since = "queued", t0
+            else:
+                stage, since = "submit", t0
+            ctx = rec.get("trace")
+            lease = rec.get("lease")
+            tasks.append({
+                "task_id": tid.hex()[:16],
+                "name": spec.get("name", "?"),
+                "stage": stage,
+                "age_s": (round(now - since, 3)
+                          if since is not None else None),
+                "total_age_s": (round(now - t0, 3)
+                                if t0 is not None else None),
+                "trace_id": ctx.trace_id.hex() if ctx is not None else "",
+                "lease_worker": lease.address if lease is not None else "",
+                "retries_left": rec.get("retries", 0),
+            })
+        executing = []
+        for info in list(self._executing.values()):
+            executing.append({
+                "task_id": info["task_id"], "name": info["name"],
+                "age_s": round(now - info["t0"], 3),
+                "thread": info["thread"], "trace_id": info["trace_id"],
+            })
+        leases = []
+        mono = time.monotonic()
+        for key, ls in list(self.leases.items()):
+            for lease in list(ls):
+                leases.append({
+                    "lease_id": lease.lease_id.hex(),
+                    "worker": lease.address,
+                    "inflight": lease.inflight,
+                    "idle_s": round(mono - lease.last_used, 3),
+                    "conn_closed": lease.conn.closed,
+                })
+        actors = []
+        for aid, client in list(self.actor_clients.items()):
+            actors.append({
+                "actor_id": aid.hex()[:16],
+                "state": client.state,
+                "address": client.address,
+                "queued": len(client.queued),
+                "inflight": client.inflight,
+                "epoch": client.epoch,
+            })
+        with self._lock:
+            owned, borrowed = len(self.owned), len(self.borrowed)
+        conns = {}
+        for addr, conn in list(self._peer_conns.items()):
+            depth = _debug.conn_depth(conn)
+            if depth:
+                conns[addr] = depth
+        snap = {
+            "role": self.mode,
+            "worker_id": self.worker_id.hex()[:16],
+            "node_id": self.node_id.hex()[:8] if self.node_id else "",
+            "address": self.address,
+            "tasks": tasks,
+            "executing": executing,
+            "exec_queue_depth": self._exec_queue.qsize(),
+            "leases": leases,
+            "actors": actors,
+            "objects": {"memstore_entries": self.memstore.size(),
+                        "owned_refs": owned, "borrowed_refs": borrowed},
+            "rpc": {"peer_conn_depth": conns,
+                    "raylet_depth": (_debug.conn_depth(self.raylet)
+                                     if self.raylet is not None else 0),
+                    "server_conns": len(self.server.connections)},
+            "collectives": _collective_debug(),
+        }
+        routers = _serve_router_debug()
+        if routers:
+            snap["routers"] = routers
+            snap["router_queues"] = [q for r in routers
+                                     for q in r.get("queries", [])]
+        inst = self._actor_instance
+        if inst is not None:
+            # hosted-actor component hook: serve controller/proxy/replica
+            # expose their own state through the __ray_debug_state__
+            # protocol (cheap, read-only — plain dict reads under GIL)
+            snap["actor_class"] = type(inst).__name__
+            hook = getattr(inst, "__ray_debug_state__", None)
+            if callable(hook):
+                try:
+                    snap["component"] = hook()
+                except Exception as e:
+                    snap["component"] = {"error": repr(e)}
+                comp = snap.get("component")
+                if isinstance(comp, dict) and "router_queues" in comp:
+                    # surfaced top-level so the doctor sees serve queue
+                    # waiters without knowing the component layout
+                    snap["router_queues"] = comp["router_queues"]
+        return _debug.finish_snapshot(snap, t_start)
+
+    def get_cluster_state(self, include_workers: bool = True,
+                          timeout: float = 5.0) -> dict:
+        """Aggregate debug_state across the whole cluster (GCS director
+        + shards, every raylet and its workers, this driver)."""
+        async def _collect():
+            async def gcs_call(method, data):
+                return await self.gcs.call(method, data)
+
+            out = await _debug.collect_cluster_state_async(
+                gcs_call, self._peer, include_workers=include_workers,
+                timeout=timeout)
+            out["driver"] = self.debug_state()
+            # the raylet fan-out also reaches connected drivers — drop
+            # THIS process from its node's list so flatten()/doctor
+            # don't see our tasks twice
+            me = str(os.getpid())
+            for node in out.get("nodes", {}).values():
+                if isinstance(node, dict):
+                    (node.get("drivers") or {}).pop(me, None)
+            return out
+
+        return self._io.run(_collect(), timeout=timeout * 4)
+
+    def get_debug_stacks(self, address: str | None = None,
+                         timeout: float = 5.0) -> dict:
+        """All-thread stacks of this process, or of the process serving
+        rpc at `address` (worker/raylet/gcs — they all carry the
+        debug_stacks handler)."""
+        if address is None:
+            return _debug.collect_stacks()
+
+        async def _fetch():
+            conn = await self._peer(address)
+            return await conn.call("debug_stacks", {}, timeout=timeout)
+
+        return self._io.run(_fetch(), timeout=timeout * 2)
+
     def publish_log(self, line: str, stream: str):
         """Worker-side: forward one output line to subscribed drivers
         (reference: log_monitor.py:48 republishing, worker stdout/stderr
@@ -2609,9 +2807,19 @@ class CoreWorker:
         arrived = spec.pop("_arrived", None)
         start = time.time()
         scope = {}
+        exec_token = next(self._exec_seq)
+        self._executing[exec_token] = {
+            "task_id": spec["task_id"].hex()[:16],
+            "name": spec.get("name", "?"),
+            "t0": start,
+            "thread": threading.current_thread().name,
+            "trace_id": (sender.trace_id.hex()
+                         if sender is not None else ""),
+        }
         try:
             yield scope
         finally:
+            self._executing.pop(exec_token, None)
             end = time.time()
             tracing.pop(token)
             tracing.record_span("task", start, end, exec_ctx,
@@ -2946,6 +3154,20 @@ class CoreWorker:
     def shutdown(self):
         if self._shutdown:
             return
+        if (self.mode == DRIVER
+                and os.environ.get("RAY_TPU_FINAL_SNAPSHOT", "")
+                not in ("", "0")):
+            # flight-recorder tail (opt-in; tests/conftest.py arms it):
+            # one bounded cluster snapshot BEFORE teardown, so post-
+            # mortem checks (the leak check) can name unreturned leases
+            # / leaked pins / orphan workers from state instead of bare
+            # pids and paths. Off by default — a production driver exit
+            # should not pay a cluster sweep nobody reads.
+            try:
+                _debug.note_final_snapshot(
+                    self.get_cluster_state(timeout=1.5))
+            except Exception:
+                pass
         self._shutdown = True
 
         async def _close():

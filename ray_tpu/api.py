@@ -142,7 +142,15 @@ def is_initialized() -> bool:
 
 
 def shutdown():
-    global _global_node
+    global _global_node, _doctor_metrics_cache
+    # disarm the doctor loop FIRST: a surviving tick would spin against
+    # the dead runtime and silently re-attach to any later init() with
+    # this session's stale cache/dedup state
+    stop_doctor()
+    _doctor_metrics_cache = None
+    from ray_tpu._private import debug_state as _ds
+
+    _ds.reset_stall_dedup()
     cw = global_state.get_core_worker()
     if cw is not None:
         cw.shutdown()
@@ -191,6 +199,173 @@ def cluster_metrics(history: int | None = None) -> dict:
     if history is not None:
         return cw.get_metrics_history(samples=history)
     return cw.get_cluster_metrics()
+
+
+def cluster_state(component: str | None = None,
+                  filters: dict | None = None, *,
+                  include_workers: bool = True,
+                  timeout: float = 5.0):
+    """Live cluster-wide introspection snapshot (the flight recorder;
+    debug_state.py): every process class — driver, GCS director +
+    shards, each raylet and its workers (serve actors and collective
+    groups included) — answers a cheap `debug_state()` of its in-flight
+    work: per-task stage with age, lease tables, transfer streams/pins,
+    collective op phases, rpc conn depth, event-loop lag.
+
+    With `component` (one of tasks|actors|objects|leases|transfers|
+    collectives) returns flat rows across every process, sorted oldest
+    first; `filters={"field": substring}` narrows them. Unreachable
+    components degrade to an {"error": ...} entry — asking a sick
+    cluster what is wrong must never hang on the sick part."""
+    from ray_tpu._private import debug_state
+
+    cw = global_state.require_core_worker()
+    snap = cw.get_cluster_state(include_workers=include_workers,
+                                timeout=timeout)
+    if component is None:
+        return snap
+    rows = debug_state.flatten(snap, component)
+    for key, want in (filters or {}).items():
+        rows = [r for r in rows if str(want) in str(r.get(key, ""))]
+    return rows
+
+
+_doctor_metrics_cache: tuple | None = None  # (monotonic_ts, metrics)
+
+
+def doctor(*, floor_s: float | None = None,
+           p99_factor: float | None = None,
+           include_stacks: bool = True, emit_events: bool = True,
+           timeout: float = 5.0, metrics_max_age_s: float = 10.0) -> dict:
+    """The stall doctor: cross-references `cluster_state()` against the
+    per-hop latency histograms the cluster already records — any
+    in-flight item whose age exceeds max(floor, K×p99-of-its-stage) is
+    flagged with its stage, age, trace id and owning process, and (with
+    include_stacks) the all-thread stacks of that process. Findings are
+    also emitted as deduped STALL_DETECTED warning events into the GCS
+    events ring (`/api/events`, `ray-tpu events`) so dashboards surface
+    stalls without polling. Knobs: floor_s (default 1s,
+    RAY_TPU_DOCTOR_FLOOR_S) and p99_factor (default 3, RAY_TPU_DOCTOR_P99_K)."""
+    from ray_tpu._private import debug_state
+
+    global _doctor_metrics_cache
+    import time as _time
+
+    cw = global_state.require_core_worker()
+    snap = cw.get_cluster_state(timeout=timeout)
+    # The p99 thresholds drift on the histogram timescale, not per tick:
+    # cache the metrics fan-out so the armed 1s doctor cadence pays ONE
+    # cluster sweep per tick (state), not two (the ≤5% microbench gate).
+    cache = _doctor_metrics_cache
+    if (cache is not None
+            and _time.monotonic() - cache[0] < metrics_max_age_s):
+        metrics = cache[1]
+    else:
+        try:
+            metrics = cw.get_cluster_metrics()
+        except Exception:
+            metrics = {}
+        # this driver's OWN registry: the submit-side task histograms
+        # (lease_wait/queue_wait/e2e) live here, not in any raylet fold
+        from ray_tpu._private import stats as _stats
+
+        metrics = dict(metrics)
+        metrics["driver"] = _stats.snapshot()
+        _doctor_metrics_cache = (_time.monotonic(), metrics)
+    findings = debug_state.diagnose(snap, metrics, floor_s=floor_s,
+                                    p99_factor=p99_factor)
+    if include_stacks and findings:
+        addr_of = _process_addresses(snap)
+        stacks: dict[str, dict] = {}
+        for f in findings:
+            label = f["process"]
+            if label in stacks or len(stacks) >= 4:
+                continue
+            try:
+                if label == "driver":
+                    stacks[label] = cw.get_debug_stacks()
+                elif label == "gcs":
+                    stacks[label] = cw._io.run(
+                        cw.gcs.call("debug_stacks", {}), timeout=timeout)
+                elif addr_of.get(label):
+                    stacks[label] = cw.get_debug_stacks(addr_of[label])
+            except Exception as e:
+                stacks[label] = {"error": f"{type(e).__name__}: {e}"}
+        for f in findings:
+            if f["process"] in stacks:
+                f["stacks"] = stacks[f["process"]]
+    if emit_events:
+        for f in debug_state.novel_findings(findings):
+            event = debug_state.make_stall_event(
+                {k: v for k, v in f.items() if k != "stacks"})
+            try:
+                cw._io.run(cw.gcs.notify("report_event", event),
+                           timeout=2.0)
+            except Exception:
+                pass
+    return {"findings": findings,
+            "collected_at": snap.get("collected_at"),
+            "processes": sum(
+                1 for _ in debug_state_iter_processes(snap))}
+
+
+def debug_state_iter_processes(snap):
+    from ray_tpu._private import debug_state
+
+    return debug_state.iter_processes(snap)
+
+
+def _process_addresses(snap: dict) -> dict[str, str]:
+    """process label (as in doctor findings) -> rpc address."""
+    from ray_tpu._private import debug_state
+
+    out = {}
+    for label, proc in debug_state.iter_processes(snap):
+        addr = proc.get("address")
+        if addr:
+            out[label] = addr
+    return out
+
+
+_doctor_loop = None
+
+
+def start_doctor(interval: float = 1.0, **knobs) -> None:
+    """Arm a background doctor tick in this driver: every `interval`
+    seconds, collect cluster_state + diagnose + emit stall events (the
+    cadence the microbench regression gate runs at). Idempotent;
+    stop_doctor() disarms."""
+    import threading
+
+    global _doctor_loop
+    if _doctor_loop is not None:
+        return
+    stop = threading.Event()
+
+    def _loop():
+        while not stop.wait(interval):
+            try:
+                doctor(include_stacks=False, **knobs)
+            except Exception:
+                pass
+
+    t = threading.Thread(target=_loop, name="stall-doctor", daemon=True)
+    t.start()
+    _doctor_loop = (t, stop)
+
+
+def stop_doctor() -> None:
+    global _doctor_loop
+    if _doctor_loop is not None:
+        _doctor_loop[1].set()
+        _doctor_loop = None
+
+
+def debug_stacks(address: str | None = None) -> dict:
+    """All-thread Python stacks of this driver, or of any live runtime
+    process by rpc address (`sys._current_frames` over rpc — the
+    `ray-tpu stack` surface)."""
+    return global_state.require_core_worker().get_debug_stacks(address)
 
 
 def trace_spans(trace_id: str | None = None) -> list[dict]:

@@ -50,6 +50,7 @@ own failure detection), so the SGD layer above can resize the group.
 
 from __future__ import annotations
 
+import functools
 import os
 import socket
 import struct
@@ -64,6 +65,39 @@ from ray_tpu.collective.types import (_NUMPY_REDUCE, QUANT_BLOCK, ReduceOp,
                                       Transport, normalize_quantize)
 
 _HDR = struct.Struct(">I")
+
+
+def _op_entry(name: str):
+    """Wrap a public collective op: tracks (op, phase, age) in the
+    group's debug row — the `ray-tpu state collectives` / stall-doctor
+    feed — and makes group-timeout hangs self-describing by attaching a
+    bounded state snapshot to the raised TimeoutError (it travels inside
+    pickled rpc error replies via the exception __dict__, so the driver
+    sees WHICH op wedged on which rank without a reproduction run)."""
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(self, *args, **kwargs):
+            dbg = self._dbg
+            dbg["op"] = name
+            dbg["phase"] = "route"
+            dbg["t0"] = time.monotonic()
+            try:
+                return fn(self, *args, **kwargs)
+            except TimeoutError as e:
+                if not hasattr(e, "state_snapshot"):
+                    from ray_tpu._private import debug_state as _ds
+
+                    try:
+                        e.state_snapshot = _ds.bounded(self.debug_state())
+                    except Exception:
+                        pass
+                raise
+            finally:
+                dbg["ops_done"] = dbg.get("ops_done", 0) + 1
+                dbg["op"] = None
+                dbg["phase"] = "idle"
+        return wrapper
+    return deco
 
 # ops the int8 block-scaled wire format can carry (the reduce happens on
 # dequantized float32; PRODUCT would compound the per-hop error
@@ -273,6 +307,9 @@ class HostGroup:
         self.group_name = group_name
         self.world_size = world_size
         self.rank = rank
+        # live-op debug row (debug_state.py; _op_entry maintains it)
+        self._dbg: dict = {"op": None, "phase": "idle", "t0": 0.0,
+                           "ops_done": 0}
         # Rendezvous AND per-op timeout: ops abort (not hang) when a peer
         # dies mid-collective, so the SGD layer can resize the group.
         self._timeout = timeout
@@ -415,7 +452,30 @@ class HostGroup:
         self._op_id += 1
         return self._op_id
 
+    def debug_state(self) -> dict:
+        """Msgpack-safe live row: which op this rank is inside, at which
+        transport phase, for how long (the stall doctor's collective
+        feed; also attached to group-timeout errors by _op_entry)."""
+        dbg = self._dbg
+        op = dbg.get("op")
+        return {
+            "group": self.group_name,
+            "rank": self.rank,
+            "world_size": self.world_size,
+            "backend": "host",
+            "transport": self._forced() or "auto",
+            "quantize": self.quantize or "",
+            "op": op or "",
+            "phase": dbg.get("phase", "idle"),
+            "age_s": (round(time.monotonic() - dbg["t0"], 3)
+                      if op else 0.0),
+            "ops_done": dbg.get("ops_done", 0),
+            "op_seq": self._op_id,
+            "timeout_s": self._timeout,
+        }
+
     def _collective(self, kind: str, meta: dict, payload: bytes):
+        self._dbg["phase"] = f"hub:{kind}"
         op_id = self._next_op()
         if self.rank == 0 or self.world_size == 1:
             result = self._state.contribute(op_id, kind, 0, meta, payload,
@@ -573,6 +633,7 @@ class HostGroup:
             if forced == Transport.DEVICE.value:
                 self._forced_unavailable(forced)
             return False
+        self._dbg["phase"] = "device_vote"
         if _fp.ARMED:
             # fires BEFORE the agreement round: a rank hard-killed here
             # leaves every survivor timing out in the hub exchange
@@ -601,6 +662,7 @@ class HostGroup:
     def _device_op(self, fn):
         from ray_tpu.collective import metrics  # noqa: F401 (register)
 
+        self._dbg["phase"] = "device"
         try:
             return fn()
         except Exception as e:
@@ -611,6 +673,7 @@ class HostGroup:
             self._abort_not_hang(e)
 
     def _shm_op(self, fn):
+        self._dbg["phase"] = "shm"
         try:
             return fn()
         except Exception as e:
@@ -632,6 +695,7 @@ class HostGroup:
             self._abort_not_hang(e)
 
     def _ring_op(self, fn):
+        self._dbg["phase"] = "ring"
         try:
             return fn()
         except Exception as e:
@@ -1300,6 +1364,7 @@ class HostGroup:
             return hub_fn()
         raise RuntimeError("no collective transport available")
 
+    @_op_entry("allreduce")
     def allreduce(self, arr: np.ndarray, op: ReduceOp = ReduceOp.SUM,
                   quantize=None):
         op = ReduceOp(op)
@@ -1330,6 +1395,7 @@ class HostGroup:
             lambda t: t.allreduce(arr, op),
             ring, hub)
 
+    @_op_entry("reduce")
     def reduce(self, arr: np.ndarray, dst_rank: int = 0,
                op: ReduceOp = ReduceOp.SUM):
         arr = self._to_host(arr)
@@ -1340,6 +1406,7 @@ class HostGroup:
             return _arr_from(reply["meta"], data)
         return arr
 
+    @_op_entry("broadcast")
     def broadcast(self, arr: np.ndarray, src_rank: int = 0):
         if self._device_route(arr):
             return self._device_op(
@@ -1358,6 +1425,7 @@ class HostGroup:
             lambda pipelined: self._ring_broadcast_pipelined(arr, src_rank),
             hub)
 
+    @_op_entry("allgather")
     def allgather(self, arr: np.ndarray) -> list[np.ndarray]:
         # allgather is the one op whose per-rank GEOMETRY may
         # legitimately differ, so local-size routing can diverge (ragged
@@ -1399,6 +1467,7 @@ class HostGroup:
         # the hub is the only tier that can express it
         return self._hub_allgather(arr)
 
+    @_op_entry("reducescatter")
     def reducescatter(self, arr: np.ndarray, op: ReduceOp = ReduceOp.SUM,
                       quantize=None):
         op = ReduceOp(op)
@@ -1420,6 +1489,7 @@ class HostGroup:
             lambda pipelined: self._ring_reducescatter_pipelined(arr, op),
             hub)
 
+    @_op_entry("barrier")
     def barrier(self):
         self._collective("barrier", {}, b"")
 

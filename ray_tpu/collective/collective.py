@@ -83,6 +83,29 @@ class GroupManager:
         if group is not None:
             group.destroy()
 
+    def debug_state(self) -> list[dict]:
+        """Live rows for every group in this process (debug_state.py /
+        `ray-tpu state collectives`): backends exposing their own
+        debug_state (HostGroup: current op + phase + age) use it; the
+        rest report membership only."""
+        with self._lock:
+            groups = list(self._groups.items())
+        out = []
+        for name, group in groups:
+            fn = getattr(group, "debug_state", None)
+            if callable(fn):
+                try:
+                    out.append(fn())
+                    continue
+                except Exception:
+                    pass
+            out.append({"group": name,
+                        "rank": int(getattr(group, "rank", 0)),
+                        "world_size": int(getattr(group, "world_size", 1)),
+                        "backend": type(group).__name__,
+                        "op": "", "phase": "idle", "age_s": 0.0})
+        return out
+
 
 _manager = GroupManager()
 

@@ -12,6 +12,9 @@ Endpoints:
     /api/trace   Perfetto JSON of the trace table (?trace_id= one tree)
     /api/metrics/history per-source metric time series (?samples=N)
     /api/events  structured cluster events ring
+    /api/state   live debug_state of every process (?component=tasks|
+                 actors|objects|leases|transfers|collectives, ?workers=0)
+    /api/doctor  stall-doctor findings (age vs max(floor, K*p99))
 """
 
 from __future__ import annotations
@@ -183,6 +186,46 @@ class Dashboard:
     async def events(self) -> list[dict]:
         return await self._gcs("get_events")
 
+    async def state(self, component: str | None = None,
+                    include_workers: bool = True):
+        """Live cluster introspection (debug_state of every process);
+        ?component=tasks|actors|objects|leases|transfers|collectives
+        returns flat rows instead of the full tree."""
+        from ray_tpu._private import debug_state
+
+        conns: dict[str, object] = {}
+        gcs = await rpc.connect(self.gcs_address, name="dashboard")
+        try:
+            async def gcs_call(method, data):
+                return await gcs.call(method, data, timeout=10)
+
+            async def peer_dial(address):
+                conn = conns.get(address)
+                if conn is None or conn.closed:
+                    conn = conns[address] = await rpc.connect(
+                        address, name="dashboard")
+                return conn
+
+            snap = await debug_state.collect_cluster_state_async(
+                gcs_call, peer_dial, include_workers=include_workers)
+        finally:
+            for conn in conns.values():
+                await conn.close()
+            await gcs.close()
+        if component:
+            return debug_state.flatten(snap, component)
+        return snap
+
+    async def doctor(self) -> dict:
+        """Stall-doctor findings over the live snapshot + histograms."""
+        from ray_tpu._private import debug_state
+
+        snap = await self.state()
+        metrics = await self.metrics()
+        findings = debug_state.diagnose(snap, metrics)
+        return {"findings": findings,
+                "collected_at": snap.get("collected_at")}
+
     # -- server ----------------------------------------------------------
 
     async def run(self, ready_cb=None):
@@ -217,6 +260,31 @@ class Dashboard:
 
         app.router.add_get("/api/trace", trace_handler)
         app.router.add_get("/api/metrics/history", history_handler)
+
+        async def state_handler(request):
+            q = request.rel_url.query
+            component = q.get("component")
+            from ray_tpu._private.debug_state import COMPONENTS
+
+            if component and component not in COMPONENTS:
+                return web.json_response(
+                    {"error": f"component must be one of {COMPONENTS}"},
+                    status=400)
+            try:
+                return web.json_response(await self.state(
+                    component=component,
+                    include_workers=q.get("workers", "1") != "0"))
+            except Exception as e:
+                return web.json_response({"error": str(e)}, status=500)
+
+        async def doctor_handler(request):
+            try:
+                return web.json_response(await self.doctor())
+            except Exception as e:
+                return web.json_response({"error": str(e)}, status=500)
+
+        app.router.add_get("/api/state", state_handler)
+        app.router.add_get("/api/doctor", doctor_handler)
 
         async def logs_handler(request):
             q = request.rel_url.query

@@ -28,6 +28,7 @@ import os
 import random
 import time
 
+from ray_tpu._private import debug_state as _debug
 from ray_tpu._private import failpoints as _fp
 from ray_tpu._private import rpc
 from ray_tpu._private import stats as _stats
@@ -106,6 +107,11 @@ class GcsServer:
         self.metrics_history: dict[str, dict] = {}
         self.metrics_history_samples = 300
         self.metrics_last_push: dict[str, float] = {}
+        # History epoch: metrics-history and trace rings are DIRECTOR
+        # MEMORY ONLY by contract (ARCHITECTURE.md "State introspection"
+        # — the lossy-restart contract): a restart resets them, and
+        # consumers (`ray-tpu top`) detect the reset by this changing.
+        self.started_at = time.time()
         if storage is not None:
             self._restore()
 
@@ -214,6 +220,8 @@ class GcsServer:
             "get_events": self.h_get_events,
             "get_metrics": self.h_get_metrics,
             "get_shard_map": self.h_get_shard_map,
+            "debug_state": self.h_debug_state,
+            "debug_stacks": lambda conn, data: _debug.collect_stacks(),
             "ping": lambda conn, data: "pong",
         }
 
@@ -904,7 +912,77 @@ class GcsServer:
             out[source] = {
                 name: list(ring)[-samples:] if samples > 0 else list(ring)
                 for name, ring in rings.items()}
+        if d.get("meta"):
+            # history-epoch envelope (opt-in, shape-preserving for old
+            # callers): started_at changing between two reads means the
+            # director restarted and the rings reset — the documented
+            # lossy-restart contract `ray-tpu top` renders as a marker
+            return {"meta": {"started_at": self.started_at,
+                             "retention_samples":
+                                 self.metrics_history_samples},
+                    "series": out}
         return out
+
+    async def h_debug_state(self, conn, d):
+        """Director live state: membership + heartbeat ages, actor/pg/
+        job table sizes, pubsub fan-out, observability-ring occupancy,
+        shard tier state (each live shard's own debug_state embedded,
+        bounded wait)."""
+        t_start = time.monotonic()
+        mono = time.monotonic()
+        nodes = []
+        for node_id, info in list(self.nodes.items()):
+            last = self.last_heartbeat.get(node_id)
+            conn_n = self.node_conns.get(node_id)
+            nodes.append({
+                "node_id": node_id.hex()[:8],
+                "address": info.get("address", ""),
+                "state": info.get("state", ""),
+                "is_head": bool(info.get("is_head")),
+                "heartbeat_age_s": (round(mono - last, 3)
+                                    if last is not None else None),
+                "conn_live": bool(conn_n is not None
+                                  and not conn_n.closed),
+            })
+        actor_states: dict[str, int] = {}
+        for rec in self.actors.values():
+            actor_states[rec["state"]] = (
+                actor_states.get(rec["state"], 0) + 1)
+        snap = {
+            "role": "gcs",
+            "started_at": self.started_at,
+            "nodes_table": nodes,
+            "actors_by_state": actor_states,
+            "pending_actor_queue": len(self._pending_actor_queue),
+            "placement_groups": {
+                "total": len(self.placement_groups),
+                "pending": sum(1 for r in self.placement_groups.values()
+                               if r["state"] == "PENDING")},
+            "jobs": len(self.jobs),
+            "kv_keys": len(self.kv),
+            "object_locations": len(self.object_locations),
+            "pubsub": {ch: len(subs)
+                       for ch, subs in list(self.subscriptions.items())
+                       if subs},
+            "rings": {"events": len(self.events),
+                      "profile_events": len(self.profile_events),
+                      "trace_spans": len(self.trace_spans),
+                      "metrics_sources": len(self.metrics_history)},
+            "rpc": {"server_conns": len(self.server.connections)},
+        }
+        if self.shard_addresses:
+            async def one(idx):
+                try:
+                    c = await self._shard_conn(idx)
+                    return await asyncio.wait_for(
+                        c.call("debug_state", {}), timeout=2.0)
+                except Exception as e:
+                    return {"error": f"{type(e).__name__}: {e}",
+                            "address": self.shard_addresses[idx]}
+
+            snap["shards"] = list(await asyncio.gather(
+                *(one(i) for i in range(len(self.shard_addresses)))))
+        return _debug.finish_snapshot(snap, t_start)
 
     async def h_get_metrics(self, conn, d):
         """This process's metric registry + computed cluster gauges."""
@@ -1274,6 +1352,7 @@ class GcsServer:
                   uds_dir: str | None = None):
         cfg = get_config()
         self._uds_dir = uds_dir
+        _debug.start_loop_lag_monitor()
         actual = await self.server.start_tcp(host=cfg.bind_host, port=port,
                                              uds_dir=uds_dir)
         asyncio.create_task(self.heartbeat_checker())

@@ -34,9 +34,11 @@ import argparse
 import asyncio
 import logging
 import os
+import time
 
 import msgpack
 
+from ray_tpu._private import debug_state as _debug
 from ray_tpu._private import failpoints as _fp
 from ray_tpu._private import rpc
 from ray_tpu._private import stats as _stats
@@ -199,8 +201,29 @@ class GcsShard:
             "configure_failpoints": self.h_configure_failpoints,
             "shard_snapshot": self.h_shard_snapshot,
             "get_metrics": self.h_get_metrics,
+            "debug_state": self.h_debug_state,
+            "debug_stacks": lambda conn, d: _debug.collect_stacks(),
             "ping": lambda conn, d: "pong",
         }
+
+    async def h_debug_state(self, conn, d):
+        """Shard live state: partition table sizes, journal occupancy,
+        conn depth — the per-shard row inside the director's snapshot."""
+        t_start = time.monotonic()
+        snap = {
+            "role": "gcs-shard",
+            "index": self.index,
+            "kv_keys": len(self.kv),
+            "object_locations": len(self.object_locations),
+            "actor_mirrors": len(self.actors),
+            "pg_mirrors": len(self.placement_groups),
+            "ops_total": M_SHARD_OPS.snapshot()["value"],
+            "journal": ({"pending_flush": self._flush_fut is not None
+                         and not self._flush_fut.done()}
+                        if self.journal is not None else None),
+            "rpc": {"server_conns": len(self.server.connections)},
+        }
+        return _debug.finish_snapshot(snap, t_start)
 
     # kv — same wire surface as the director's handlers, so routing is
     # invisible to callers
@@ -303,6 +326,7 @@ class GcsShard:
     async def run(self, port: int, ready_file: str | None = None,
                   uds_dir: str | None = None):
         cfg = get_config()
+        _debug.start_loop_lag_monitor()
         actual = await self.server.start_tcp(host=cfg.bind_host, port=port,
                                              uds_dir=uds_dir)
         logger.info("GCS shard %d listening on %s:%d", self.index,

@@ -301,6 +301,8 @@ def main(seconds_per_case: float = 2.0) -> list[dict]:
 
     _tracing_ab(results)
 
+    _state_ab(results)
+
     ray_tpu.shutdown()
 
     _cross_node_bench(results)
@@ -825,6 +827,76 @@ def _tracing_ab(results: list[dict]):
     _rate_rows(results, [
         ("tracing A/B serve http qps", on_rates),
         ("tracing A/B serve http qps (tracing-off control)", off_rates),
+    ], windows=5)
+    pool.shutdown()
+    serve.shutdown()
+
+
+def _state_ab(results: list[dict]):
+    """Live-state-introspection overhead A/B (the tier-1 gate in
+    tests/test_state_api.py reads these rows): the stall doctor armed
+    at its 1s cadence — a background thread collecting cluster_state
+    (GCS + raylet + per-worker debug_state fan-out) plus histogram
+    diagnosis plus stall-event dedup EVERY second, ray_tpu.start_doctor
+    — against a doctor-off control, paired-interleaved on the same two
+    rows the tracing gate watches (tasks sync, serve http qps). The
+    introspection plane must be cheap enough to leave armed in
+    production: the gate fails tier-1 on >5% regression."""
+    from ray_tpu import api as _api
+    from ray_tpu import serve
+
+    def arm(on: bool):
+        def setup():
+            if on:
+                _api.start_doctor(interval=1.0)
+            else:
+                _api.stop_doctor()
+            time.sleep(0.05)
+
+        return setup
+
+    AB = lambda fn: {"": (arm(True), fn),  # noqa: E731
+                     "state-off control": (arm(False), fn)}
+
+    @ray_tpu.remote
+    def small_task():
+        return b"ok"
+
+    def task_sync():
+        ray_tpu.get(small_task.remote())
+
+    timeit_ab("state A/B tasks sync", AB(task_sync), results=results)
+    _api.stop_doctor()
+
+    client = serve.start(http=True)
+    client.create_backend("noop_st", lambda _=None: "ok", config={
+        "num_replicas": 2, "max_batch_size": 32,
+        "batch_wait_timeout": 0.001, "max_concurrent_queries": 8})
+    client.create_endpoint("noop_st", backend="noop_st", route="/noop_st")
+    handle = client.get_handle("noop_st")
+    ray_tpu.get(handle.remote(None), timeout=60)  # warm the path
+
+    import threading as _threading
+    from concurrent.futures import ThreadPoolExecutor
+
+    pool = ThreadPoolExecutor(max_workers=16)
+    tls = _threading.local()
+    port = client.http_port
+
+    def http_window(seconds: float = 0.7) -> float:
+        return _http_qps_window(pool, tls, port, "/noop_st", seconds)
+
+    http_window(0.2)  # warm keep-alive conns
+    on_rates, off_rates = [], []
+    for _ in range(5):
+        arm(True)()
+        on_rates.append(http_window())
+        arm(False)()
+        off_rates.append(http_window())
+    arm(False)()
+    _rate_rows(results, [
+        ("state A/B serve http qps", on_rates),
+        ("state A/B serve http qps (state-off control)", off_rates),
     ], windows=5)
     pool.shutdown()
     serve.shutdown()

@@ -26,6 +26,7 @@ import subprocess
 import sys
 import time
 
+from ray_tpu._private import debug_state as _debug
 from ray_tpu._private import failpoints as _fp
 from ray_tpu._private import rpc
 from ray_tpu._private import tracing
@@ -222,6 +223,8 @@ class Raylet:
             "transfer_done": self.h_transfer_done,
             "set_transfer_mode": self.h_set_transfer_mode,
             "peer_ping": self.h_peer_ping,
+            "debug_state": self.h_debug_state,
+            "debug_stacks": lambda conn, d: _debug.collect_stacks(),
             "ping": lambda conn, d: "pong",
         }
 
@@ -364,7 +367,11 @@ class Raylet:
                 self.idle.append(worker)
             self._wake_worker_waiters()
         else:  # driver
-            conn.context["driver"] = True
+            # truthy dict (callers only truth-test it): pid/address let
+            # debug_state/doctor reach driver-owned task state from the
+            # out-of-process surfaces (ray-tpu state/doctor, dashboard)
+            conn.context["driver"] = {"pid": d.get("pid"),
+                                      "address": d.get("address", "")}
         return {"node_id": self.node_id.binary(), "address": self.address}
 
     async def _on_disconnect(self, conn):
@@ -750,6 +757,9 @@ class Raylet:
                 self.m_spillbacks.inc()
                 return await self._spill(d, addr, hops + 1)
         fut = asyncio.get_running_loop().create_future()
+        # queue-arrival stamp rides the spec so debug_state/doctor can age
+        # the raylet's lease queue (carried along spillback forwards too)
+        spec.setdefault("_queued_at", time.time())
         self.pending_leases.append((spec, fut))
         result = await fut
         if result.get("granted"):
@@ -1742,16 +1752,22 @@ class Raylet:
         # util/metrics.py live in worker processes)
         import asyncio
 
-        async def _pull(w):
+        async def _pull(conn):
             try:
                 return await asyncio.wait_for(
-                    w.conn.call("get_stats", {}), timeout=2.0)
+                    conn.call("get_stats", {}), timeout=2.0)
             except Exception:
                 return {}
 
-        worker_snaps = await asyncio.gather(
-            *[_pull(w) for w in list(self.workers.values())
-              if not w.conn.closed])
+        # workers AND connected drivers: the submit-side task histograms
+        # (core.task_lease_wait_s etc.) live in the OWNER process, which
+        # for driver-submitted work is the driver — without its fold the
+        # doctor's K*p99 thresholds would never see those stages
+        conns = [w.conn for w in list(self.workers.values())
+                 if not w.conn.closed]
+        conns += [c for c in list(self.server.connections)
+                  if c.context.get("driver") and not c.closed]
+        worker_snaps = await asyncio.gather(*[_pull(c) for c in conns])
         # raylet-owned names are never clobbered by a worker metric that
         # happens to share the name; incompatible merges log once
         reserved = set(snap)
@@ -1811,6 +1827,104 @@ class Raylet:
                 "transfer_pins": self.transfer_pins.count(),
             },
         }
+
+    async def h_debug_state(self, conn, d):
+        """Live-state snapshot of this raylet: worker pool, lease queue
+        with ages, spillback grants awaiting adoption, object/transfer
+        plane, rpc depth. With include_workers=True, fans out to every
+        registered worker's debug_state (bounded per-worker wait) so one
+        call answers for the whole node."""
+        t_start = time.monotonic()
+        now = time.time()
+        mono = time.monotonic()
+        pool = []
+        idle = set(id(w) for w in self.idle) | set(
+            id(w) for w in self.idle_tpu)
+        for w in list(self.workers.values()):
+            pool.append({
+                "worker_id": w.worker_id.hex()[:16],
+                "pid": w.pid,
+                "address": w.address,
+                "flavor": w.flavor,
+                "lease_id": w.lease_id.hex() if w.lease_id else "",
+                "actor_id": (w.actor_id.hex()[:16]
+                             if w.actor_id else ""),
+                "idle": id(w) in idle,
+            })
+        pending = []
+        for spec, fut in list(self.pending_leases):
+            q = spec.get("_queued_at")
+            ctx = tracing.from_wire(spec.get("trace"))
+            pending.append({
+                "name": spec.get("name", "?"),
+                "age_s": round(now - q, 3) if q else None,
+                "resources": dict(spec.get("resources") or {}),
+                "trace_id": ctx.trace_id.hex() if ctx is not None else "",
+            })
+        spilled = sum(1 for r in self.local_objects.values()
+                      if r.get("spilled"))
+        snap = {
+            "role": "raylet",
+            "node_id": self.node_id.hex()[:8],
+            "address": self.address,
+            "is_head": self.is_head,
+            "resources": {"total": self.total.raw(),
+                          "available": self.available.raw()},
+            "worker_pool": pool,
+            "idle_workers": len(self.idle) + len(self.idle_tpu),
+            "starting_workers": self.starting + self.starting_tpu,
+            "pending_leases": pending,
+            "unadopted_spillback_grants": [
+                {"lease_id": lid.hex(),
+                 "expires_in_s": round(dl - mono, 3)}
+                for lid, dl in list(self._unadopted.items())],
+            "objects": {"local_objects": len(self.local_objects),
+                        "store_used_bytes": self.store_used,
+                        "spilled": spilled,
+                        "pulls_inflight": len(self._pulls_inflight)},
+            "transfers": transfer.debug_transfers(self.transfer_pins),
+            "bundles": len(self.bundles),
+            "rpc": {"server_conns": len(self.server.connections),
+                    "gcs_depth": (_debug.conn_depth(self.gcs.director)
+                                  if self.gcs is not None else 0)},
+        }
+        if d.get("include_workers"):
+            async def one(w):
+                try:
+                    state = await asyncio.wait_for(
+                        w.conn.call("debug_state", {}), timeout=2.0)
+                except Exception as e:
+                    state = {"error": f"{type(e).__name__}: {e}",
+                             "pid": w.pid}
+                return w.worker_id.hex()[:16], state
+
+            got = await asyncio.gather(
+                *(one(w) for w in list(self.workers.values())
+                  if not w.conn.closed))
+            snap["workers"] = dict(got)
+
+            # connected DRIVERS too (duplex conns carry their handlers):
+            # driver-owned task state — e.g. a task stuck in lease_wait,
+            # which lives only in the owner's `submitted` table — is
+            # otherwise invisible to the out-of-process surfaces
+            async def one_driver(conn, info):
+                pid = (info or {}).get("pid")
+                try:
+                    state = await asyncio.wait_for(
+                        conn.call("debug_state", {}), timeout=2.0)
+                except Exception as e:
+                    state = {"error": f"{type(e).__name__}: {e}",
+                             "pid": pid}
+                return str(pid or id(conn)), state
+
+            drivers = [(c, c.context.get("driver"))
+                       for c in list(self.server.connections)
+                       if c.context.get("driver") and not c.closed]
+            if drivers:
+                got = await asyncio.gather(
+                    *(one_driver(c, info) for c, info in drivers))
+                snap["drivers"] = dict(got)
+        return _debug.finish_snapshot(snap, t_start)
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -1990,6 +2104,7 @@ class Raylet:
 
     async def run(self, port: int = 0, ready_file: str | None = None):
         self._loop = asyncio.get_running_loop()
+        _debug.start_loop_lag_monitor()
         actual = await self.server.start_tcp(
             host=self.config.bind_host, port=port,
             uds_dir=os.path.join(self.session_dir, "sock"))

@@ -83,6 +83,56 @@ M_INFLIGHT_CHUNKS = _stats.Gauge(
     "raylet.transfer_inflight_chunks",
     "bulk-transfer chunk records currently being sent/received")
 
+# ---------------------------------------------------------------------------
+# live-transfer registry (debug_state / stall doctor): every in-flight
+# streaming pull (receiver side) and serve stream (sender side) in this
+# process, with age + progress — so `ray-tpu state transfers` can answer
+# "which stream is stuck and how far did it get" for a live raylet.
+# ---------------------------------------------------------------------------
+
+import itertools as _itertools
+
+_active_lock = threading.Lock()
+_active_pulls: dict[int, dict] = {}
+_active_serves: dict[int, dict] = {}
+_active_ids = _itertools.count(1)
+
+
+def _track(table: dict, entry: dict) -> int:
+    token = next(_active_ids)
+    with _active_lock:
+        table[token] = entry
+    return token
+
+
+def _untrack(table: dict, token: int) -> None:
+    with _active_lock:
+        table.pop(token, None)
+
+
+def debug_transfers(pins: "TransferPins | None" = None) -> dict:
+    """Msgpack-safe snapshot of this process's in-flight transfers."""
+    now = time.monotonic()
+    out = {"pulls": [], "serves": []}
+    with _active_lock:
+        items = ([("pulls", e) for e in _active_pulls.values()]
+                 + [("serves", e) for e in _active_serves.values()])
+    for kind, e in items:
+        remaining = e.get("remaining")
+        size = e.get("size", 0)
+        done = (size - remaining[0]) if remaining else e.get("sent", 0)
+        out[kind].append({
+            "object_id": e["object_id"],
+            "age_s": round(now - e["t0"], 3),
+            "size": size,
+            "progress": f"{done}/{size}",
+            "sources": e.get("sources", 1),
+            "trace_id": e.get("trace_id", ""),
+        })
+    if pins is not None:
+        out["pins"] = pins.debug()
+    return out
+
 
 class PullError(Exception):
     """Streaming pull failed on every source; carries per-source causes."""
@@ -212,6 +262,26 @@ class TransferPins:
     def count(self) -> int:
         with self._lock:
             return len(self._leases)
+
+    def debug(self) -> dict:
+        """Per-object pin state for debug_state: live pin count and the
+        seconds until the soonest lease expiry (negative = overdue for
+        the next sweep)."""
+        now = time.monotonic()
+        out: dict[str, dict] = {}
+        with self._lock:
+            for (token, oid), expires in self._leases.items():
+                rec = out.setdefault(oid.hex()[:12], {
+                    "pins": 0, "expires_in_s": None, "deferred_free": False})
+                rec["pins"] += 1
+                left = round(expires - now, 3)
+                if rec["expires_in_s"] is None or left < rec["expires_in_s"]:
+                    rec["expires_in_s"] = left
+            for oid in self._deferred_free:
+                out.setdefault(oid.hex()[:12], {
+                    "pins": 0, "expires_in_s": None,
+                    "deferred_free": True})["deferred_free"] = True
+        return out
 
 
 # ---------------------------------------------------------------------------
@@ -454,18 +524,29 @@ class BulkTransferServer:
                                 {"size": size}]))
         pos = offset
         view = buf.view
-        while pos < end:
-            n = min(chunk, end - pos)
-            if _fp.ARMED:
-                if _fp.fire("transfer.chunk_send") == "drop_conn":
-                    raise ConnectionError("transfer.chunk_send drop_conn")
-            M_INFLIGHT_CHUNKS.add(1)
-            try:
-                _sendmsg_all(sock, _CHUNK.pack(pos, n), view[pos:pos + n])
-            finally:
-                M_INFLIGHT_CHUNKS.add(-1)
-            pos += n
-        sock.sendall(_CHUNK.pack(_DONE_OFFSET, 0))
+        serve_entry = {"object_id": oid.hex()[:12], "t0": time.monotonic(),
+                       "size": end - offset, "sent": 0,
+                       "trace_id": (_trace_ctx.trace_id.hex()
+                                    if _trace_ctx is not None else "")}
+        serve_token = _track(_active_serves, serve_entry)
+        try:
+            while pos < end:
+                n = min(chunk, end - pos)
+                if _fp.ARMED:
+                    if _fp.fire("transfer.chunk_send") == "drop_conn":
+                        raise ConnectionError(
+                            "transfer.chunk_send drop_conn")
+                M_INFLIGHT_CHUNKS.add(1)
+                try:
+                    _sendmsg_all(sock, _CHUNK.pack(pos, n),
+                                 view[pos:pos + n])
+                finally:
+                    M_INFLIGHT_CHUNKS.add(-1)
+                pos += n
+                serve_entry["sent"] = pos - offset
+            sock.sendall(_CHUNK.pack(_DONE_OFFSET, 0))
+        finally:
+            _untrack(_active_serves, serve_token)
         if _trace_ctx is not None and length:
             _tracing.record_span(
                 "transfer.serve", _trace_start, time.time(),
@@ -622,6 +703,7 @@ def streaming_pull(oid: bytes, object_id: ObjectID, store,
         first.close()
         raise
     wedged = False  # a live writer thread forbids store.abort (below)
+    pull_token = None
     try:
         view = buf.view
         unit = max(chunk, stripe)
@@ -635,6 +717,11 @@ def streaming_pull(oid: bytes, object_id: ObjectID, store,
         lock = threading.Lock()
         remaining = [size]
         bytes_by_source: dict[str, int] = {}
+        pull_token = _track(_active_pulls, {
+            "object_id": oid.hex()[:12], "t0": time.monotonic(),
+            "size": size, "remaining": remaining,
+            "sources": len(usable),
+            "trace_id": (bytes(trace[0]).hex() if trace else "")})
 
         nsources = max(1, len(usable))
         conns: list[_Source] = []  # live worker connections (abort hook)
@@ -747,4 +834,7 @@ def streaming_pull(oid: bytes, object_id: ObjectID, store,
         if not wedged:
             store.abort(object_id)
         raise
+    finally:
+        if pull_token is not None:
+            _untrack(_active_pulls, pull_token)
     return size

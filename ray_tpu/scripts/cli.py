@@ -320,9 +320,28 @@ def cmd_top(args) -> int:
         print("no cluster found", file=sys.stderr)
         return 1
 
+    epoch = [None]  # GCS history epoch across renders (reset marker)
+
     def render() -> int:
-        hist = _rpc_call(addr, "get_metrics_history", {"samples": 0})
+        reply = _rpc_call(addr, "get_metrics_history",
+                          {"samples": 0, "meta": True})
+        if isinstance(reply, dict) and "series" in reply:
+            hist = reply["series"]
+            started = (reply.get("meta") or {}).get("started_at")
+        else:  # pre-meta GCS
+            hist, started = reply, None
+        reset = (epoch[0] is not None and started is not None
+                 and started != epoch[0])
+        if started is not None:
+            epoch[0] = started
         lines = []
+        if reset:
+            # metrics history + trace rings are director-memory-only
+            # (documented lossy-restart contract): a restart resets
+            # them — render the discontinuity instead of silently
+            # splicing fresh samples onto the old view
+            lines.append("  ===== history reset: GCS (re)started — "
+                         "rings cleared, rates restart from zero =====")
         for source in sorted(hist):
             rings = hist[source]
             rows = []
@@ -375,6 +394,166 @@ def cmd_top(args) -> int:
     except KeyboardInterrupt:
         pass
     return 0
+
+
+def _fmt_row(row: dict, drop=("process",)) -> str:
+    parts = []
+    for k, v in row.items():
+        if k in drop or v in ("", None, [], {}):
+            continue
+        parts.append(f"{k}={v}")
+    return "  ".join(parts)
+
+
+def cmd_state(args) -> int:
+    """Live cluster introspection (`ray-tpu state [component]`): every
+    process's debug_state() aggregated over the rpc plane — no driver
+    runtime needed. Without a component: a per-process summary; with
+    one (tasks|actors|objects|leases|transfers|collectives): flat rows
+    across the cluster, oldest first."""
+    addr = _gcs_address(args)
+    if not addr:
+        print("no cluster found", file=sys.stderr)
+        return 1
+    from ray_tpu._private import debug_state
+
+    snap = debug_state.collect_via_rpc(
+        addr, include_workers=not args.no_workers, timeout=args.timeout)
+    if not args.component:
+        for label, proc in debug_state.iter_processes(snap):
+            if "error" in proc:
+                print(f"{label}: UNREACHABLE ({proc['error']})")
+                continue
+            bits = [f"pid={proc.get('pid')}"]
+            lag = proc.get("event_loop_lag_s")
+            if lag is not None:
+                bits.append(f"loop_lag={lag * 1e3:.1f}ms")
+            for key, fmt in (("tasks", "tasks"), ("executing", "exec"),
+                             ("leases", "leases"), ("actors", "actors"),
+                             ("pending_leases", "lease_queue"),
+                             ("worker_pool", "workers"),
+                             ("collectives", "collective_groups")):
+                n = len(proc.get(key) or [])
+                if n:
+                    bits.append(f"{fmt}={n}")
+            tr = proc.get("transfers") or {}
+            n = len(tr.get("pulls") or []) + len(tr.get("serves") or [])
+            if n:
+                bits.append(f"transfers={n}")
+            print(f"{label}: " + "  ".join(bits))
+        return 0
+    rows = debug_state.flatten(snap, args.component)
+    if args.filter:
+        rows = [r for r in rows
+                if any(args.filter in str(v) for v in r.values())]
+    if not rows:
+        print(f"(no live {args.component})")
+        return 0
+    for row in rows:
+        print(f"{row.get('process', '?'):<28} {_fmt_row(row)}")
+    return 0
+
+
+def _find_stack_address(snap, target: str):
+    """Resolve a `ray-tpu stack` target (pid | worker/node id prefix |
+    address) to (label, rpc address) from a cluster snapshot."""
+    from ray_tpu._private import debug_state
+
+    for label, proc in debug_state.iter_processes(snap):
+        addr = proc.get("address")
+        if str(proc.get("pid")) == target:
+            return label, addr
+        if target and (target in label
+                       or (addr and target in addr)
+                       or target == proc.get("worker_id", "")[:len(target)]
+                       or target == proc.get("node_id", "")):
+            return label, addr
+    return None, None
+
+
+def cmd_stack(args) -> int:
+    """All-thread Python stacks of any live runtime process
+    (sys._current_frames over rpc): `ray-tpu stack gcs`, a pid, a
+    node/worker id prefix, or an rpc address."""
+    addr = _gcs_address(args)
+    if not addr:
+        print("no cluster found", file=sys.stderr)
+        return 1
+    target = args.target
+    if target == "gcs":
+        label, stacks = "gcs", _rpc_call(addr, "debug_stacks")
+    else:
+        from ray_tpu._private import debug_state
+
+        snap = debug_state.collect_via_rpc(addr, timeout=args.timeout)
+        label, proc_addr = _find_stack_address(snap, target)
+        if proc_addr is None:
+            print(f"no live process matches {target!r} (try "
+                  f"`ray-tpu state` for pids/ids)", file=sys.stderr)
+            return 1
+        stacks = _rpc_call(proc_addr, "debug_stacks")
+    print(f"=== {label} (pid {stacks.get('pid')}), "
+          f"{len(stacks.get('threads', []))} thread(s) ===")
+    for t in stacks.get("threads", []):
+        daemon = " daemon" if t.get("daemon") else ""
+        print(f"\n--- thread {t['name']}{daemon} ---")
+        print(t["stack"].rstrip())
+    return 0
+
+
+def cmd_doctor(args) -> int:
+    """The stall doctor, out of process: collect cluster_state + the
+    per-hop latency histograms, flag anything whose age exceeds
+    max(floor, K×p99) for its stage, and print each finding with its
+    owning process (+ stacks with --stacks). Exit code 1 when stalls
+    were found."""
+    addr = _gcs_address(args)
+    if not addr:
+        print("no cluster found", file=sys.stderr)
+        return 1
+    from ray_tpu._private import debug_state
+
+    snap = debug_state.collect_via_rpc(addr, timeout=args.timeout)
+    metrics = {"raylets": {}}
+    try:
+        metrics["gcs"] = _rpc_call(addr, "get_metrics")
+        for n in _rpc_call(addr, "get_all_nodes"):
+            try:
+                metrics["raylets"][n["node_id"].hex()[:8]] = _rpc_call(
+                    n["address"], "get_metrics")
+            except Exception:
+                pass
+    except Exception:
+        pass
+    findings = debug_state.diagnose(snap, metrics, floor_s=args.floor,
+                                    p99_factor=args.p99_factor)
+    if not findings:
+        print("doctor: no stalls detected "
+              f"(floor {args.floor if args.floor is not None else debug_state.DOCTOR_FLOOR_S}s, "
+              f"K={args.p99_factor if args.p99_factor is not None else debug_state.DOCTOR_P99_FACTOR})")
+        return 0
+    seen_procs = set()
+    for f in findings:
+        tid = f" trace={f['trace_id']}" if f.get("trace_id") else ""
+        print(f"STALLED {f['kind']} {f.get('name') or f.get('id')}: "
+              f"stage={f['stage']} age={f['age_s']:.1f}s "
+              f"(threshold {f['threshold_s']:.1f}s) on {f['process']}"
+              f"{tid}  {f.get('detail', '')}")
+        if args.stacks and f["process"] not in seen_procs:
+            seen_procs.add(f["process"])
+            _, proc_addr = _find_stack_address(snap, f["process"])
+            if proc_addr:
+                try:
+                    stacks = _rpc_call(proc_addr, "debug_stacks")
+                    for t in stacks.get("threads", []):
+                        print(f"  --- {f['process']} thread "
+                              f"{t['name']} ---")
+                        for line in t["stack"].rstrip().splitlines():
+                            print(f"  {line}")
+                except Exception as e:
+                    print(f"  (stacks unreachable: {e})")
+    print(f"{len(findings)} finding(s)")
+    return 1
 
 
 def cmd_submit(args) -> int:
@@ -615,6 +794,45 @@ def main(argv=None) -> int:
     p.add_argument("--filter", default=None,
                    help="only metrics whose name contains this substring")
     p.set_defaults(fn=cmd_top)
+
+    p = sub.add_parser("state",
+                       help="live cluster introspection (debug_state "
+                            "of every process)")
+    p.add_argument("component", nargs="?", default=None,
+                   choices=["tasks", "actors", "objects", "leases",
+                            "transfers", "collectives"],
+                   help="flat rows for one component class "
+                        "(omit for a per-process summary)")
+    p.add_argument("--address", default=None)
+    p.add_argument("--filter", default=None,
+                   help="only rows containing this substring")
+    p.add_argument("--no-workers", action="store_true",
+                   help="skip the per-worker fan-out (faster)")
+    p.add_argument("--timeout", type=float, default=5.0)
+    p.set_defaults(fn=cmd_state)
+
+    p = sub.add_parser("stack",
+                       help="all-thread Python stacks of a live "
+                            "process (gcs | pid | id prefix | address)")
+    p.add_argument("target")
+    p.add_argument("--address", default=None)
+    p.add_argument("--timeout", type=float, default=5.0)
+    p.set_defaults(fn=cmd_stack)
+
+    p = sub.add_parser("doctor",
+                       help="stall doctor: flag in-flight work whose "
+                            "age exceeds max(floor, K*p99) of its stage")
+    p.add_argument("--address", default=None)
+    p.add_argument("--floor", type=float, default=None,
+                   help="absolute stall floor in seconds (default 1.0 / "
+                        "RAY_TPU_DOCTOR_FLOOR_S)")
+    p.add_argument("--p99-factor", type=float, default=None,
+                   help="K in max(floor, K*p99) (default 3.0 / "
+                        "RAY_TPU_DOCTOR_P99_K)")
+    p.add_argument("--stacks", action="store_true",
+                   help="print the flagged processes' thread stacks")
+    p.add_argument("--timeout", type=float, default=5.0)
+    p.set_defaults(fn=cmd_doctor)
 
     p = sub.add_parser("timeline", help="dump chrome-trace profile timeline")
     p.add_argument("--address", default=None)

@@ -76,6 +76,29 @@ class ServeController:
         self._stopped = True
         return True
 
+    def __ray_debug_state__(self) -> dict:
+        """Live-state hook (debug_state.py): desired vs actual replica
+        sets and the router queue reports driving the autoscaler —
+        plain dict reads under the GIL, safe from any thread."""
+        now = time.monotonic()
+        return {
+            "kind": "serve-controller",
+            "version": self.version,
+            "backends": {
+                name: {"replicas": len(rec["replicas"]),
+                       "target": rec["config"].get("num_replicas"),
+                       "autoscaling":
+                           bool(rec["config"].get("autoscaling"))}
+                for name, rec in list(self.backends.items())},
+            "endpoints": {
+                name: {"route": ep.get("route"),
+                       "traffic": dict(ep["traffic"])}
+                for name, ep in list(self.endpoints.items())},
+            "queue_reports": {
+                ep: {"queued": q, "report_age_s": round(now - ts, 3)}
+                for ep, (q, ts) in list(self._queue_lens.items())},
+        }
+
     def _notify_change(self):
         """Wake parked listen_for_change calls; safe from any thread."""
         loop = self._loop

@@ -183,3 +183,15 @@ class HTTPProxy:
 
     def ping(self):
         return "pong"
+
+    def __ray_debug_state__(self) -> dict:
+        """Live-state hook (debug_state.py): route table version + port.
+        Per-endpoint router queues surface through the process-level
+        router registry (serve/router.py debug_routers), not here."""
+        with self._state_lock:
+            routes = {path: r.get("endpoint", "")
+                      for path, r in self._routes.items()}
+        return {"kind": "serve-proxy", "version": self._version,
+                "port": self._actual_port, "routes": routes,
+                "server_error": (repr(self._error)
+                                 if self._error is not None else "")}

@@ -48,6 +48,8 @@ class Replica:
             reconfigure = getattr(self._callable, "reconfigure", None)
             if reconfigure:
                 reconfigure(user_config)
+        self._batches_handled = 0
+        self._last_batch_at = 0.0
 
     def reconfigure(self, user_config: dict):
         fn = getattr(self._callable, "reconfigure", None)
@@ -70,7 +72,17 @@ class Replica:
                 out = [self._callable(r) for r in requests]
         finally:
             M_REPLICA_EXEC_S.observe(time.time() - start)
+            self._batches_handled += 1
+            self._last_batch_at = time.time()
         return tuple(out) if len(out) > 1 else out[0]
 
     def ping(self):
         return "pong"
+
+    def __ray_debug_state__(self) -> dict:
+        """Live-state hook (debug_state.py)."""
+        return {"kind": "serve-replica",
+                "batches_handled": self._batches_handled,
+                "last_batch_age_s": (round(time.time()
+                                           - self._last_batch_at, 3)
+                                     if self._last_batch_at else None)}

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import threading
 import time
+import weakref
 
 from ray_tpu._private import stats as _stats
 from ray_tpu._private import tracing
@@ -23,6 +24,23 @@ M_ROUTER_QUEUE_S = _stats.Histogram(
     "serve.router_queue_s", _stats.LATENCY_BOUNDARIES_S,
     "query enqueue -> batch dispatch to a replica (the autoscaler's "
     "queue-delay feed, observed for every query)")
+
+# Live routers in this process (driver handles AND proxy actors), for
+# the debug_state/stall-doctor plane: queued queries with ages surface
+# in `ray-tpu state` without the router knowing who is asking.
+_live_routers: "weakref.WeakSet[Router]" = weakref.WeakSet()
+
+
+def debug_routers() -> list[dict]:
+    out = []
+    for router in list(_live_routers):
+        if getattr(router, "_closed", False):
+            continue
+        try:
+            out.append(router.debug_state())
+        except Exception:
+            continue
+    return out
 
 
 class _PendingQuery:
@@ -89,6 +107,31 @@ class Router:
         self._flusher.start()
         self._poller = threading.Thread(target=self._poll_loop, daemon=True)
         self._poller.start()
+        _live_routers.add(self)
+
+    def debug_state(self) -> dict:
+        """Msgpack-safe live snapshot: queued queries with ages (+trace
+        ids), per-replica in-flight batches — the serve rows of
+        `ray-tpu state` and the doctor's router_queue stage."""
+        now = time.time()
+        with self._lock:
+            queue = list(self._queue)
+            inflight = {aid.hex()[:16]: n
+                        for aid, n in self._inflight.items() if n}
+        return {
+            "endpoint": self._endpoint,
+            "queued": len(queue),
+            "oldest_age_s": (round(max(now - q.t_enqueue
+                                       for q in queue), 3)
+                             if queue else 0.0),
+            "inflight_batches": inflight,
+            "queries": [{
+                "endpoint": self._endpoint,
+                "age_s": round(now - q.t_enqueue, 3),
+                "trace_id": (q.trace.trace_id.hex()
+                             if q.trace is not None else ""),
+            } for q in queue[:25]],
+        }
 
     # -- state sync ------------------------------------------------------
 

@@ -20,6 +20,12 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 # backend use and propagates to all spawned runtime processes.
 os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 
+# Arm the driver-shutdown flight-recorder tail for the whole test tree:
+# the leak check names leaked workers/leases/pins from the final cluster
+# snapshot (debug_state.FINAL_SNAPSHOT). Opt-in by env so production
+# driver exits never pay the sweep.
+os.environ.setdefault("RAY_TPU_FINAL_SNAPSHOT", "1")
+
 # The plugin may already be registered in THIS interpreter (sitecustomize
 # runs before conftest); forcing the config keeps jax from ever
 # initializing it.
@@ -54,6 +60,69 @@ def pytest_configure(config):
         "chaos: seeded fault-injection sweep (slow tier). Runs with "
         "`pytest -m chaos`; a failure logs its seed — replay it "
         "deterministically with RAY_TPU_CHAOS_SEED=<seed>.")
+
+
+# ---------------------------------------------------------------------------
+# flight-recorder artifacts: chaos sweeps dump cluster_state + stacks on
+# deadline overrun, so a seeded hang is triaged from the recording
+# instead of a reproduction run
+# ---------------------------------------------------------------------------
+
+
+def _artifact_dir() -> str:
+    return os.environ.get(
+        "RAY_TPU_TEST_ARTIFACT_DIR",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "artifacts"))
+
+
+def dump_state_artifact(name: str, reason: str = "") -> str | None:
+    """Dump the live cluster's state snapshot + this process's thread
+    stacks to tests/artifacts/<name>.json. Never raises (triage must
+    not mask the original failure); returns the path or None."""
+    import re
+    import time as _time
+
+    from ray_tpu._private import debug_state, global_state
+
+    try:
+        cw = global_state.get_core_worker()
+        snap: dict = {}
+        if cw is not None:
+            try:
+                snap = cw.get_cluster_state(timeout=3.0)
+            except Exception as e:
+                snap = {"error": f"{type(e).__name__}: {e}"}
+        safe = re.sub(r"[^A-Za-z0-9._-]+", "_", name)[:150]
+        path = os.path.join(_artifact_dir(),
+                            f"{safe}-{int(_time.time())}.json")
+        out = debug_state.dump_artifact(path, snap, reason=reason)
+        print(f"[state-dump] cluster snapshot -> {out}")
+        return out
+    except Exception as e:  # pragma: no cover - best effort
+        print(f"[state-dump] failed: {e}")
+        return None
+
+
+class state_dump_on_failure:
+    """Context manager for chaos deadline waits: any escaping exception
+    (GetTimeoutError, assert, typed error the test didn't expect) dumps
+    a cluster_state + stacks artifact BEFORE the failure propagates —
+    while the wedged cluster is still alive to answer."""
+
+    def __init__(self, name: str, reason: str = "chaos deadline overrun"):
+        self.name = name
+        self.reason = reason
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        if exc_type is not None:
+            dump_state_artifact(
+                self.name,
+                reason=f"{self.reason}: {exc_type.__name__}: {exc_val}")
+        return False
 
 
 # ---------------------------------------------------------------------------
@@ -103,6 +172,62 @@ def _colseg_files() -> set:
     return found
 
 
+def _leak_notes(leaked_pids: dict, leaked_segs: set) -> str:
+    """Name leaked processes / segments / still-held resources from the
+    final cluster snapshot captured at driver shutdown (debug_state
+    FINAL_SNAPSHOT), so the failure reads as 'worker abc123 holding
+    lease X for actor Y' instead of a bare pid."""
+    from ray_tpu._private import debug_state
+
+    snap = debug_state.FINAL_SNAPSHOT
+    if not snap:
+        return ""
+    notes: list[str] = []
+    try:
+        by_pid: dict[int, str] = {}
+        for label, proc in debug_state.iter_processes(snap):
+            pid = proc.get("pid")
+            if isinstance(pid, int):
+                # setdefault: the raylet's worker_pool row (richer —
+                # actor/lease held) wins over the worker's own label
+                by_pid.setdefault(pid, f"{label} ({proc.get('role', '?')})")
+            for w in proc.get("worker_pool") or []:
+                desc = (f"worker {w.get('worker_id')} on {label}"
+                        + (f" running actor {w['actor_id']}"
+                           if w.get("actor_id") else "")
+                        + (f" holding lease {w['lease_id']}"
+                           if w.get("lease_id") else ""))
+                if isinstance(w.get("pid"), int):
+                    by_pid[w["pid"]] = desc
+        for pid in leaked_pids:
+            if pid in by_pid:
+                notes.append(f"  pid {pid}: {by_pid[pid]}")
+        # resources still held at shutdown — the usual cause of orphans
+        for label, proc in debug_state.iter_processes(snap):
+            for lease in proc.get("leases") or []:
+                notes.append(
+                    f"  unreturned lease {lease.get('lease_id')} on "
+                    f"{label} -> worker {lease.get('worker')} "
+                    f"(inflight={lease.get('inflight')})")
+            pins = (proc.get("transfers") or {}).get("pins") or {}
+            for oid, rec in pins.items():
+                notes.append(f"  leaked transfer pin on {label}: object "
+                             f"{oid} ({rec.get('pins')} lease(s), "
+                             f"expires_in={rec.get('expires_in_s')}s)")
+            if leaked_segs:
+                for g in proc.get("collectives") or []:
+                    notes.append(
+                        f"  live collective group {g.get('group')!r} "
+                        f"rank {g.get('rank')} on {label} "
+                        f"(op={g.get('op') or 'idle'})")
+    except Exception:
+        return ""
+    if not notes:
+        return ""
+    return ("\nfinal cluster snapshot (captured at shutdown) names:\n"
+            + "\n".join(notes[:20]))
+
+
 @pytest.fixture(autouse=True)
 def leak_check(request):
     """After each test: if the test no longer holds a cluster, every
@@ -146,12 +271,14 @@ def leak_check(request):
             os.unlink(path)
         except OSError:
             pass
+    notes = (_leak_notes(leaked, leaked_segs)
+             if (leaked or leaked_segs) else "")
     assert not leaked, (
         f"test leaked {len(leaked)} orphaned runtime process(es) "
-        f"(now killed): {leaked}")
+        f"(now killed): {leaked}{notes}")
     assert not leaked_segs, (
         f"test leaked /dev/shm collective segment(s) (now removed): "
-        f"{sorted(leaked_segs)}")
+        f"{sorted(leaked_segs)}{notes}")
 
 
 @pytest.fixture

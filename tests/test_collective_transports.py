@@ -688,25 +688,35 @@ def test_chaos_device_and_quantized_kill_schedule(ray_start_shared, seed):
     ray_tpu.get(workers[victim_idx].arm_failpoint.remote(
         point, "exit", nth=nth), timeout=30)
     # the device seam is hit once per op, the quantize seam w+... times
-    # per op — issue rounds until the armed kill lands
+    # per op — issue rounds until the armed kill lands. A deadline
+    # overrun here dumps cluster_state + stacks to a per-test artifact
+    # before failing (flight-recorder triage for seeded hangs).
+    from tests.conftest import state_dump_on_failure
+
     outs = None
-    for _ in range(nth + 1):
-        refs = [w.timed_allreduce.remote(transport, 1 << 20,
-                                         quantize=quant)
-                for w in workers]
-        outs = []
-        for r in refs:
-            try:
-                outs.append(ray_tpu.get(r, timeout=scale_timeout(180)))
-            except Exception:  # the victim's own call dies with it
-                outs.append({"ok": False, "elapsed": 0.0, "died": True})
-        if not all(o["ok"] for o in outs):
-            break
-    survivors = [o for i, o in enumerate(outs) if i != victim_idx]
-    # every survivor errored (TimeoutError) within the deadline; the
-    # victim's own slot may be ok=False too (it died mid-call)
-    assert all(not o["ok"] for o in survivors), (point, nth, outs)
-    assert all(o["elapsed"] < timeout * 3 + 10 for o in survivors), outs
+    with state_dump_on_failure(
+            f"collective-chaos-{point.replace('.', '_')}-seed{seed}",
+            reason="collective kill-schedule deadline overrun"):
+        for _ in range(nth + 1):
+            refs = [w.timed_allreduce.remote(transport, 1 << 20,
+                                             quantize=quant)
+                    for w in workers]
+            outs = []
+            for r in refs:
+                try:
+                    outs.append(ray_tpu.get(r,
+                                            timeout=scale_timeout(180)))
+                except Exception:  # the victim's own call dies with it
+                    outs.append({"ok": False, "elapsed": 0.0,
+                                 "died": True})
+            if not all(o["ok"] for o in outs):
+                break
+        survivors = [o for i, o in enumerate(outs) if i != victim_idx]
+        # every survivor errored (TimeoutError) within the deadline; the
+        # victim's own slot may be ok=False too (it died mid-call)
+        assert all(not o["ok"] for o in survivors), (point, nth, outs)
+        assert all(o["elapsed"] < timeout * 3 + 10
+                   for o in survivors), outs
     keep = [w for i, w in enumerate(workers) if i != victim_idx]
     ray_tpu.get([w.destroy_group.remote() for w in keep],
                 timeout=scale_timeout(60))

@@ -443,6 +443,8 @@ def test_chaos_shard_and_director_kill(seed):
     import random
 
     rng = random.Random(seed)
+    from tests.conftest import state_dump_on_failure
+
     ray_tpu.init(num_cpus=2, _system_config={"gcs_shards": 2})
     node = _api._global_node
     try:
@@ -454,30 +456,35 @@ def test_chaos_shard_and_director_kill(seed):
         deadline = time.monotonic() + scale_timeout(120)
         victim = rng.randrange(2)
         kill_director = bool(seed % 2)
-        for round_no in range(3):
-            refs = [churn.remote(i) for i in range(20)]
-            for i in range(6):
-                key = f"chaos-{seed}-{round_no}-{i}"
-                internal_kv._kv_put(key, b"%d" % i)
-                acked[key] = b"%d" % i
-            if round_no == 1:
-                node.kill_gcs_shard(victim)
-                if kill_director:
-                    node.kill_gcs()
-            got = ray_tpu.get(refs, timeout=max(
-                5.0, deadline - time.monotonic()))
-            assert got == [i * i for i in range(20)]
-        # acked KV must be readable after the kills (journal replay /
-        # director restart against its WAL) — retry while the monitor
-        # finishes restarting
-        while True:
-            try:
-                for key, val in acked.items():
-                    assert internal_kv._kv_get(key) == val
-                break
-            except AssertionError:
-                if time.monotonic() > deadline:
-                    raise
-                time.sleep(0.5)
+        # deadline overruns dump cluster_state + all-thread stacks to a
+        # per-test artifact BEFORE failing (flight-recorder triage)
+        with state_dump_on_failure(
+                f"control-plane-chaos-seed{seed}",
+                reason="shard/director-kill workload deadline overrun"):
+            for round_no in range(3):
+                refs = [churn.remote(i) for i in range(20)]
+                for i in range(6):
+                    key = f"chaos-{seed}-{round_no}-{i}"
+                    internal_kv._kv_put(key, b"%d" % i)
+                    acked[key] = b"%d" % i
+                if round_no == 1:
+                    node.kill_gcs_shard(victim)
+                    if kill_director:
+                        node.kill_gcs()
+                got = ray_tpu.get(refs, timeout=max(
+                    5.0, deadline - time.monotonic()))
+                assert got == [i * i for i in range(20)]
+            # acked KV must be readable after the kills (journal replay /
+            # director restart against its WAL) — retry while the monitor
+            # finishes restarting
+            while True:
+                try:
+                    for key, val in acked.items():
+                        assert internal_kv._kv_get(key) == val
+                    break
+                except AssertionError:
+                    if time.monotonic() > deadline:
+                        raise
+                    time.sleep(0.5)
     finally:
         ray_tpu.shutdown()

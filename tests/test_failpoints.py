@@ -472,10 +472,17 @@ _SEEDS = ([int(os.environ["RAY_TPU_CHAOS_SEED"])]
 
 
 def _run_or_typed(label, seed, thunk):
-    """Run one workload: correct result or typed error; a hang fails."""
+    """Run one workload: correct result or typed error; a hang fails —
+    after dumping the live cluster's state + stacks to a per-test
+    artifact (flight-recorder triage: the seeded hang is diagnosed from
+    the recording, not a reproduction run)."""
+    from tests.conftest import dump_state_artifact
+
     try:
         thunk()
     except exc.GetTimeoutError:
+        dump_state_artifact(f"failpoints-chaos-{label}-seed{seed}",
+                            reason=f"{label} hung past its deadline")
         pytest.fail(f"[chaos seed={seed}] {label} HUNG past its deadline "
                     f"(replay: RAY_TPU_CHAOS_SEED={seed})")
     except _TYPED as e:

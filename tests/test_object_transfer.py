@@ -360,7 +360,11 @@ def test_chaos_source_death_mid_stream(seed, ray_start_cluster):
 
     # the striped pull: the doomed source exits at its nth chunk; the
     # survivor resumes the remaining ranges
-    got = ray_tpu.get(ref, timeout=scale_timeout(120))
+    from tests.conftest import state_dump_on_failure
+
+    with state_dump_on_failure(f"object-transfer-chaos-seed{seed}",
+                               reason="striped pull deadline overrun"):
+        got = ray_tpu.get(ref, timeout=scale_timeout(120))
     assert np.array_equal(got, _expected(n, "uint8")), \
         f"[chaos seed={seed}] SILENT CORRUPTION after source death"
     assert not doomed.svc.alive(), \
@@ -391,6 +395,10 @@ def test_chaos_source_death_mid_stream(seed, ray_start_cluster):
         with pytest.raises(exc.ObjectLostError):
             ray_tpu.get(ref2, timeout=scale_timeout(120))
     except exc.GetTimeoutError:
+        from tests.conftest import dump_state_artifact
+
+        dump_state_artifact(f"object-transfer-chaos-loss-seed{seed}",
+                            reason="single-source death hung")
         pytest.fail(f"[chaos seed={seed}] single-source death HUNG past "
                     f"its deadline (replay: RAY_TPU_CHAOS_SEED={seed})")
     finally:

@@ -627,6 +627,70 @@ def test_gcs_trace_table_apply_failpoint(ray_start_regular):
         ray_tpu.set_trace_sampling(0.01)
 
 
+def test_metrics_history_lossy_restart_contract(ray_start_regular):
+    """Satellite: the GCS metrics-history and trace rings are DIRECTOR
+    MEMORY ONLY by contract (ARCHITECTURE.md "State introspection &
+    stall doctor" — the jobs/actors/KV tables persist via WAL+journal,
+    the observability rings deliberately do not). A director restart
+    therefore resets them; consumers detect the reset via the history
+    epoch (`get_metrics_history` with meta=True), which `ray-tpu top`
+    renders as a visible "history reset" marker instead of silently
+    splicing fresh samples onto the old view."""
+    from tests.conftest import scale_timeout
+
+    from ray_tpu import api as _api
+    from ray_tpu._private import global_state
+
+    node = _api._global_node
+    cw = global_state.require_core_worker()
+
+    def history(meta=False):
+        return cw._io.run(cw.gcs.call(
+            "get_metrics_history", {"samples": 0, "meta": meta}),
+            timeout=10)
+
+    # let at least one sample land (raylet heartbeat piggyback, ~2s)
+    deadline = time.monotonic() + scale_timeout(30)
+    while time.monotonic() < deadline and not history():
+        time.sleep(0.5)
+    reply = history(meta=True)
+    assert "meta" in reply and reply["series"], reply
+    epoch0 = reply["meta"]["started_at"]
+    # meta=False preserves the pre-epoch wire shape for old consumers
+    assert "meta" not in history()
+
+    old_pid = next(s.proc.pid for s in node.processes
+                   if s.name == "gcs_server")
+    node.kill_gcs()
+    deadline = time.monotonic() + scale_timeout(40)
+    while time.monotonic() < deadline:
+        gcs = next((s for s in node.processes
+                    if s.name == "gcs_server"), None)
+        if gcs is not None and gcs.alive() and gcs.proc.pid != old_pid:
+            break
+        time.sleep(0.2)
+    else:
+        raise TimeoutError("GCS was not restarted")
+
+    deadline = time.monotonic() + scale_timeout(30)
+    while True:
+        try:
+            reply2 = history(meta=True)
+            break
+        except Exception:
+            if time.monotonic() > deadline:
+                raise
+            time.sleep(0.5)
+    epoch1 = reply2["meta"]["started_at"]
+    assert epoch1 != epoch0, "history epoch must change across a restart"
+    # every surviving sample was collected AFTER the restart: the rings
+    # were reset, not spliced (the lossy contract)
+    for source, rings in reply2["series"].items():
+        for name, series in rings.items():
+            assert all(ts >= epoch1 - 1.0 for ts, _ in series), (
+                f"pre-restart sample survived in {source}/{name}")
+
+
 @pytest.mark.chaos
 def test_chaos_gcs_killed_mid_flush(ray_start_regular):
     """Seeded chaos case (satellite): the GCS dies while traced work is
