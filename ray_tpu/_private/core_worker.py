@@ -2780,9 +2780,15 @@ class CoreWorker:
                 except BaseException as e:
                     if isinstance(e, (SystemExit, KeyboardInterrupt)):
                         raise
-                    error = exc.TaskError(type(e).__name__, repr(e),
-                                          traceback.format_exc())
-                    reply = self._pack_error(spec, error)
+                    if (isinstance(e, exc.RayTpuError)
+                            and not isinstance(e, exc.GetTimeoutError)):
+                        # typed runtime errors cross the task boundary
+                        # untranslated (same contract as the sync path)
+                        reply = self._pack_error(spec, e)
+                    else:
+                        error = exc.TaskError(type(e).__name__, repr(e),
+                                              traceback.format_exc())
+                        reply = self._pack_error(spec, error)
         finally:
             _ASYNC_TASK_ID.reset(token)
             self._cancelled_tasks.discard(spec["task_id"])
@@ -2887,6 +2893,19 @@ class CoreWorker:
         except BaseException as e:
             if isinstance(e, (SystemExit, KeyboardInterrupt)):
                 raise
+            if (isinstance(e, exc.RayTpuError)
+                    and not isinstance(e, exc.GetTimeoutError)):
+                # Typed runtime errors (ObjectLostError surfaced by an
+                # arg fetch, ReplicaGroupDied raised by a serve group
+                # leader, ...) propagate AS THEMSELVES — wrapping them in
+                # TaskError would strip the type the caller's retry/
+                # degradation logic dispatches on (reference: RayError
+                # subclasses cross the task boundary untranslated).
+                # GetTimeoutError stays wrapped: a remote task's internal
+                # get timeout must not masquerade as the CALLER's own
+                # get() timing out (the chaos harness reads that as a
+                # hang).
+                return self._pack_error(spec, e)
             error = exc.TaskError(type(e).__name__, repr(e),
                                   traceback.format_exc())
             return self._pack_error(spec, error)

@@ -234,7 +234,7 @@ def collect_via_rpc(gcs_address: str, *, include_workers: bool = True,
 # flattening (the `ray-tpu state <component>` tables)
 # ---------------------------------------------------------------------------
 
-COMPONENTS = ("tasks", "actors", "objects", "leases", "transfers",
+COMPONENTS = ("serve", "tasks", "actors", "objects", "leases", "transfers",
               "collectives")
 
 
@@ -298,6 +298,28 @@ def flatten(snapshot: dict, component: str) -> list[dict]:
         elif component == "collectives":
             for g in proc.get("collectives") or []:
                 rows.append({"process": label, **g})
+        elif component == "serve":
+            # per-router admission rows: queue depth vs bound, shed and
+            # admitted totals (shed RATE comes from the metrics history;
+            # these are the live instantaneous truth)
+            for r in proc.get("routers") or []:
+                rows.append({
+                    "process": label, "kind": "router",
+                    "endpoint": r.get("endpoint"),
+                    "queued": r.get("queued"),
+                    "max_queued": r.get("max_queued"),
+                    "shed_total": r.get("shed_total"),
+                    "admitted_total": r.get("admitted_total"),
+                    "age_s": r.get("oldest_age_s"),
+                    "inflight": r.get("inflight_batches"),
+                })
+            comp = proc.get("component")
+            if isinstance(comp, dict) and comp.get("kind", "").startswith(
+                    "serve-"):
+                rows.append({"process": label,
+                             "kind": comp.get("kind"), **{
+                                 k: v for k, v in comp.items()
+                                 if k != "kind"}})
     rows.sort(key=lambda r: -float(r.get("age_s") or 0.0))
     return rows
 

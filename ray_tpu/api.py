@@ -212,9 +212,11 @@ def cluster_state(component: str | None = None,
     work: per-task stage with age, lease tables, transfer streams/pins,
     collective op phases, rpc conn depth, event-loop lag.
 
-    With `component` (one of tasks|actors|objects|leases|transfers|
-    collectives) returns flat rows across every process, sorted oldest
-    first; `filters={"field": substring}` narrows them. Unreachable
+    With `component` (one of serve|tasks|actors|objects|leases|
+    transfers|collectives) returns flat rows across every process,
+    sorted oldest first (`serve`: per-router queue depth vs admission
+    bound, shed/admitted totals, replica-group/controller state);
+    `filters={"field": substring}` narrows them. Unreachable
     components degrade to an {"error": ...} entry — asking a sick
     cluster what is wrong must never hang on the sick part."""
     from ray_tpu._private import debug_state

@@ -95,3 +95,45 @@ class RuntimeEnvSetupError(RayTpuError):
 
 class NodeDiedError(RayTpuError):
     """A node in the cluster was declared dead."""
+
+
+class ServeOverloadedError(RayTpuError):
+    """A Serve endpoint shed this request at admission: the router's
+    bounded queue was already at `max_queued_requests` depth. The typed
+    503 of the serving tier — callers should back off `retry_after_s`
+    and retry; the HTTP proxy maps it to 503 + Retry-After."""
+
+    def __init__(self, endpoint: str = "", queued: int = 0,
+                 max_queued: int = 0, retry_after_s: float = 1.0):
+        self.endpoint = endpoint
+        self.queued = queued
+        self.max_queued = max_queued
+        self.retry_after_s = retry_after_s
+        super().__init__(
+            f"endpoint {endpoint!r} overloaded: {queued} queued >= "
+            f"max_queued_requests={max_queued}; retry after "
+            f"{retry_after_s:.1f}s")
+
+    def __reduce__(self):
+        return (ServeOverloadedError,
+                (self.endpoint, self.queued, self.max_queued,
+                 self.retry_after_s))
+
+
+class ReplicaGroupDied(RayTpuError):
+    """A sharded Serve replica group lost a member (or its leader) while
+    this request was in flight. The whole gang is being restarted by the
+    controller; the request did NOT complete. Retryable once the gang is
+    back (the HTTP proxy maps it to 503)."""
+
+    def __init__(self, backend: str = "", group: str = "",
+                 reason: str = ""):
+        self.backend = backend
+        self.group = group
+        self.reason = reason
+        super().__init__(
+            f"replica group {group or '?'} of backend {backend!r} died "
+            f"mid-request: {reason or 'member lost'}")
+
+    def __reduce__(self):
+        return (ReplicaGroupDied, (self.backend, self.group, self.reason))

@@ -303,6 +303,8 @@ def main(seconds_per_case: float = 2.0) -> list[dict]:
 
     _state_ab(results)
 
+    _serve_mixed(results)
+
     ray_tpu.shutdown()
 
     _cross_node_bench(results)
@@ -761,6 +763,154 @@ def _serve_qps(results: list[dict]):
     serve.shutdown()
 
 
+def _serve_mixed(results: list[dict], window_s: float = 1.5,
+                 windows: int = 3):
+    """Mixed-traffic serve bench (ROADMAP item 1 acceptance): sustained
+    small-JSON + large (8MB octet-stream) bodies through the HTTP proxy
+    at 1x and 2x admission capacity, paired-interleaved windows. Large
+    bodies ride the zero-copy plane (plasma + bulk channel past the 1MB
+    threshold). Records per arm: qps (2xx only), client-side p99 of
+    SUCCESSFUL requests (what admitted traffic experiences), and the
+    shed rate (503 fraction). The tier-1 gate
+    (tests/test_serve_sharded.py::test_microbench_serve_mixed_gate)
+    asserts the recorded 2x row kept p99 bounded WITH nonzero typed
+    sheds — overload must degrade via 503s, not latency collapse.
+
+    Capacity arithmetic: 2 replicas x max_concurrent_queries=2 in
+    service + max_queued_requests=4 queue ~= 8 outstanding. 1x drives 7
+    closed-loop clients (6 small + 1 large, no sheds expected); 2x
+    drives 14 (12 small + 2 large, the excess MUST shed)."""
+    import http.client
+    import threading as _threading
+
+    import numpy as _np
+
+    from ray_tpu import serve
+
+    client = serve.start(http=True)
+    client.create_backend(
+        "mixed", lambda d=None: (len(d) if isinstance(d, (bytes,
+                                                          bytearray))
+                                 else "ok"),
+        config={"num_replicas": 2, "max_concurrent_queries": 2,
+                "max_batch_size": 4, "batch_wait_timeout": 0.001,
+                "max_queued_requests": 4,
+                "large_payload_threshold": 1 << 20})
+    client.create_endpoint("mixed", backend="mixed", route="/mixed",
+                           methods=["GET", "POST"])
+    port = client.http_port
+    big = _np.zeros(8 << 20, dtype=_np.uint8).tobytes()  # 8MB
+    tls = _threading.local()
+
+    def one_request(body):
+        conns = getattr(tls, "conns", None)
+        if conns is None:
+            conns = tls.conns = {}
+        conn = conns.get(port)
+        if conn is None:
+            conn = conns[port] = http.client.HTTPConnection(
+                "127.0.0.1", port)
+        t0 = time.perf_counter()
+        try:
+            if body is None:
+                conn.request("GET", "/mixed")
+            else:
+                conn.request("POST", "/mixed", body=body, headers={
+                    "Content-Type": "application/octet-stream"})
+            resp = conn.getresponse()
+            resp.read()
+            status = resp.status
+        except (http.client.HTTPException, OSError):
+            conns.pop(port, None)
+            raise
+        return status, time.perf_counter() - t0
+
+    def drive(n_small: int, n_large: int, seconds: float):
+        """One closed-loop window; returns (ok_lat, shed, errors, dt)."""
+        stop = time.perf_counter() + seconds
+        lock = _threading.Lock()
+        ok_lat: list[float] = []
+        counts = {"shed": 0, "other": 0}
+
+        def worker(body):
+            while time.perf_counter() < stop:
+                try:
+                    status, dt = one_request(body)
+                except (http.client.HTTPException, OSError):
+                    # dropped keep-alive conn: reconnect next loop —
+                    # a dead worker thread would silently shrink the
+                    # offered load mid-window
+                    with lock:
+                        counts["other"] += 1
+                    continue
+                with lock:
+                    if status == 200:
+                        ok_lat.append(dt)
+                    elif status == 503:
+                        counts["shed"] += 1
+                    else:
+                        counts["other"] += 1
+
+        threads = ([_threading.Thread(target=worker, args=(None,))
+                    for _ in range(n_small)]
+                   + [_threading.Thread(target=worker, args=(big,))
+                      for _ in range(n_large)])
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return ok_lat, counts["shed"], counts["other"], \
+            time.perf_counter() - t0
+
+    # warm the route + the zero-copy path (sleep on EVERY miss — a 404
+    # while the route table syncs returns without raising and must not
+    # hot-spin; a transient conn drop on the first 8MB body must not
+    # abort the whole suite)
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        try:
+            if one_request(None)[0] == 200:
+                break
+        except Exception:
+            pass
+        time.sleep(0.2)
+    for _ in range(10):
+        try:
+            one_request(big)
+            break
+        except Exception:
+            time.sleep(0.5)
+
+    arms = {"serve_mixed 1x": (6, 1), "serve_mixed 2x overload": (12, 2)}
+    acc = {name: {"lat": [], "shed": 0, "ok": 0, "other": 0, "dt": 0.0}
+           for name in arms}
+    for _ in range(windows):  # paired: load swings hit both arms
+        for name, (ns, nl) in arms.items():
+            lat, shed, other, dt = drive(ns, nl, window_s)
+            a = acc[name]
+            a["lat"].extend(lat)
+            a["shed"] += shed
+            a["ok"] += len(lat)
+            a["other"] += other
+            a["dt"] += dt
+    for name, a in acc.items():
+        total = a["ok"] + a["shed"] + a["other"]
+        qps = a["ok"] / a["dt"] if a["dt"] else 0.0
+        p99_ms = (float(_np.percentile(a["lat"], 99)) * 1000.0
+                  if a["lat"] else 0.0)
+        shed_rate = a["shed"] / total if total else 0.0
+        row = {"name": name, "per_second": round(qps, 2),
+               "p99_ms": round(p99_ms, 1),
+               "shed_rate": round(shed_rate, 4),
+               "ok": a["ok"], "shed": a["shed"], "other": a["other"],
+               "windows": windows, "window_s": window_s}
+        results.append(row)
+        print(f"{name}: {qps:.1f} qps ok, p99 {p99_ms:.0f}ms, "
+              f"shed rate {shed_rate:.1%} ({a['shed']}/{total})")
+    serve.shutdown()
+
+
 def _tracing_ab(results: list[dict]):
     """Distributed-tracing overhead A/B (the tier-1 microbench gate in
     test_observability reads these rows): tracing at the DEFAULT head
@@ -960,8 +1110,51 @@ if __name__ == "__main__":
                         help="also print one JSON line with all results")
     parser.add_argument("--out", default=None,
                         help="write results JSON to this path")
+    parser.add_argument("--only", default=None,
+                        help="run a single bench group (e.g. serve_mixed)"
+                             " instead of the full suite; always includes"
+                             " the same-window calibration controls")
+    parser.add_argument("--merge", default=None,
+                        help="merge this run's rows into an existing "
+                             "results JSON (same-name rows replaced, new"
+                             " ones appended) — for recording one new "
+                             "bench without a full-suite rerun")
     args = parser.parse_args()
-    doc = {"metadata": _metadata(), "results": main()}
+    if args.only:
+        groups = {"serve_mixed": _serve_mixed, "serve": _serve_qps,
+                  "tracing": _tracing_ab, "state": _state_ab,
+                  "collective": _collective_bench}
+        if args.only not in groups:
+            parser.error(f"--only must be one of {sorted(groups)}")
+        results: list = []
+        calibrate(results)
+        ray_tpu.init()
+        try:
+            groups[args.only](results)
+        finally:
+            ray_tpu.shutdown()
+    else:
+        results = main()
+    doc = {"metadata": _metadata(), "results": results}
+    if args.merge:
+        with open(args.merge) as f:
+            base = json.load(f)
+        rows = {r["name"]: r for r in results}
+        # the base file's calibration rows contextualize ITS rows; this
+        # partial window's calibration travels with the partial-run
+        # metadata instead of overwriting them
+        calib = {n: rows.pop(n) for n in list(rows)
+                 if n.startswith("calibration")}
+        merged = [rows.pop(r["name"], r) for r in base["results"]]
+        merged.extend(rows.values())
+        base["results"] = merged
+        base.setdefault("metadata", {})
+        base["metadata"]["last_partial_run"] = {
+            "only": args.only, "calibration": list(calib.values()),
+            **_metadata()}
+        doc = base
+        with open(args.merge, "w") as f:
+            json.dump(doc, f, indent=1)
     if args.json:
         print(json.dumps(doc))
     if args.out:

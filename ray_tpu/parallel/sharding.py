@@ -62,6 +62,42 @@ def tree_shardings(mesh: Mesh, logical_tree: Any,
     )
 
 
+def shard_bounds(dim: int, rank: int, num_shards: int) -> tuple[int, int]:
+    """Contiguous [lo, hi) range of a dimension owned by `rank` when the
+    dimension is split over `num_shards` Megatron-style. Uneven splits
+    spread the remainder over the FIRST shards (every rank still gets a
+    non-degenerate slice as long as dim >= num_shards)."""
+    if not 0 <= rank < num_shards:
+        raise ValueError(f"rank {rank} outside [0, {num_shards})")
+    if dim < num_shards:
+        raise ValueError(
+            f"cannot split dimension {dim} over {num_shards} shards")
+    base, rem = divmod(dim, num_shards)
+    lo = rank * base + min(rank, rem)
+    hi = lo + base + (1 if rank < rem else 0)
+    return lo, hi
+
+
+def column_shard(w, rank: int, num_shards: int):
+    """This rank's slice of a COLUMN-parallel weight (SNIPPETS [3]
+    ColumnParallelLinear: output features sharded, logical axes
+    ("embed", "mlp") -> P(None, "model")): w[..., lo:hi] of the LAST
+    axis. The activation after x @ w_col is already shard-local, so no
+    communication follows it."""
+    lo, hi = shard_bounds(w.shape[-1], rank, num_shards)
+    return w[..., lo:hi]
+
+
+def row_shard(w, rank: int, num_shards: int):
+    """This rank's slice of a ROW-parallel weight (SNIPPETS [3]
+    RowParallelLinear: input features sharded, logical axes
+    ("mlp", "embed") -> P("model", None)): w[lo:hi] of the FIRST axis.
+    The per-shard output is a PARTIAL sum — callers allreduce(SUM) it
+    across the shard group to recover the full matmul."""
+    lo, hi = shard_bounds(w.shape[0], rank, num_shards)
+    return w[lo:hi]
+
+
 def infer_param_logical_axes(params: Any) -> Any:
     """Heuristic logical axes for unannotated param trees: last axis of a
     kernel is its output features. Used when a model doesn't carry

@@ -409,8 +409,8 @@ def cmd_state(args) -> int:
     """Live cluster introspection (`ray-tpu state [component]`): every
     process's debug_state() aggregated over the rpc plane — no driver
     runtime needed. Without a component: a per-process summary; with
-    one (tasks|actors|objects|leases|transfers|collectives): flat rows
-    across the cluster, oldest first."""
+    one (serve|tasks|actors|objects|leases|transfers|collectives): flat
+    rows across the cluster, oldest first."""
     addr = _gcs_address(args)
     if not addr:
         print("no cluster found", file=sys.stderr)
@@ -799,10 +799,12 @@ def main(argv=None) -> int:
                        help="live cluster introspection (debug_state "
                             "of every process)")
     p.add_argument("component", nargs="?", default=None,
-                   choices=["tasks", "actors", "objects", "leases",
-                            "transfers", "collectives"],
+                   choices=["serve", "tasks", "actors", "objects",
+                            "leases", "transfers", "collectives"],
                    help="flat rows for one component class "
-                        "(omit for a per-process summary)")
+                        "(omit for a per-process summary; `serve` shows "
+                        "per-router queue depth vs bound + shed/admitted "
+                        "totals and replica-group state)")
     p.add_argument("--address", default=None)
     p.add_argument("--filter", default=None,
                    help="only rows containing this substring")

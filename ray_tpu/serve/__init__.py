@@ -1,17 +1,27 @@
 """ray_tpu.serve — actor-based model serving with dynamic micro-batching
 (the Serve equivalent; reference: python/ray/serve/). On TPU the batch is
 what fills the MXU: the router groups queries to max_batch_size before one
-replica RPC."""
+replica RPC. Production tier (ROADMAP item 1): bounded admission queues
+with typed load shedding, zero-copy large payloads over plasma + the bulk
+channel, and sharded replica GROUPS whose forward pass is collective-
+backed (serve/replica_group.py)."""
 
+from ray_tpu.exceptions import ReplicaGroupDied, ServeOverloadedError
 from ray_tpu.serve.api import Client, connect, shutdown, start
 from ray_tpu.serve.config import BackendConfig
+from ray_tpu.serve.payload import LargePayload
 from ray_tpu.serve.replica import accept_batch
+from ray_tpu.serve.replica_group import ShardedMLP
 from ray_tpu.serve.router import ServeHandle
 
 __all__ = [
     "BackendConfig",
     "Client",
+    "LargePayload",
+    "ReplicaGroupDied",
     "ServeHandle",
+    "ServeOverloadedError",
+    "ShardedMLP",
     "accept_batch",
     "connect",
     "shutdown",

@@ -1,6 +1,8 @@
 """Backend configuration (reference: python/ray/serve/config.py
 BackendConfig — num_replicas, max_batch_size, batch_wait_timeout,
-max_concurrent_queries)."""
+max_concurrent_queries; extended here with the production-tier knobs:
+bounded admission queues, zero-copy payload cutover, and sharded
+replica groups)."""
 
 from __future__ import annotations
 
@@ -30,6 +32,28 @@ class BackendConfig:
     max_concurrent_queries: int = 8       # in-flight cap per replica
     user_config: dict | None = None
     autoscaling: dict | None = None       # AutoscalingConfig.to_dict()
+    # -- admission control (load shedding / backpressure) ---------------
+    # Bounded router queue per endpoint: queries arriving when `queued
+    # >= max_queued_requests` are refused with a typed
+    # ServeOverloadedError (HTTP 503 + Retry-After) instead of growing
+    # an unbounded backlog whose latency collapses under overload.
+    # None = unbounded (legacy behavior).
+    max_queued_requests: int | None = None
+    # Hint callers receive with a shed (Retry-After seconds).
+    overload_retry_after_s: float = 1.0
+    # -- zero-copy payloads ---------------------------------------------
+    # Request/response bodies at or over this many bytes ride plasma +
+    # the bulk channel as ObjectRefs instead of being pickled through
+    # the router. 0/None = always pickle (legacy behavior).
+    large_payload_threshold: int = 1 << 20
+    # -- sharded replica groups -----------------------------------------
+    # num_shards > 1 turns each replica into a GANG of num_shards
+    # member actors holding a Megatron-partitioned model; the forward
+    # pass is collective-backed (see serve/replica_group.py).
+    num_shards: int = 1
+    shard_group_timeout_s: float = 10.0   # collective op deadline
+    shard_transport: str = "auto"         # pin shm/ring/device, or auto
+    num_cpus_per_shard: float = 0.001     # gang bundle reservation size
 
     def __post_init__(self):
         if self.num_replicas < 0:
@@ -38,6 +62,13 @@ class BackendConfig:
             raise ValueError("max_batch_size must be >= 1")
         if self.max_concurrent_queries < 1:
             raise ValueError("max_concurrent_queries must be >= 1")
+        if self.max_queued_requests is not None \
+                and self.max_queued_requests < 1:
+            raise ValueError("max_queued_requests must be >= 1 (or None)")
+        if self.num_shards < 1:
+            raise ValueError("num_shards must be >= 1")
+        if self.num_shards > 1 and self.shard_group_timeout_s <= 0:
+            raise ValueError("shard_group_timeout_s must be > 0")
         if isinstance(self.autoscaling, AutoscalingConfig):
             self.autoscaling = self.autoscaling.to_dict()
 

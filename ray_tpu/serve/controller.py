@@ -17,7 +17,10 @@ import time
 
 import ray_tpu
 from ray_tpu.serve.config import BackendConfig
+from ray_tpu.serve.metrics import M_GROUP_RESTARTS_TOTAL
 from ray_tpu.serve.replica import Replica
+from ray_tpu.serve.replica_group import (kill_replica_group,
+                                         spawn_replica_group)
 
 
 class ServeController:
@@ -41,6 +44,7 @@ class ServeController:
         self.version = 0
         # endpoint -> (latest reported router queue length, monotonic ts)
         self._queue_lens: dict[str, tuple[float, float]] = {}
+        self._gang_restarts = 0
         self._last_downscale_ok: dict[str, float] = {}
         self._last_autoscale = 0.0
         # serializes tick-thread autoscaling against report-triggered
@@ -58,7 +62,9 @@ class ServeController:
         """The control-loop clock (reference: controller.py run_control_loop):
         without it, _maybe_autoscale only ran when router traffic reports
         arrived, so an idle deployment never scaled down to min_replicas
-        and a handle-only deployment never autoscaled at all."""
+        and a handle-only deployment never autoscaled at all. The same
+        tick drives replica-GROUP health: a gang with any DEAD member is
+        torn down and respawned whole (gang restart)."""
         import logging
 
         logger = logging.getLogger("ray_tpu.serve.controller")
@@ -68,6 +74,10 @@ class ServeController:
                 self._maybe_autoscale()
             except Exception:
                 logger.exception("autoscale tick failed")
+            try:
+                self._check_gangs()
+            except Exception:
+                logger.exception("gang health tick failed")
 
     def stop(self):
         """Stop the autoscale tick thread (called by Client.shutdown
@@ -84,9 +94,17 @@ class ServeController:
         return {
             "kind": "serve-controller",
             "version": self.version,
+            "gang_restarts": self._gang_restarts,
             "backends": {
                 name: {"replicas": len(rec["replicas"]),
                        "target": rec["config"].get("num_replicas"),
+                       "num_shards": rec["config"].get("num_shards", 1),
+                       "gangs": [
+                           {"gang_id": g["gang_id"],
+                            "group": g["group_name"],
+                            "age_s": round(time.time() - g["spawned_at"],
+                                           1)}
+                           for g in rec.get("gangs") or []],
                        "autoscaling":
                            bool(rec["config"].get("autoscaling"))}
                 for name, rec in list(self.backends.items())},
@@ -134,7 +152,24 @@ class ServeController:
                 "init_args": init_args,
                 "replicas": [],
             }
-            self._reconcile(name)
+            try:
+                self._reconcile(name)
+            except BaseException:
+                # failed bootstrap (e.g. a gang whose callable has no
+                # shard protocol, or an unplaceable reservation) must
+                # not leave a half-registered backend behind — NOR the
+                # gangs/replicas reconcile already spawned before the
+                # failing one (they'd be untracked and leak forever)
+                rec = self.backends.pop(name, None)
+                if rec is not None:
+                    for gang in rec.get("gangs") or []:
+                        kill_replica_group(gang)
+                    for handle in rec.get("replicas") or []:
+                        try:
+                            ray_tpu.kill(handle)
+                        except Exception:
+                            pass
+                raise
         self.version += 1
         self._notify_change()
         return True
@@ -154,11 +189,15 @@ class ServeController:
             rec = self.backends.pop(name, None)
             if rec is None:
                 return False
-            for handle in rec["replicas"]:
-                try:
-                    ray_tpu.kill(handle)
-                except Exception:
-                    pass
+            if rec.get("gangs"):
+                for gang in rec["gangs"]:
+                    kill_replica_group(gang)
+            else:
+                for handle in rec["replicas"]:
+                    try:
+                        ray_tpu.kill(handle)
+                    except Exception:
+                        pass
         self.version += 1
         self._notify_change()
         return True
@@ -166,10 +205,19 @@ class ServeController:
     def update_backend_config(self, name: str, config: dict):
         with self._autoscale_lock:
             rec = self._backend(name)
+            old_shards = rec["config"].get("num_shards", 1)
             merged = {**rec["config"], **config}
-            rec["config"] = BackendConfig.from_dict(merged).to_dict()
+            merged_cfg = BackendConfig.from_dict(merged).to_dict()
+            if merged_cfg.get("num_shards", 1) != old_shards:
+                raise ValueError(
+                    f"num_shards of a live backend cannot change "
+                    f"({old_shards} -> {merged_cfg.get('num_shards')}); "
+                    f"deploy a new backend and shift traffic instead")
+            rec["config"] = merged_cfg
             self._reconcile(name)
-            replicas = list(rec["replicas"])
+            # gangs: reconfigure reaches every member, not just leaders
+            replicas = ([m for g in rec["gangs"] for m in g["members"]]
+                        if rec.get("gangs") else list(rec["replicas"]))
         if rec["config"].get("user_config") is not None:
             # reconfigure outside the lock: a 60s replica get must not
             # stall the autoscale tick
@@ -195,17 +243,164 @@ class ServeController:
         rec = self._backend(name)
         want = rec["config"]["num_replicas"]
         replicas = rec["replicas"]
+        if rec["config"].get("num_shards", 1) > 1:
+            # Sharded backend: each "replica" is a GANG; rec["gangs"][i]
+            # is the gang whose leader is rec["replicas"][i].
+            gangs = rec.setdefault("gangs", [])
+            while len(gangs) < want:
+                gang = spawn_replica_group(
+                    name, rec["pickled"], rec["init_args"], rec["config"])
+                gangs.append(gang)
+                replicas.append(gang["leader"])
+            while len(gangs) > want:
+                gang = gangs.pop()
+                replicas.pop()
+                kill_replica_group(gang)
+            return
         replica_cls = ray_tpu.remote(Replica)
         while len(replicas) < want:
             replicas.append(replica_cls.remote(
                 rec["pickled"], rec["init_args"],
-                rec["config"].get("user_config")))
+                rec["config"].get("user_config"),
+                rec["config"].get("large_payload_threshold") or 0))
         while len(replicas) > want:
             handle = replicas.pop()
             try:
                 ray_tpu.kill(handle)
             except Exception:
                 pass
+
+    # -- replica-group (gang) health -------------------------------------
+
+    def _check_gangs(self):
+        """One health pass: any gang member DEAD in the GCS actor table
+        => gang-restart the whole group (kill survivors, fresh pg-backed
+        gang + collective group, swap the leader handle, bump version so
+        routers cut over). In-flight requests against the old gang get
+        typed ReplicaGroupDied (leader alive: starved allreduce; leader
+        dead: ActorDiedError mapped by the router).
+
+        Locking: ONLY the gang-table reads/mutations hold
+        _autoscale_lock. The liveness RPCs and the (possibly tens of
+        seconds) respawn run outside it — a stuck placement must not
+        freeze create/delete/update_backend, the autoscaler, or the
+        routers' 30s controller gets (same rule as the reconfigure path
+        above)."""
+        from ray_tpu._private import global_state
+
+        cw = global_state.get_core_worker()
+        if cw is None:
+            return
+        now = time.monotonic()
+        with self._autoscale_lock:
+            candidates = [
+                (name, rec, gang)
+                for name, rec in list(self.backends.items())
+                for gang in (rec.get("gangs") or [])
+                if not gang.get("restarting")
+                and gang.get("restart_backoff_until", 0.0) <= now]
+        for name, rec, gang in candidates:
+            if not self._gang_is_dead(cw, gang):
+                continue
+            with self._autoscale_lock:
+                gangs = rec.get("gangs") or []
+                if (self.backends.get(name) is not rec
+                        or gang not in gangs or gang.get("restarting")):
+                    continue  # deleted/resized under us
+                gang["restarting"] = True
+                i = gangs.index(gang)
+            self._restart_gang(name, rec, i, gang)
+
+    @staticmethod
+    def _gang_is_dead(cw, gang: dict) -> bool:
+        for member in gang["members"]:
+            try:
+                info = cw.get_actor_info(member._actor_id.binary())
+            except Exception:
+                return False  # GCS unreachable: don't thrash
+            if info is None or info.get("state") == "DEAD":
+                return True
+        return False
+
+    def _restart_gang(self, name: str, rec: dict, i: int, gang: dict):
+        """Drain-then-kill gang restart (called WITHOUT the autoscale
+        lock; `gang["restarting"]` was claimed under it). Followers die
+        NOW (no caller ever dispatches to them); the LEADER is left
+        alive long enough for its in-flight collective forwards to
+        starve into typed ReplicaGroupDied within the group timeout —
+        killing it immediately would downgrade every in-flight caller's
+        error to a bare ActorDiedError. A timer reaps the drained leader
+        (and the old gang's reservation) after the timeout + grace; the
+        fresh gang takes over the routing slot once it spawns. A failed
+        respawn (cluster temporarily short on resources) leaves the
+        slot's dead gang in place — callers keep getting typed errors —
+        and retries with backoff WITHOUT re-draining or re-counting."""
+        import logging
+        import threading
+
+        import ray_tpu as _rt
+        from ray_tpu.util.placement_group import remove_placement_group
+
+        logger = logging.getLogger("ray_tpu.serve.controller")
+        if not gang.get("drain_started"):
+            # one-shot side effects, however many respawn retries follow
+            gang["drain_started"] = True
+            logger.warning(
+                "backend %r gang %s lost a member; gang-restarting",
+                name, gang["gang_id"])
+            for member in gang["members"][1:]:
+                try:
+                    _rt.kill(member)
+                except Exception:
+                    pass
+            leader, pg = gang["leader"], gang["pg"]
+            grace = float(rec["config"].get("shard_group_timeout_s")
+                          or 10.0) + 2.0
+
+            def _reap():
+                try:
+                    _rt.kill(leader)
+                except Exception:
+                    pass
+                try:
+                    remove_placement_group(pg)
+                except Exception:
+                    pass
+
+            timer = threading.Timer(grace, _reap)
+            timer.daemon = True
+            timer.start()
+        try:
+            fresh = spawn_replica_group(name, rec["pickled"],
+                                        rec["init_args"], rec["config"])
+        except BaseException:
+            logger.exception(
+                "backend %r gang %s respawn failed; retrying with "
+                "backoff", name, gang["gang_id"])
+            gang["restart_backoff_until"] = time.monotonic() + 5.0
+            gang["restarting"] = False
+            return
+        with self._autoscale_lock:
+            gangs = rec.get("gangs") or []
+            if (self.backends.get(name) is not rec
+                    or i >= len(gangs) or gangs[i] is not gang):
+                # backend deleted or resized mid-respawn: the slot is
+                # gone — don't leak the fresh gang into nowhere
+                kill_replica_group(fresh)
+                return
+            gangs[i] = fresh
+            rec["replicas"][i] = fresh["leader"]
+            self._gang_restarts += 1
+        M_GROUP_RESTARTS_TOTAL.inc()
+        self.version += 1
+        self._notify_change()
+
+    def get_gang_members(self, name: str) -> list:
+        """Member handles of every gang of a sharded backend (ordered
+        rank 0..N-1 per gang) — the test/chaos surface for arming
+        member-local failpoints and picking victims."""
+        rec = self._backend(name)
+        return [list(g["members"]) for g in rec.get("gangs") or []]
 
     # -- endpoints -------------------------------------------------------
 

@@ -13,10 +13,37 @@ import time
 
 from ray_tpu._private import stats as _stats
 from ray_tpu._private import tracing
+from ray_tpu.serve import payload as _payload
 
 M_HTTP_E2E_S = _stats.Histogram(
     "serve.http_e2e_s", _stats.LATENCY_BOUNDARIES_S,
     "HTTP request arrival -> response sent (proxy side)")
+
+
+def _error_response(e: BaseException):
+    """Map typed internal errors to honest status codes (the production
+    contract: overload and infrastructure loss are RETRYABLE 503s with a
+    hint, user exceptions are 500s — a blanket 500 made clients retry
+    bugs and give up on sheds). Returns (status, headers, body_dict)."""
+    from ray_tpu import exceptions as exc
+
+    if isinstance(e, exc.ServeOverloadedError):
+        return 503, {"Retry-After": f"{max(e.retry_after_s, 0.1):.010g}"}, {
+            "error": str(e), "type": "ServeOverloadedError",
+            "retry_after_s": e.retry_after_s}
+    if isinstance(e, exc.ReplicaGroupDied):
+        # gang restart in progress: retryable once the controller
+        # respawns the group
+        return 503, {"Retry-After": "1"}, {
+            "error": str(e), "type": "ReplicaGroupDied"}
+    if isinstance(e, exc.ObjectLostError):
+        # a zero-copy payload's producer died with the only copy
+        return 503, {"Retry-After": "1"}, {
+            "error": str(e), "type": "ObjectLostError"}
+    if isinstance(e, exc.TaskError):
+        return 500, {}, {"error": str(e), "type": "TaskError",
+                         "cause": e.cause_cls_name}
+    return 500, {}, {"error": str(e), "type": type(e).__name__}
 
 
 class HTTPProxy:
@@ -31,6 +58,7 @@ class HTTPProxy:
         self._legacy_path = legacy_path
         self._routers: dict[str, object] = {}
         self._routes: dict[str, dict] = {}
+        self._thresholds: dict[str, int] = {}
         self._state_lock = threading.Lock()
         self._version = -1
         self._host = host
@@ -77,8 +105,18 @@ class HTTPProxy:
             if snap is None:
                 self._synced.set()  # controller alive, nothing changed
                 continue
+            # per-endpoint zero-copy cutover, read from the primary
+            # backend's config (same snapshot the routes came from)
+            thresholds = {}
+            for name, ep_state in (snap.get("endpoints") or {}).items():
+                cfg = (ep_state.get("backends", {})
+                       .get(ep_state.get("backend"), {})
+                       .get("config") or {})
+                thresholds[name] = int(
+                    cfg.get("large_payload_threshold") or 0)
             with self._state_lock:
                 self._routes = dict(snap["routes"])
+                self._thresholds = thresholds
                 self._version = snap["version"]
             self._synced.set()
 
@@ -113,12 +151,30 @@ class HTTPProxy:
                     {"error": f"method {request.method} not allowed"},
                     status=405)
             body = (await request.read()) if request.body_exists else None
-            try:
-                data = json.loads(body) if body else None
-            except json.JSONDecodeError:
-                return web.json_response({"error": "invalid JSON"},
-                                         status=400)
             endpoint = route["endpoint"]
+            ctype = request.headers.get("Content-Type", "")
+            if body is not None and ctype.startswith(
+                    "application/octet-stream"):
+                # binary body (tensor payloads): pass raw bytes through;
+                # at/over the endpoint's threshold they ride plasma +
+                # the bulk channel as a LargePayload ref instead of
+                # being pickled through the router. The plasma put is a
+                # blocking copy — off the event loop (like the response
+                # unwrap below), or one 512MB body stalls every
+                # concurrent small request on this proxy.
+                threshold = self._thresholds.get(endpoint) or 0
+                if threshold and len(body) >= threshold:
+                    data = await asyncio.get_running_loop() \
+                        .run_in_executor(None, _payload.wrap, body,
+                                         threshold)
+                else:
+                    data = body
+            else:
+                try:
+                    data = json.loads(body) if body else None
+                except json.JSONDecodeError:
+                    return web.json_response({"error": "invalid JSON"},
+                                             status=400)
             # lock-free hot path: dict reads are GIL-atomic; the locked
             # creator runs only on the first request per endpoint
             router = self._routers.get(endpoint)
@@ -138,9 +194,21 @@ class HTTPProxy:
                         asyncio.wrap_future(ref.future()), 60)
                 else:
                     result = await router.call_async(data, timeout=60.0)
+                if isinstance(result, _payload.LargePayload):
+                    # zero-copy response: resolve the plasma ref off the
+                    # event loop (first touch may pull over the bulk
+                    # channel) and answer binary
+                    result = await asyncio.get_running_loop() \
+                        .run_in_executor(None, _payload.unwrap, result)
+                if isinstance(result, (bytes, bytearray, memoryview)):
+                    return web.Response(
+                        body=bytes(result),
+                        content_type="application/octet-stream")
                 return web.json_response({"result": result})
             except Exception as e:
-                return web.json_response({"error": str(e)}, status=500)
+                status, headers, payload_doc = _error_response(e)
+                return web.json_response(payload_doc, status=status,
+                                         headers=headers)
             finally:
                 end = time.time()
                 M_HTTP_E2E_S.observe(end - t0)
@@ -150,7 +218,10 @@ class HTTPProxy:
                                         {"name": request.path})
 
         async def run():
-            app = web.Application()
+            # client_max_size: large tensor bodies are a first-class
+            # workload (they ride plasma past the threshold); aiohttp's
+            # 1MB default would 413 them at the door
+            app = web.Application(client_max_size=1 << 30)
             app.router.add_route("*", "/{tail:.*}", handler)
             runner = web.AppRunner(app)
             await runner.setup()

@@ -1,0 +1,36 @@
+"""Serve production-tier counters (admission control, replica groups,
+zero-copy payload plane). Registered in whichever process hosts the
+component (proxy/driver routers, the controller actor's worker, replica
+workers); they flow into the PR 6 metrics history via the normal
+worker/driver stats push, so shed RATE and restart counts are graphable
+from `ray-tpu top` / `cluster_metrics(history=N)` without touching any
+hot path."""
+
+from __future__ import annotations
+
+from ray_tpu._private import stats as _stats
+
+M_SHED_TOTAL = _stats.Count(
+    "serve.shed_total",
+    "requests refused at router admission (queue depth >= "
+    "max_queued_requests) with a typed ServeOverloadedError / HTTP 503")
+
+M_ADMITTED_TOTAL = _stats.Count(
+    "serve.admitted_total",
+    "requests accepted into a bounded router queue (pairs with "
+    "serve.shed_total: shed rate = shed / (shed + admitted))")
+
+M_ROUTER_QUEUED = _stats.Gauge(
+    "serve.router_queued",
+    "live queued queries across this process's routers (the admission "
+    "gauge shed/cancel paths must keep honest)")
+
+M_GROUP_RESTARTS_TOTAL = _stats.Count(
+    "serve.group_restarts_total",
+    "sharded replica-group gang restarts (any member death restarts the "
+    "whole gang)")
+
+M_ZERO_COPY_BYTES_TOTAL = _stats.Count(
+    "serve.zero_copy_bytes_total",
+    "request/response body bytes that rode plasma + the bulk channel as "
+    "ObjectRefs instead of being pickled through the router")

@@ -33,7 +33,9 @@ class Replica:
     """Hosts one copy of the user's callable."""
 
     def __init__(self, pickled_callable: bytes, init_args: tuple,
-                 user_config: dict | None):
+                 user_config: dict | None,
+                 large_payload_threshold: int = 0):
+        self._threshold = large_payload_threshold
         target = cloudpickle.loads(pickled_callable)
         if inspect.isclass(target):
             self._callable = target(*init_args)
@@ -59,7 +61,18 @@ class Replica:
 
     def handle_batch(self, requests: list):
         """One RPC per batch; returns per-request results (the runtime
-        splits them into the callers' ObjectRefs via num_returns)."""
+        splits them into the callers' ObjectRefs via num_returns).
+        Zero-copy plane: LargePayload markers resolve here (the bytes
+        rode plasma + the bulk channel, not the router), and results at
+        or over the threshold ride plasma back the same way."""
+        from ray_tpu.serve import payload as _payload
+
+        # wrap responses only for callers speaking the zero-copy
+        # protocol (the HTTP proxy): a plain handle.remote() caller gets
+        # values, never markers
+        wrap_back = [isinstance(r, _payload.LargePayload)
+                     for r in requests]
+        requests = [_payload.unwrap(r) for r in requests]
         start = time.time()
         try:
             if self._accept_batch:
@@ -74,6 +87,9 @@ class Replica:
             M_REPLICA_EXEC_S.observe(time.time() - start)
             self._batches_handled += 1
             self._last_batch_at = time.time()
+        if self._threshold:
+            out = [_payload.wrap(r, self._threshold) if w else r
+                   for r, w in zip(out, wrap_back)]
         return tuple(out) if len(out) > 1 else out[0]
 
     def ping(self):

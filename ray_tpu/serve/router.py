@@ -19,6 +19,8 @@ import weakref
 
 from ray_tpu._private import stats as _stats
 from ray_tpu._private import tracing
+from ray_tpu.serve.metrics import (M_ADMITTED_TOTAL, M_ROUTER_QUEUED,
+                                   M_SHED_TOTAL)
 
 M_ROUTER_QUEUE_S = _stats.Histogram(
     "serve.router_queue_s", _stats.LATENCY_BOUNDARIES_S,
@@ -100,6 +102,8 @@ class Router:
         self._inflight: dict[bytes, int] = {}   # actor_id -> live batches
         self._state = None
         self._state_time = 0.0
+        self._shed_total = 0
+        self._admitted_total = 0
         self._closed = False
         self._wake = threading.Event()
         self._refresh()
@@ -118,9 +122,13 @@ class Router:
             queue = list(self._queue)
             inflight = {aid.hex()[:16]: n
                         for aid, n in self._inflight.items() if n}
+        maxq, _ = self._admission()
         return {
             "endpoint": self._endpoint,
             "queued": len(queue),
+            "max_queued": maxq or 0,
+            "shed_total": self._shed_total,
+            "admitted_total": self._admitted_total,
             "oldest_age_s": (round(max(now - q.t_enqueue
                                        for q in queue), 3)
                              if queue else 0.0),
@@ -170,15 +178,59 @@ class Router:
                 self._state = st
                 self._wake.set()
 
+    # -- admission control (load shedding / backpressure) ----------------
+
+    def _admission(self) -> tuple[int | None, float]:
+        """(max_queued_requests, retry_after_s) for this endpoint, read
+        from the primary backend's config in the current routing state
+        (None = unbounded)."""
+        state = self._state
+        if not state:
+            return None, 1.0
+        cfg = (state.get("backends", {})
+               .get(state.get("backend"), {})
+               .get("config"))
+        if not cfg:
+            return None, 1.0
+        return (cfg.get("max_queued_requests"),
+                float(cfg.get("overload_retry_after_s") or 1.0))
+
+    def _admit(self, q: _PendingQuery) -> None:
+        """Append under the bounded queue or raise the typed shed error.
+        All bookkeeping the shed/cancel paths must keep honest lives
+        here and in _abandon/_take_batch: the live-queue gauge moves
+        with every append/remove, and a shed never touches any ref or
+        memstore state (nothing was created for it)."""
+        from ray_tpu import exceptions as exc
+
+        maxq, retry_after = self._admission()
+        with self._lock:
+            if self._closed:
+                raise RuntimeError(
+                    f"router for {self._endpoint!r} is closed")
+            depth = len(self._queue)
+            if maxq is not None and depth >= maxq:
+                self._shed_total += 1
+                shed = exc.ServeOverloadedError(
+                    self._endpoint, depth, maxq, retry_after)
+            else:
+                self._queue.append(q)
+                self._admitted_total += 1
+                shed = None
+        if shed is not None:
+            M_SHED_TOTAL.inc()
+            raise shed
+        M_ADMITTED_TOTAL.inc()
+        M_ROUTER_QUEUED.add(1)
+        self._wake.set()
+
     # -- client surface --------------------------------------------------
 
     def assign(self, data, timeout: float = 30.0):
         """Enqueue one query; block until its batch is dispatched; return
         the caller's ObjectRef slice of the batched call."""
         q = _PendingQuery(data)
-        with self._lock:
-            self._queue.append(q)
-        self._wake.set()
+        self._admit(q)
         if not q.event.wait(timeout):
             # Nobody will consume the result — withdraw the query so it
             # doesn't burn a replica slot after we've given up on it.
@@ -199,9 +251,7 @@ class Router:
         q = _PendingQuery(data)
         q.loop = asyncio.get_running_loop()
         q.future = q.loop.create_future()
-        with self._lock:
-            self._queue.append(q)
-        self._wake.set()
+        self._admit(q)
         try:
             return await asyncio.wait_for(asyncio.shield(q.future),
                                           timeout)
@@ -225,9 +275,7 @@ class Router:
         q.loop = asyncio.get_running_loop()
         q.future = q.loop.create_future()
         q.want_result = True
-        with self._lock:
-            self._queue.append(q)
-        self._wake.set()
+        self._admit(q)
         try:
             return await asyncio.wait_for(asyncio.shield(q.future), timeout)
         except asyncio.TimeoutError:
@@ -242,13 +290,34 @@ class Router:
             raise
 
     def _abandon(self, q: _PendingQuery):
+        """Caller gave up (timeout / client disconnect). While still
+        queued the query is withdrawn outright — queue gauge reclaimed,
+        no refs were ever created for it. Once dispatched, the abandoned
+        flag makes the completion path drop the result and free the
+        router-owned ref instead of parking it on a dead future."""
         with self._lock:
             q.abandoned = True
-            if q in self._queue:
+            dequeued = q in self._queue
+            if dequeued:
                 self._queue.remove(q)
+        if dequeued:
+            M_ROUTER_QUEUED.add(-1)
 
     def close(self):
-        self._closed = True
+        with self._lock:
+            self._closed = True
+            stranded = list(self._queue)
+            self._queue.clear()
+        if stranded:
+            # a closed router must not strand queued callers until their
+            # timeout: error them now and give the gauge back
+            M_ROUTER_QUEUED.add(-len(stranded))
+            err = RuntimeError(
+                f"router for {self._endpoint!r} closed while the query "
+                f"was queued")
+            for q in stranded:
+                q.error = err
+                q._notify()
         self._wake.set()
 
     # -- flusher ---------------------------------------------------------
@@ -343,22 +412,41 @@ class Router:
             # batch sized by the backend that will actually serve it
             max_bs = cfg["max_batch_size"] or 1
             with self._lock:
+                taken = min(max_bs, len(self._queue))
                 batch = [q for q in self._queue[:max_bs]
                          if not q.abandoned]
                 del self._queue[:max_bs]
+            if taken:
+                M_ROUTER_QUEUED.add(-taken)
             if not batch:
                 continue
-            self._dispatch(replica, batch)
+            self._dispatch(replica, batch, cfg=cfg)
             # shadow traffic: mirror the batch, results dropped
             # (reference: serve/api.py shadow_traffic)
             for sb, prop in (state.get("shadow") or {}).items():
                 if random.random() < prop:
                     sreplica = self._pick_replica(state, sb)
                     if sreplica is not None:
-                        self._dispatch(sreplica, batch, shadow=True)
+                        self._dispatch(sreplica, batch, shadow=True,
+                                       cfg=state["backends"][sb]["config"])
+
+    def _map_group_error(self, e, cfg):
+        """Sharded backends: a dead group LEADER surfaces to callers as
+        the typed ReplicaGroupDied (member deaths are typed by the
+        leader itself; leader death is an actor error only the router
+        can attribute to the gang)."""
+        from ray_tpu import exceptions as exc
+
+        if (cfg and cfg.get("num_shards", 1) > 1
+                and isinstance(e, (exc.ActorDiedError,
+                                   exc.ActorUnavailableError))):
+            return exc.ReplicaGroupDied(
+                self._endpoint, "",
+                f"group leader died: {type(e).__name__}: {e}")
+        return e
 
     def _dispatch(self, replica, batch: list[_PendingQuery],
-                  shadow: bool = False):
+                  shadow: bool = False, cfg: dict | None = None):
         key = replica._actor_id.binary()
         with self._lock:
             self._inflight[key] = self._inflight.get(key, 0) + 1
@@ -391,23 +479,36 @@ class Router:
                     q._notify()
         except Exception as e:
             if not shadow:
+                e = self._map_group_error(e, cfg)
                 for q in batch:
                     q.error = e
                     q._notify()
         if refs:
             # shadow batches still occupy a replica slot until done
-            # (backpressure), their results just go nowhere
-            self._watch_batch(key, refs, () if shadow else batch)
+            # (backpressure); their results are reclaimed the moment
+            # each lands (_watch_batch owns and frees those refs)
+            self._watch_batch(key, refs, () if shadow else batch,
+                              cfg=cfg)
         else:
             with self._lock:
                 self._inflight[key] -= 1
 
-    def _watch_batch(self, key: bytes, refs: list, batch):
+    def _watch_batch(self, key: bytes, refs: list, batch,
+                     cfg: dict | None = None):
         """Arm one memstore ready-callback per return: the last one to
         fire frees the replica slot, and result-mode queries get their
         deserialized value pushed straight to their event loop. The
         callbacks run inline on the task-reply (io-loop) thread, so a
-        whole batch completes in one pass with no polling anywhere."""
+        whole batch completes in one pass with no polling anywhere.
+
+        Ref reclamation: refs only the ROUTER will ever read — shadow
+        results, and result-mode (call_async) returns whose callers get
+        the VALUE — are held in `owned` and dropped deterministically as
+        each completes, so their memstore entries and owned-table rows
+        free on the spot instead of whenever GC finds the callback
+        closures ("results go nowhere" must not strand entries). Refs
+        handed to assign() callers are theirs to hold; the router keeps
+        no copy past the callback."""
         from ray_tpu._private import global_state, rpc, serialization
         from ray_tpu._private.memstore import IN_PLASMA
 
@@ -415,8 +516,14 @@ class Router:
         state = {"left": len(refs)}
         waiters = {ref.id(): q for q, ref in zip(batch, refs)
                    if q.want_result}
+        if batch:
+            owned = {ref.id(): ref for q, ref in zip(batch, refs)
+                     if q.want_result}
+        else:  # shadow: every result is nobody's — all router-owned
+            owned = {ref.id(): ref for ref in refs}
 
-        def finish_one():
+        def finish_one(oid):
+            owned.pop(oid, None)  # deterministic free (see docstring)
             with self._lock:
                 state["left"] -= 1
                 done = state["left"] == 0
@@ -451,13 +558,13 @@ class Router:
                 try:
                     deliver(q, ray_tpu.get(ref), False)
                 except BaseException as e:
-                    deliver(q, e, True)
+                    deliver(q, self._map_group_error(e, cfg), True)
                 finally:
-                    finish_one()
+                    finish_one(oid)
 
             def on_ready():
                 if q is None:
-                    finish_one()
+                    finish_one(oid)
                     return
                 found, value, is_exc = cw.memstore.get_if_ready(oid)
                 if not found or value is IN_PLASMA:
@@ -470,8 +577,10 @@ class Router:
                     result = serialization.deserialize(value)
                 except BaseException as e:
                     result, is_exc = e, True
+                if is_exc:
+                    result = self._map_group_error(result, cfg)
                 deliver(q, result, is_exc)
-                finish_one()
+                finish_one(oid)
 
             return on_ready
 

@@ -220,6 +220,16 @@ def _leak_notes(leaked_pids: dict, leaked_segs: set) -> str:
                         f"  live collective group {g.get('group')!r} "
                         f"rank {g.get('rank')} on {label} "
                         f"(op={g.get('op') or 'idle'})")
+            # serve replica-group members name their gang: a leaked
+            # member reads as 'rank 2 of backend X' instead of a pid
+            comp = proc.get("component") or {}
+            if (leaked_pids or leaked_segs) and comp.get("kind") == \
+                    "serve-replica-group-member":
+                notes.append(
+                    f"  live replica-group member rank {comp.get('rank')}"
+                    f"/{comp.get('world_size')} of backend "
+                    f"{comp.get('backend')!r} on {label} "
+                    f"(group {comp.get('group')})")
     except Exception:
         return ""
     if not notes:

@@ -1,0 +1,532 @@
+"""Sharded model serving (replica groups), admission control, and the
+zero-copy payload plane (ISSUE 10 / ROADMAP item 1).
+
+Tier-1: bit-exact partitioned forward vs the unsharded reference,
+deterministic member-kill -> typed ReplicaGroupDied + gang restart,
+bounded-queue shedding with honest bookkeeping, zero-copy round trips,
+HTTP status mapping.
+
+Chaos (`pytest -m chaos`): 5-seeded member-kill sweep — victim rank and
+kill point drawn per seed; every in-flight request completes or raises a
+TYPED error within its deadline, the gang restarts, fresh requests
+succeed, and the conftest leak-check proves no orphaned members or
+leaked collective segments."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import exceptions as exc
+from ray_tpu import serve
+from ray_tpu.serve.replica_group import ShardedMLP
+from tests.conftest import scale_timeout, state_dump_on_failure
+
+
+def _int_weights(seed: int, h: int = 8, d: int = 16):
+    """Integer-valued f32 weights/inputs: every partial product and sum
+    is exactly representable, so the sharded sum is BIT-exact with the
+    unsharded matmul regardless of reduction order."""
+    rng = np.random.default_rng(seed)
+    w1 = rng.integers(-3, 4, (h, d)).astype(np.float32)
+    w2 = rng.integers(-3, 4, (d, h)).astype(np.float32)
+    return w1, w2
+
+
+@pytest.fixture
+def serve_client(ray_start_shared):
+    client = serve.start()
+    try:
+        yield client
+    finally:
+        client.shutdown()
+
+
+def _wait_route(port, path, deadline=30.0):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < scale_timeout(deadline):
+        try:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=5)
+            return
+        except urllib.error.HTTPError as e:
+            if e.code != 404:
+                return  # route exists (405/400/500 are all post-routing)
+        except Exception:
+            pass
+        time.sleep(0.2)
+    raise TimeoutError(f"route {path} never appeared")
+
+
+# ---------------------------------------------------------------------------
+# sharded forward: bit-exactness + basics
+# ---------------------------------------------------------------------------
+
+
+def test_sharded_forward_bit_exact(serve_client):
+    """A num_shards=4 deployment answers through the collective-backed
+    partitioned forward and matches the single-process unsharded
+    reference model BIT-exactly (f32) for the same weights/inputs."""
+    w1, w2 = _int_weights(0)
+    serve_client.create_backend(
+        "sx", ShardedMLP, w1, w2,
+        config=serve.BackendConfig(
+            num_shards=4, large_payload_threshold=0,
+            shard_group_timeout_s=scale_timeout(10)))
+    serve_client.create_endpoint("sx_ep", backend="sx")
+    handle = serve_client.get_handle("sx_ep")
+
+    rng = np.random.default_rng(1)
+    x = rng.integers(-3, 4, (6, 8)).astype(np.float32)
+    out = ray_tpu.get([handle.remote(row) for row in x],
+                      timeout=scale_timeout(60))
+    reference = ShardedMLP(w1, w2)([row for row in x])
+    for got, want in zip(out, reference):
+        assert got.dtype == np.float32
+        assert (got == want).all(), "sharded forward not bit-exact"
+
+    # sanity: the gang really is 4 members in 1 collective group
+    gangs = ray_tpu.get(
+        serve_client._controller.get_gang_members.remote("sx"),
+        timeout=scale_timeout(30))
+    assert len(gangs) == 1 and len(gangs[0]) == 4
+
+
+def test_sharded_member_kill_typed_and_gang_restart(serve_client):
+    """Deterministic member-kill: arm `serve.group_forward=exit` in ONE
+    member; the in-flight request raises typed ReplicaGroupDied within
+    the group timeout, the controller gang-restarts, and fresh requests
+    succeed through the new gang."""
+    w1, w2 = _int_weights(2)
+    timeout_s = scale_timeout(5)
+    serve_client.create_backend(
+        "skill", ShardedMLP, w1, w2,
+        config=serve.BackendConfig(
+            num_shards=3, large_payload_threshold=0,
+            shard_group_timeout_s=timeout_s))
+    serve_client.create_endpoint("skill_ep", backend="skill")
+    handle = serve_client.get_handle("skill_ep")
+    x = np.arange(8, dtype=np.float32)
+    assert ray_tpu.get(handle.remote(x),
+                       timeout=scale_timeout(60)) is not None
+
+    gangs = ray_tpu.get(
+        serve_client._controller.get_gang_members.remote("skill"),
+        timeout=scale_timeout(30))
+    old_members = gangs[0]
+    victim = old_members[1]
+    ray_tpu.get(victim.arm_failpoint.remote(
+        "serve.group_forward", "exit", nth=1), timeout=scale_timeout(30))
+
+    t0 = time.monotonic()
+    with pytest.raises(exc.ReplicaGroupDied):
+        ray_tpu.get(handle.remote(x), timeout=scale_timeout(60))
+    assert time.monotonic() - t0 < timeout_s + scale_timeout(10), \
+        "typed error took longer than the group timeout + grace"
+
+    # the gang restarts and serves again
+    deadline = time.monotonic() + scale_timeout(60)
+    while True:
+        try:
+            out = ray_tpu.get(handle.remote(x), timeout=scale_timeout(15))
+            break
+        except (exc.ReplicaGroupDied, exc.ActorDiedError,
+                exc.ActorUnavailableError, TimeoutError):
+            assert time.monotonic() < deadline, "gang never came back"
+            time.sleep(0.5)
+    assert (out == ShardedMLP(w1, w2)([x])[0]).all()
+    fresh = ray_tpu.get(
+        serve_client._controller.get_gang_members.remote("skill"),
+        timeout=scale_timeout(30))
+    assert len(fresh[0]) == 3
+    # the whole gang was replaced, not patched
+    old_ids = {m._actor_id.binary() for m in old_members}
+    new_ids = {m._actor_id.binary() for m in fresh[0]}
+    assert not (old_ids & new_ids)
+
+
+def test_sharded_backend_requires_shard_protocol(serve_client):
+    """A num_shards>1 backend whose callable has no shard() fails at
+    create_backend time (bootstrap surfaces the member's TypeError), and
+    nothing is leaked."""
+    with pytest.raises(Exception):
+        serve_client.create_backend(
+            "bad_sharded", lambda d=None: d,
+            config=serve.BackendConfig(num_shards=2))
+    assert "bad_sharded" not in serve_client.list_backends()
+
+
+# ---------------------------------------------------------------------------
+# admission control: bounded queues, typed sheds, honest bookkeeping
+# ---------------------------------------------------------------------------
+
+
+def test_admission_shed_typed_and_counters(serve_client):
+    """Queries past max_queued_requests shed with the typed
+    ServeOverloadedError; shed/admitted counters and the live queue
+    gauge stay honest (gauge returns to zero once traffic drains)."""
+    from ray_tpu.serve.metrics import M_ROUTER_QUEUED
+
+    class Gate:
+        def __call__(self, data):
+            import time as _t
+
+            _t.sleep(1.0)
+            return "ok"
+
+    serve_client.create_backend(
+        "gate", Gate,
+        config=serve.BackendConfig(max_concurrent_queries=1,
+                                   max_batch_size=1,
+                                   max_queued_requests=2,
+                                   overload_retry_after_s=2.5))
+    serve_client.create_endpoint("gate_ep", backend="gate")
+    handle = serve_client.get_handle("gate_ep")
+    router = handle._router
+
+    # one slow query occupies the replica; then fill the bounded queue
+    from concurrent.futures import ThreadPoolExecutor
+
+    pool = ThreadPoolExecutor(8)
+    inflight = [pool.submit(handle.remote, i) for i in range(3)]
+    deadline = time.monotonic() + scale_timeout(30)
+    # wait until the replica slot is taken and the queue is at capacity
+    while time.monotonic() < deadline:
+        snap = router.debug_state()
+        if snap["queued"] >= 2:
+            break
+        time.sleep(0.05)
+    shed_before = router.debug_state()["shed_total"]
+    with pytest.raises(exc.ServeOverloadedError) as ei:
+        handle.remote(99)
+    assert ei.value.max_queued == 2
+    assert ei.value.retry_after_s == 2.5
+    assert router.debug_state()["shed_total"] == shed_before + 1
+
+    refs = [f.result(timeout=scale_timeout(60)) for f in inflight]
+    assert ray_tpu.get(refs, timeout=scale_timeout(60)) == ["ok"] * 3
+    # the queue gauge drains back with the traffic
+    deadline = time.monotonic() + scale_timeout(20)
+    while time.monotonic() < deadline:
+        if router.debug_state()["queued"] == 0:
+            break
+        time.sleep(0.05)
+    assert router.debug_state()["queued"] == 0
+    assert M_ROUTER_QUEUED.snapshot()["value"] >= 0
+    assert router.debug_state()["admitted_total"] >= 3
+    pool.shutdown(wait=False)
+
+
+def test_shed_and_completion_reclaim_refs(serve_client):
+    """Bookkeeping fix (satellite): result-mode queries whose values are
+    delivered (call_async) and shed/abandoned queries must leave no
+    memstore entries or owned-table rows behind — 'results go nowhere'
+    now means reclaimed, not stranded."""
+    import asyncio
+
+    from ray_tpu._private import global_state
+
+    serve_client.create_backend("echo_rc", lambda d=None: d)
+    serve_client.create_endpoint("echo_rc_ep", backend="echo_rc")
+    handle = serve_client.get_handle("echo_rc_ep")
+    assert ray_tpu.get(handle.remote("warm"),
+                       timeout=scale_timeout(60)) == "warm"
+    router = handle._router
+    cw = global_state.get_core_worker()
+
+    async def drive():
+        return await asyncio.gather(
+            *[router.call_async(i, timeout=scale_timeout(30))
+              for i in range(16)])
+
+    before_owned = len(cw.owned)
+    before_size = cw.memstore.size()
+    assert asyncio.run(drive()) == list(range(16))
+    # completion must reclaim every return ref the router owned
+    deadline = time.monotonic() + scale_timeout(20)
+    while time.monotonic() < deadline:
+        if (len(cw.owned) <= before_owned
+                and cw.memstore.size() <= before_size):
+            break
+        time.sleep(0.05)
+    assert len(cw.owned) <= before_owned, (
+        f"leaked owned refs: {len(cw.owned)} vs {before_owned}")
+    assert cw.memstore.size() <= before_size, (
+        f"leaked memstore entries: {cw.memstore.size()} vs {before_size}")
+
+
+# ---------------------------------------------------------------------------
+# zero-copy payloads
+# ---------------------------------------------------------------------------
+
+
+def test_payload_wrap_unwrap_roundtrip(serve_client):
+    """wrap() puts bodies >= threshold into plasma (counted), unwrap()
+    restores identical bytes; sub-threshold bodies pass through."""
+    from ray_tpu.serve import payload
+    from ray_tpu.serve.metrics import M_ZERO_COPY_BYTES_TOTAL
+
+    small = b"x" * 100
+    assert payload.wrap(small, 1024) is small
+    big = np.random.default_rng(3).integers(
+        0, 256, 256 * 1024).astype(np.uint8).tobytes()
+    before = M_ZERO_COPY_BYTES_TOTAL.snapshot()["value"]
+    wrapped = payload.wrap(big, 1024)
+    assert isinstance(wrapped, payload.LargePayload)
+    assert wrapped.nbytes == len(big)
+    assert M_ZERO_COPY_BYTES_TOTAL.snapshot()["value"] == before + len(big)
+    assert payload.unwrap(wrapped) == big
+    assert payload.unwrap(small) is small
+
+
+def test_zero_copy_http_roundtrip(serve_client):
+    """Large binary body in -> plasma ref through the router -> replica
+    -> plasma ref back -> identical bytes out, with octet-stream
+    content type both ways."""
+    serve_client.create_backend(
+        "echo_zc", lambda d=None: d,
+        config=serve.BackendConfig(large_payload_threshold=64 * 1024))
+    serve_client.create_endpoint("echo_zc_ep", backend="echo_zc",
+                                 route="/echo_zc",
+                                 methods=["GET", "POST"])
+    port = serve_client.enable_http()
+    _wait_route(port, "/echo_zc")
+    body = np.random.default_rng(4).integers(
+        0, 256, 3 << 20).astype(np.uint8).tobytes()  # 3MB
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/echo_zc", data=body,
+        headers={"Content-Type": "application/octet-stream"},
+        method="POST")
+    with urllib.request.urlopen(req, timeout=scale_timeout(60)) as resp:
+        assert resp.headers.get("Content-Type") == \
+            "application/octet-stream"
+        back = resp.read()
+    assert back == body, "zero-copy round trip corrupted the body"
+
+
+# ---------------------------------------------------------------------------
+# HTTP status mapping
+# ---------------------------------------------------------------------------
+
+
+def test_http_error_mapping_unit():
+    """_error_response maps each typed internal error to its production
+    status code (pure function — no cluster needed)."""
+    from ray_tpu.serve.http_proxy import _error_response
+
+    st, hdrs, doc = _error_response(
+        exc.ServeOverloadedError("ep", 5, 4, 2.0))
+    assert st == 503 and hdrs["Retry-After"] == "2"
+    assert doc["type"] == "ServeOverloadedError"
+    st, hdrs, doc = _error_response(exc.ReplicaGroupDied("b", "g", "x"))
+    assert st == 503 and "Retry-After" in hdrs
+    st, _, doc = _error_response(exc.ObjectLostError("abc"))
+    assert st == 503
+    st, _, doc = _error_response(
+        exc.TaskError("ValueError", "boom", "tb"))
+    assert st == 500 and doc["cause"] == "ValueError"
+    st, _, doc = _error_response(RuntimeError("misc"))
+    assert st == 500
+
+
+def test_http_shed_503_and_user_error_500(serve_client):
+    """Through the wire: sheds answer 503 + Retry-After; a user
+    exception answers 500 with the TaskError cause."""
+    class GateOrBoom:
+        def __call__(self, data):
+            import time as _t
+
+            if data == {"boom": 1}:
+                raise ValueError("user bug")
+            _t.sleep(1.0)
+            return "ok"
+
+    serve_client.create_backend(
+        "mix", GateOrBoom,
+        config=serve.BackendConfig(max_concurrent_queries=1,
+                                   max_batch_size=1,
+                                   max_queued_requests=1))
+    serve_client.create_endpoint("mix_ep", backend="mix", route="/mix",
+                                 methods=["GET", "POST"])
+    port = serve_client.enable_http()
+    _wait_route(port, "/mix")
+
+    # user error -> 500 (before saturating the endpoint)
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/mix",
+        data=json.dumps({"boom": 1}).encode(), method="POST")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req, timeout=scale_timeout(30))
+    assert ei.value.code == 500
+    assert json.loads(ei.value.read())["type"] == "TaskError"
+
+    # saturate: 1 executing + 1 queued; the rest must shed as 503
+    from concurrent.futures import ThreadPoolExecutor
+
+    def call(_):
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/mix",
+                    timeout=scale_timeout(60)) as r:
+                return r.status, r.headers
+        except urllib.error.HTTPError as e:
+            e.read()
+            return e.code, e.headers
+
+    with ThreadPoolExecutor(8) as pool:
+        futures = [pool.submit(call, i) for i in range(8)]
+        codes = [f.result(timeout=scale_timeout(60)) for f in futures]
+    sheds = [(c, h) for c, h in codes if c == 503]
+    assert sheds, f"no 503 sheds under 8x overload: {[c for c, _ in codes]}"
+    assert all(h.get("Retry-After") for _, h in sheds)
+    assert any(c == 200 for c, _ in codes), "nothing succeeded"
+
+
+# ---------------------------------------------------------------------------
+# CI gate: mixed-traffic overload behavior (reads MICROBENCH.json —
+# deterministic, no benchmarking in CI; same pattern as the tracing and
+# state overhead gates)
+# ---------------------------------------------------------------------------
+
+
+def test_microbench_serve_mixed_gate():
+    """The recorded 2x-overload mixed-traffic row must show typed sheds
+    doing their job: nonzero 503 shed rate, surviving goodput, and p99
+    bounded relative to the 1x arm of the SAME windows (overload
+    degrades by shedding, not by latency collapse)."""
+    import os
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    doc = json.load(open(os.path.join(root, "MICROBENCH.json")))
+    rows = {r["name"]: r for r in doc["results"]}
+    for name in ("serve_mixed 1x", "serve_mixed 2x overload"):
+        assert name in rows, f"missing {name!r} row in MICROBENCH.json"
+    one, two = rows["serve_mixed 1x"], rows["serve_mixed 2x overload"]
+    assert two["shed_rate"] > 0, \
+        "2x overload recorded ZERO sheds — admission control not engaged"
+    assert two["per_second"] > 0, "no goodput survived 2x overload"
+    # bounded p99: shed-fast overload must not let admitted-request
+    # latency run away (collapse reads as p99 >> the 1x arm's)
+    assert two["p99_ms"] <= 5 * max(one["p99_ms"], 50.0), (
+        f"2x overload p99 {two['p99_ms']}ms vs 1x {one['p99_ms']}ms — "
+        f"latency collapsed instead of shedding")
+
+
+# ---------------------------------------------------------------------------
+# seeded chaos: member killed mid-forward (slow tier)
+# ---------------------------------------------------------------------------
+
+_CHAOS_SEEDS = [201, 202, 203, 204, 205]
+
+# Typed outcomes an in-flight request may legitimately surface while the
+# gang dies/restarts under it. ReplicaGroupDied: member death starved
+# the leader's collective. ActorDied/Unavailable: the LEADER itself was
+# the victim (the handle path sees the raw actor error).
+_CHAOS_TYPED = (exc.ReplicaGroupDied, exc.ActorDiedError,
+                exc.ActorUnavailableError)
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+@pytest.mark.parametrize("seed", _CHAOS_SEEDS)
+def test_chaos_member_kill_mid_forward(seed):
+    """Per seed: draw a victim rank and a kill point, kill that member
+    mid-forward under concurrent traffic. Every in-flight request
+    completes bit-exact or raises a TYPED error within its deadline, the
+    gang restarts, fresh requests succeed, and (conftest leak-check) no
+    member processes or collective segments leak."""
+    import random
+
+    rng = random.Random(seed)
+    num_shards = 3
+    victim_rank = rng.randrange(num_shards)
+    nth = rng.randint(1, 3)
+    print(f"[chaos] seed={seed} victim_rank={victim_rank} nth={nth}")
+    budget = scale_timeout(90)
+    timeout_s = scale_timeout(5)
+    w1, w2 = _int_weights(seed)
+    reference = ShardedMLP(w1, w2)
+    ray_tpu.init(num_cpus=8)
+    client = None
+    try:
+        client = serve.start()
+        client.create_backend(
+            "chx", ShardedMLP, w1, w2,
+            config=serve.BackendConfig(
+                num_shards=num_shards, large_payload_threshold=0,
+                shard_group_timeout_s=timeout_s))
+        client.create_endpoint("chx_ep", backend="chx")
+        handle = client.get_handle("chx_ep")
+        x = np.arange(8, dtype=np.float32)
+        want = reference([x])[0]
+        with state_dump_on_failure(f"serve-sharded-chaos-seed{seed}"):
+            assert (ray_tpu.get(handle.remote(x), timeout=budget)
+                    == want).all()
+            gangs = ray_tpu.get(
+                client._controller.get_gang_members.remote("chx"),
+                timeout=scale_timeout(30))
+            victim = gangs[0][victim_rank]
+            ray_tpu.get(victim.arm_failpoint.remote(
+                "serve.group_forward", "exit", nth=nth),
+                timeout=scale_timeout(30))
+
+            # concurrent traffic so requests are in flight when the
+            # kill lands; every outcome is correct-or-typed in bounded
+            # time (the ISSUE invariant)
+            outcomes: list = [None] * 8
+
+            def one(i):
+                try:
+                    out = ray_tpu.get(handle.remote(x), timeout=budget)
+                    outcomes[i] = ("ok", out)
+                except exc.GetTimeoutError as e:
+                    outcomes[i] = ("hang", e)
+                except _CHAOS_TYPED as e:
+                    outcomes[i] = ("typed", e)
+                except TimeoutError as e:
+                    # router dispatch window during gang cutover
+                    outcomes[i] = ("typed", e)
+
+            threads = [threading.Thread(target=one, args=(i,))
+                       for i in range(8)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=budget + scale_timeout(30))
+            assert not any(t.is_alive() for t in threads), \
+                f"[seed={seed}] request thread HUNG"
+            kinds = [o[0] for o in outcomes if o]
+            assert "hang" not in kinds, \
+                f"[seed={seed}] request hung past deadline: {outcomes}"
+            for kind, val in outcomes:
+                if kind == "ok":
+                    assert (val == want).all(), \
+                        f"[seed={seed}] SILENT CORRUPTION: {val}"
+            typed = [v for k, v in outcomes if k == "typed"]
+            print(f"[chaos seed={seed}] outcomes: "
+                  f"{[k for k, _ in outcomes]}")
+            assert typed, (
+                f"[seed={seed}] the armed kill never surfaced — "
+                f"nth={nth} did not land?")
+
+            # the gang restarts and answers bit-exact again
+            deadline = time.monotonic() + budget
+            while True:
+                try:
+                    out = ray_tpu.get(handle.remote(x),
+                                      timeout=scale_timeout(15))
+                    break
+                except (_CHAOS_TYPED + (TimeoutError,)):
+                    assert time.monotonic() < deadline, (
+                        f"[seed={seed}] gang never came back")
+                    time.sleep(0.5)
+            assert (out == want).all()
+    finally:
+        if client is not None:
+            client.shutdown()
+        ray_tpu.shutdown()
