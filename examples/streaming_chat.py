@@ -1,0 +1,92 @@
+"""Streaming inference demo: token-level continuous batching + SSE.
+
+Deploys the integer-weight ShardedTokenLM reference model as a
+streaming backend (2-shard gang: the decode loop runs in the gang
+leader, one collective allreduce per STEP), then drives it three ways:
+
+  1. handle.stream(...)      — sync token generator over the router
+  2. HTTP SSE                — curl-style `Accept: text/event-stream`
+  3. multi-turn session      — the second turn lands on the replica
+                               already holding the session's KV pages
+
+Run:  python examples/streaming_chat.py
+"""
+
+import http.client
+import json
+import time
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.serve.engine import ShardedTokenLM
+from ray_tpu.serve.streaming import iter_sse_lines
+
+
+def main():
+    model = ShardedTokenLM.make(42, vocab=256, hidden=32, inner=64)
+    ray_tpu.init(num_cpus=4)
+    client = serve.start(http=True)
+    client.create_backend(
+        "chat", ShardedTokenLM,
+        model.embed.copy(), model.w_up.copy(), model.w_out.copy(),
+        config=serve.BackendConfig(streaming=True, num_shards=2,
+                                   max_decode_batch=4))
+    client.create_endpoint("chat", backend="chat", route="/chat",
+                           methods=["POST"])
+    port = client.http_port
+
+    # 1. sync generator over the router
+    handle = client.get_handle("chat")
+    print("handle.stream:", end=" ", flush=True)
+    for tok in handle.stream({"prompt": [7, 3, 5], "max_tokens": 16}):
+        print(tok, end=" ", flush=True)
+    print()
+
+    # 2. HTTP SSE (wait for the proxy's route table first)
+    def post(body):
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+        conn.request("POST", "/chat", body=json.dumps(body), headers={
+            "Content-Type": "application/json",
+            "Accept": "text/event-stream"})
+        return conn, conn.getresponse()
+
+    while True:
+        conn, resp = post({"prompt": [1], "max_tokens": 1})
+        ok = resp.status == 200
+        resp.read()
+        conn.close()
+        if ok:
+            break
+        time.sleep(0.2)
+    conn, resp = post({"prompt": [7, 3, 5], "max_tokens": 16,
+                       "stream": True})
+    t0 = time.perf_counter()
+    print("SSE frames:")
+    for event, data in iter_sse_lines(resp.fp):
+        stamp = (time.perf_counter() - t0) * 1000
+        if event == "meta":
+            print(f"  +{stamp:6.1f}ms  meta: {data}")
+            continue
+        if event == "done" or data.get("done"):
+            print(f"  +{stamp:6.1f}ms  done ({data.get('tokens_total')} "
+                  f"tokens)")
+            break
+        print(f"  +{stamp:6.1f}ms  data: {data['tokens']}")
+    conn.close()
+
+    # 3. multi-turn session: turn 2 adopts turn 1's cached KV prefix
+    t1 = list(handle.stream({"prompt": [2, 4], "max_tokens": 8,
+                             "session": "demo"}))
+    t2 = list(handle.stream({"prompt": [6], "max_tokens": 8,
+                             "session": "demo"}))
+    print(f"session turn 1: {t1}\nsession turn 2: {t2}")
+    router = handle._router.debug_state()
+    print(f"affinity: {router['affinity_hits']} hit(s), "
+          f"{router['affinity_misses']} miss(es)")
+
+    client.shutdown()
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

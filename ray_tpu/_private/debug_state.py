@@ -60,6 +60,7 @@ STAGE_HISTOGRAMS = {
     "exec": "core.task_exec_s",
     "raylet_queue": "raylet.lease_grant_s",
     "router_queue": "serve.router_queue_s",
+    "decode_step": "serve.decode_step_s",
 }
 
 
@@ -310,16 +311,37 @@ def flatten(snapshot: dict, component: str) -> list[dict]:
                     "max_queued": r.get("max_queued"),
                     "shed_total": r.get("shed_total"),
                     "admitted_total": r.get("admitted_total"),
+                    "streams_open": r.get("streams_open"),
+                    "sessions": r.get("sessions"),
                     "age_s": r.get("oldest_age_s"),
                     "inflight": r.get("inflight_batches"),
                 })
             comp = proc.get("component")
             if isinstance(comp, dict) and comp.get("kind", "").startswith(
                     "serve-"):
-                rows.append({"process": label,
-                             "kind": comp.get("kind"), **{
-                                 k: v for k, v in comp.items()
-                                 if k != "kind"}})
+                row = {"process": label, "kind": comp.get("kind"),
+                       **{k: v for k, v in comp.items()
+                          if k not in ("kind", "engine")}}
+                eng = comp.get("engine")
+                if isinstance(eng, dict):
+                    # decode-engine occupancy: batch fill, stream
+                    # backlog, per-session page counts, leak report —
+                    # the `ray-tpu state serve` streaming-tier rows
+                    row.update({
+                        "decode_batch": f"{eng.get('decode_batch')}"
+                                        f"/{eng.get('max_decode_batch')}",
+                        "waiting": eng.get("waiting"),
+                        "steps": eng.get("steps"),
+                        "open_streams": eng.get("open_streams"),
+                        "stream_backlog": eng.get("stream_backlog"),
+                        "kv_pages": f"{(eng.get('kv') or {}).get('pages_in_use')}"
+                                    f"/{(eng.get('kv') or {}).get('pages_total')}",
+                        "sessions": eng.get("sessions"),
+                        "age_s": eng.get("stall_age_s"),
+                        "kv_leaked": eng.get("kv_leaked") or "",
+                        "engine_dead": eng.get("dead") or "",
+                    })
+                rows.append(row)
     rows.sort(key=lambda r: -float(r.get("age_s") or 0.0))
     return rows
 
@@ -421,6 +443,19 @@ def diagnose(snapshot: dict, metrics: dict | None = None, *,
                 flag("collective", label, "collective", g.get("age_s"), g,
                      detail=f"phase={g.get('phase', '')} "
                             f"rank={g.get('rank')}")
+        comp = proc.get("component")
+        eng = comp.get("engine") if isinstance(comp, dict) else None
+        if isinstance(eng, dict) and eng.get("stall_age_s") is not None \
+                and not eng.get("dead"):
+            # a decode engine with running sequences whose last step
+            # age exceeds the decode-stage threshold is a WEDGED decode
+            # loop (stuck allreduce, dead follower the leader hasn't
+            # typed yet) — the stall doctor's streaming-tier finding
+            flag("decode", label, "decode_step", eng.get("stall_age_s"),
+                 {"name": eng.get("backend")},
+                 detail=f"batch={eng.get('decode_batch')} "
+                        f"open_streams={eng.get('open_streams')} "
+                        f"steps={eng.get('steps')}")
     findings.sort(key=lambda f: -f["age_s"])
     return findings
 

@@ -12,8 +12,11 @@ Endpoints:
     /api/trace   Perfetto JSON of the trace table (?trace_id= one tree)
     /api/metrics/history per-source metric time series (?samples=N)
     /api/events  structured cluster events ring
-    /api/state   live debug_state of every process (?component=tasks|
-                 actors|objects|leases|transfers|collectives, ?workers=0)
+    /api/state   live debug_state of every process (?component=serve|
+                 tasks|actors|objects|leases|transfers|collectives,
+                 ?workers=0; `serve` includes per-gang decode-batch
+                 occupancy, per-session KV page counts and stream
+                 backlog for streaming backends)
     /api/doctor  stall-doctor findings (age vs max(floor, K*p99))
 """
 

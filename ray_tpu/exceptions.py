@@ -120,6 +120,23 @@ class ServeOverloadedError(RayTpuError):
                  self.retry_after_s))
 
 
+class SequenceAborted(RayTpuError):
+    """A streaming inference sequence was aborted before it finished:
+    the client disconnected mid-stream, the KV page pool was exhausted,
+    or the hosting engine shut down. The sequence's KV pages are freed
+    on the abort path; any reader still parked on the stream surfaces
+    this instead of hanging."""
+
+    def __init__(self, seq_id: str = "", reason: str = ""):
+        self.seq_id = seq_id
+        self.reason = reason
+        super().__init__(
+            f"sequence {seq_id or '?'} aborted: {reason or 'aborted'}")
+
+    def __reduce__(self):
+        return (SequenceAborted, (self.seq_id, self.reason))
+
+
 class ReplicaGroupDied(RayTpuError):
     """A sharded Serve replica group lost a member (or its leader) while
     this request was in flight. The whole gang is being restarted by the

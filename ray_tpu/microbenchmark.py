@@ -305,6 +305,8 @@ def main(seconds_per_case: float = 2.0) -> list[dict]:
 
     _serve_mixed(results)
 
+    _serve_stream(results)
+
     ray_tpu.shutdown()
 
     _cross_node_bench(results)
@@ -911,6 +913,185 @@ def _serve_mixed(results: list[dict], window_s: float = 1.5,
     serve.shutdown()
 
 
+def _serve_stream(results: list[dict], windows: int = 3,
+                  gen_tokens: int = 96):
+    """Streaming inference bench (ROADMAP item 1 acceptance): tokens/s
+    per replica and time-to-first-token through the HTTP proxy at 2x
+    admission capacity, paired-interleaved against the PRESERVED
+    request-level path (same integer-weight ShardedTokenLM, deployed
+    once with streaming=True/SSE and once as a plain request/response
+    backend whose whole generation blocks its slot).
+
+    Capacity arithmetic: the continuous arm runs one engine with
+    max_decode_batch=4 running sequences; 2x = 8 closed-loop SSE
+    clients (the excess waits in the bounded admission queue and is
+    admitted into the RUNNING batch between steps). The request-level
+    arm serves the same 8 clients with max_batch_size=4 batches — a
+    whole batch's generations complete before the next dispatch.
+
+    Recorded per arm: tokens/s/replica (2xx tokens only), client-side
+    TTFT p50/p99 (first SSE data frame; for request-level the full
+    JSON IS the first byte, so TTFT == total latency — the coupling the
+    tier decouples), and full-generation p99. The tier-1 gate
+    (tests/test_serve_streaming.py::test_microbench_serve_stream_gate)
+    asserts the recorded continuous row kept TTFT p99 under 25% of the
+    full-generation p99 at 2x overload with tokens/s >= the
+    request-level arm."""
+    import http.client
+    import threading as _threading
+
+    import numpy as _np
+
+    from ray_tpu import serve
+    from ray_tpu.serve.engine import ShardedTokenLM
+    from ray_tpu.serve.streaming import iter_sse_lines
+
+    model = ShardedTokenLM.make(11, vocab=2048, hidden=64, inner=256)
+    margs = (model.embed.copy(), model.w_up.copy(), model.w_out.copy())
+    client = serve.start(http=True)
+    client.create_backend(
+        "bench_stream", ShardedTokenLM, *margs,
+        config={"streaming": True, "max_decode_batch": 4,
+                "max_waiting_sequences": 64, "kv_pages_total": 4096,
+                "num_replicas": 1, "large_payload_threshold": 0})
+    client.create_endpoint("bench_stream", backend="bench_stream",
+                           route="/bench_stream", methods=["POST"])
+    client.create_backend(
+        "bench_reqlvl", ShardedTokenLM, *margs,
+        config={"num_replicas": 1, "max_batch_size": 4,
+                "batch_wait_timeout": 0.002, "max_concurrent_queries": 1,
+                "large_payload_threshold": 0})
+    client.create_endpoint("bench_reqlvl", backend="bench_reqlvl",
+                           route="/bench_reqlvl", methods=["POST"])
+    port = client.http_port
+    n_clients = 8  # 2x the engine's 4 running slots
+
+    def _req_tokens(i: int) -> int:
+        # long-tailed lengths (x0.25, x0.5, x1, x4 of gen_tokens — the
+        # LLM-traffic shape iteration-level scheduling exists for):
+        # short sequences retire early and hand their running slot to
+        # the admission queue mid-flight, while request-level lockstep
+        # batches burn pad compute until their LONGEST row finishes
+        return int(gen_tokens * (0.25, 0.5, 1.0, 4.0)[i % 4])
+
+    def one_stream(i) -> tuple[float, float, int]:
+        """(ttft, total, tokens) for one SSE generation."""
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+        body = json.dumps({"prompt": [i % 7 + 1, 3, 5],
+                           "max_tokens": _req_tokens(i), "stream": True})
+        t0 = time.perf_counter()
+        conn.request("POST", "/bench_stream", body=body, headers={
+            "Content-Type": "application/json",
+            "Accept": "text/event-stream"})
+        resp = conn.getresponse()
+        ttft, n = None, 0
+        for ev, data in iter_sse_lines(resp.fp):
+            if ev == "error":
+                break
+            if ttft is None and data.get("tokens"):
+                ttft = time.perf_counter() - t0
+            n += len(data.get("tokens") or [])
+            if ev == "done" or data.get("done"):
+                break
+        total = time.perf_counter() - t0
+        conn.close()
+        return ttft if ttft is not None else total, total, n
+
+    def one_reqlvl(i) -> tuple[float, float, int]:
+        """(ttft, total, tokens) for one request-level generation —
+        the full JSON is the first byte the client sees."""
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+        body = json.dumps({"prompt": [i % 7 + 1, 3, 5],
+                           "max_tokens": _req_tokens(i)})
+        t0 = time.perf_counter()
+        conn.request("POST", "/bench_reqlvl", body=body,
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        doc = resp.read()
+        total = time.perf_counter() - t0
+        conn.close()
+        if resp.status != 200:
+            return total, total, 0
+        return total, total, len(json.loads(doc).get("result") or [])
+
+    def drive(fn, reqs_per_client: int = 3):
+        ttfts: list[float] = []
+        totals: list[float] = []
+        counts = {"tokens": 0}
+        lock = _threading.Lock()
+
+        def worker(i):
+            # staggered starts: closed-loop clients self-desynchronize
+            # after a few requests; the stagger keeps window 1's TTFT
+            # from measuring a thundering herd instead of steady state
+            time.sleep(i * 0.025)
+            for _ in range(reqs_per_client):
+                try:
+                    ttft, total, n = fn(i)
+                except (http.client.HTTPException, OSError):
+                    continue
+                with lock:
+                    if n:
+                        ttfts.append(ttft)
+                        totals.append(total)
+                        counts["tokens"] += n
+
+        threads = [_threading.Thread(target=worker, args=(i,))
+                   for i in range(n_clients)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        dt = time.perf_counter() - t0
+        return ttfts, totals, counts["tokens"], dt
+
+    # warm both routes (the route table syncs asynchronously) and both
+    # engines' first-step paths
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        try:
+            if one_stream(0)[2] and one_reqlvl(0)[2]:
+                break
+        except Exception:
+            pass
+        time.sleep(0.3)
+
+    arms = {"serve_stream continuous 2x": one_stream,
+            "serve_stream request-level 2x": one_reqlvl}
+    acc = {name: {"ttft": [], "total": [], "tokens": 0, "dt": 0.0}
+           for name in arms}
+    for _ in range(windows):  # paired: load swings hit both arms
+        for name, fn in arms.items():
+            ttfts, totals, tokens, dt = drive(fn)
+            a = acc[name]
+            a["ttft"].extend(ttfts)
+            a["total"].extend(totals)
+            a["tokens"] += tokens
+            a["dt"] += dt
+    for name, a in acc.items():
+        tps = a["tokens"] / a["dt"] if a["dt"] else 0.0
+        row = {
+            "name": name,
+            "tokens_per_s_per_replica": round(tps, 1),
+            "ttft_p50_ms": round(float(_np.percentile(a["ttft"], 50))
+                                 * 1000, 1) if a["ttft"] else 0.0,
+            "ttft_p99_ms": round(float(_np.percentile(a["ttft"], 99))
+                                 * 1000, 1) if a["ttft"] else 0.0,
+            "gen_p99_ms": round(float(_np.percentile(a["total"], 99))
+                                * 1000, 1) if a["total"] else 0.0,
+            "generations": len(a["total"]),
+            "gen_tokens": gen_tokens,
+            "clients": n_clients,
+            "windows": windows,
+        }
+        results.append(row)
+        print(f"{name}: {tps:.1f} tok/s/replica, ttft p99 "
+              f"{row['ttft_p99_ms']:.0f}ms, gen p99 "
+              f"{row['gen_p99_ms']:.0f}ms ({row['generations']} gens)")
+    serve.shutdown()
+
+
 def _tracing_ab(results: list[dict]):
     """Distributed-tracing overhead A/B (the tier-1 microbench gate in
     test_observability reads these rows): tracing at the DEFAULT head
@@ -1122,6 +1303,7 @@ if __name__ == "__main__":
     args = parser.parse_args()
     if args.only:
         groups = {"serve_mixed": _serve_mixed, "serve": _serve_qps,
+                  "serve_stream": _serve_stream,
                   "tracing": _tracing_ab, "state": _state_ab,
                   "collective": _collective_bench}
         if args.only not in groups:

@@ -88,6 +88,17 @@ def column_shard(w, rank: int, num_shards: int):
     return w[..., lo:hi]
 
 
+def kv_slice(width: int, rank: int, num_shards: int) -> tuple[int, int]:
+    """This rank's [lo, hi) slice of a KV vector's inner dimension —
+    the per-shard KV PAGE slice of the streaming tier's paged cache
+    (serve/kv_cache.py): each gang rank caches only the columns its
+    column-sharded up-projection produces, so cache reads/writes are
+    shard-local and only the per-step logits allreduce crosses ranks.
+    Identical arithmetic to column_shard's last-axis bounds, named so
+    cache sizing and weight slicing can't drift apart."""
+    return shard_bounds(width, rank, num_shards)
+
+
 def row_shard(w, rank: int, num_shards: int):
     """This rank's slice of a ROW-parallel weight (SNIPPETS [3]
     RowParallelLinear: input features sharded, logical axes

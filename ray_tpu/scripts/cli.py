@@ -804,7 +804,9 @@ def main(argv=None) -> int:
                    help="flat rows for one component class "
                         "(omit for a per-process summary; `serve` shows "
                         "per-router queue depth vs bound + shed/admitted "
-                        "totals and replica-group state)")
+                        "totals, replica-group state, and per-engine "
+                        "decode-batch occupancy / per-session KV page "
+                        "counts / stream backlog for streaming backends)")
     p.add_argument("--address", default=None)
     p.add_argument("--filter", default=None,
                    help="only rows containing this substring")

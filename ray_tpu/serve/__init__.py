@@ -3,12 +3,17 @@
 what fills the MXU: the router groups queries to max_batch_size before one
 replica RPC. Production tier (ROADMAP item 1): bounded admission queues
 with typed load shedding, zero-copy large payloads over plasma + the bulk
-channel, and sharded replica GROUPS whose forward pass is collective-
-backed (serve/replica_group.py)."""
+channel, sharded replica GROUPS whose forward pass is collective-backed
+(serve/replica_group.py), and a STREAMING inference tier — token-level
+continuous batching inside the replica/gang leader, a paged shard-resident
+KV-cache, SSE end-to-end, and session-affinity routing (serve/engine.py,
+serve/kv_cache.py, serve/streaming.py)."""
 
-from ray_tpu.exceptions import ReplicaGroupDied, ServeOverloadedError
+from ray_tpu.exceptions import (ReplicaGroupDied, SequenceAborted,
+                                ServeOverloadedError)
 from ray_tpu.serve.api import Client, connect, shutdown, start
 from ray_tpu.serve.config import BackendConfig
+from ray_tpu.serve.engine import ShardedTokenLM
 from ray_tpu.serve.payload import LargePayload
 from ray_tpu.serve.replica import accept_batch
 from ray_tpu.serve.replica_group import ShardedMLP
@@ -19,9 +24,11 @@ __all__ = [
     "Client",
     "LargePayload",
     "ReplicaGroupDied",
+    "SequenceAborted",
     "ServeHandle",
     "ServeOverloadedError",
     "ShardedMLP",
+    "ShardedTokenLM",
     "accept_batch",
     "connect",
     "shutdown",

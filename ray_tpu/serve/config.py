@@ -54,6 +54,20 @@ class BackendConfig:
     shard_group_timeout_s: float = 10.0   # collective op deadline
     shard_transport: str = "auto"         # pin shm/ring/device, or auto
     num_cpus_per_shard: float = 0.001     # gang bundle reservation size
+    # -- streaming inference (continuous batching / paged KV-cache) -----
+    # streaming=True hosts a token-level decode engine in each replica
+    # (the gang LEADER for num_shards>1): requests are admitted into the
+    # running batch between decode steps, finished sequences retire
+    # early, and responses stream token-by-token (SSE over HTTP). The
+    # model must speak the decode protocol (see engine.ShardedTokenLM).
+    streaming: bool = False
+    max_decode_batch: int = 8             # running sequences per engine
+    max_waiting_sequences: int = 32       # admission bound (typed shed)
+    kv_page_size: int = 16                # tokens per KV page
+    kv_pages_total: int = 512             # page pool size per rank
+    kv_backend: str = "numpy"             # or "jax" (donated updates)
+    session_cache_max: int = 32           # retained session KV tables
+    stream_poll_s: float = 2.0            # router long-poll slice
 
     def __post_init__(self):
         if self.num_replicas < 0:
@@ -69,6 +83,18 @@ class BackendConfig:
             raise ValueError("num_shards must be >= 1")
         if self.num_shards > 1 and self.shard_group_timeout_s <= 0:
             raise ValueError("shard_group_timeout_s must be > 0")
+        if self.streaming:
+            if self.max_decode_batch < 1:
+                raise ValueError("max_decode_batch must be >= 1")
+            if self.max_waiting_sequences < 1:
+                raise ValueError("max_waiting_sequences must be >= 1")
+            if self.kv_page_size < 1 or self.kv_pages_total < 1:
+                raise ValueError(
+                    "kv_page_size and kv_pages_total must be >= 1")
+            if self.kv_backend not in ("numpy", "jax"):
+                raise ValueError("kv_backend must be 'numpy' or 'jax'")
+            if self.session_cache_max < 0:
+                raise ValueError("session_cache_max must be >= 0")
         if isinstance(self.autoscaling, AutoscalingConfig):
             self.autoscaling = self.autoscaling.to_dict()
 

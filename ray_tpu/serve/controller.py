@@ -213,6 +213,12 @@ class ServeController:
                     f"num_shards of a live backend cannot change "
                     f"({old_shards} -> {merged_cfg.get('num_shards')}); "
                     f"deploy a new backend and shift traffic instead")
+            if bool(merged_cfg.get("streaming")) != bool(
+                    rec["config"].get("streaming")):
+                raise ValueError(
+                    "streaming of a live backend cannot change (live "
+                    "replicas' decode engines are not reconfigurable); "
+                    "deploy a new backend and shift traffic instead")
             rec["config"] = merged_cfg
             self._reconcile(name)
             # gangs: reconfigure reaches every member, not just leaders
@@ -262,7 +268,8 @@ class ServeController:
             replicas.append(replica_cls.remote(
                 rec["pickled"], rec["init_args"],
                 rec["config"].get("user_config"),
-                rec["config"].get("large_payload_threshold") or 0))
+                rec["config"].get("large_payload_threshold") or 0,
+                {**rec["config"], "_backend_name": name}))
         while len(replicas) > want:
             handle = replicas.pop()
             try:
@@ -435,6 +442,8 @@ class ServeController:
             total += w
         if total <= 0:
             raise ValueError("traffic weights sum to zero")
+        live = [b for b, w in traffic.items() if float(w) > 0]
+        self._check_streaming_uniform(live + list(ep["shadow"]))
         ep["traffic"] = {b: float(w) / total for b, w in traffic.items()
                         if float(w) > 0}
         ep["backend"] = max(ep["traffic"], key=ep["traffic"].get)
@@ -454,10 +463,26 @@ class ServeController:
             ep["shadow"].pop(backend, None)
         else:
             self._backend(backend)
+            self._check_streaming_uniform(list(ep["traffic"]) + [backend])
             ep["shadow"][backend] = proportion
         self.version += 1
         self._notify_change()
         return True
+
+    def _check_streaming_uniform(self, backends: list):
+        """An endpoint's backends must agree on `streaming`: the proxy
+        picks its dispatch style (SSE/stream vs request/response) per
+        ENDPOINT while the router picks a backend per REQUEST by
+        weight, so a mixed split would hard-500 whichever arm loses
+        the primary flag. Canary between two streaming backends (or
+        two request-level ones) instead."""
+        flags = {b: bool(self._backend(b)["config"].get("streaming"))
+                 for b in backends}
+        if len(set(flags.values())) > 1:
+            raise ValueError(
+                f"cannot split/shadow an endpoint across streaming AND "
+                f"request-level backends: {flags}; deploy the "
+                f"replacement with the same serving mode")
 
     def _endpoint(self, name: str) -> dict:
         if name not in self.endpoints:

@@ -40,6 +40,11 @@ def _error_response(e: BaseException):
         # a zero-copy payload's producer died with the only copy
         return 503, {"Retry-After": "1"}, {
             "error": str(e), "type": "ObjectLostError"}
+    if isinstance(e, exc.SequenceAborted):
+        # the stream was aborted (client gone, KV exhausted mid-decode,
+        # engine shutdown): nginx-style 499 — not retryable as-is, not
+        # a server bug
+        return 499, {}, {"error": str(e), "type": "SequenceAborted"}
     if isinstance(e, exc.TaskError):
         return 500, {}, {"error": str(e), "type": "TaskError",
                          "cause": e.cause_cls_name}
@@ -59,6 +64,7 @@ class HTTPProxy:
         self._routers: dict[str, object] = {}
         self._routes: dict[str, dict] = {}
         self._thresholds: dict[str, int] = {}
+        self._streaming: dict[str, bool] = {}
         self._state_lock = threading.Lock()
         self._version = -1
         self._host = host
@@ -108,15 +114,18 @@ class HTTPProxy:
             # per-endpoint zero-copy cutover, read from the primary
             # backend's config (same snapshot the routes came from)
             thresholds = {}
+            streaming = {}
             for name, ep_state in (snap.get("endpoints") or {}).items():
                 cfg = (ep_state.get("backends", {})
                        .get(ep_state.get("backend"), {})
                        .get("config") or {})
                 thresholds[name] = int(
                     cfg.get("large_payload_threshold") or 0)
+                streaming[name] = bool(cfg.get("streaming"))
             with self._state_lock:
                 self._routes = dict(snap["routes"])
                 self._thresholds = thresholds
+                self._streaming = streaming
                 self._version = snap["version"]
             self._synced.set()
 
@@ -134,6 +143,77 @@ class HTTPProxy:
         import asyncio
 
         from aiohttp import web
+
+        async def stream_handler(request, endpoint, router, data):
+            """Streaming-backend request: SSE when the client asked for
+            it (Accept: text/event-stream or {"stream": true}), else
+            aggregate the decoded tokens into one JSON reply — both ride
+            the engine's continuous batch; only the framing differs.
+            TTFT decoupling is the SSE path: the first `data:` frame
+            flushes one decode step after admission."""
+            from ray_tpu.serve.streaming import (SSE_CONTENT_TYPE,
+                                                 sse_event)
+
+            wants_sse = (SSE_CONTENT_TYPE
+                         in request.headers.get("Accept", "")
+                         or (isinstance(data, dict)
+                             and data.get("stream")))
+            gen = router.stream_async(data, timeout=60.0)
+            if not wants_sse:
+                toks: list[int] = []
+                try:
+                    async for chunk in gen:
+                        toks.extend(chunk["tokens"])
+                except Exception as e:
+                    status, headers, doc = _error_response(e)
+                    return web.json_response(doc, status=status,
+                                             headers=headers)
+                return web.json_response({"result": toks})
+            resp = web.StreamResponse(
+                status=200,
+                headers={"Cache-Control": "no-cache",
+                         "X-Accel-Buffering": "no"})
+            resp.content_type = SSE_CONTENT_TYPE
+            await resp.prepare(request)
+            total = 0
+            try:
+                async for chunk in gen:
+                    if "meta" in chunk:
+                        # stream preamble: seq id + session-cache
+                        # hit/miss (delta-prompt clients resend full
+                        # history on a miss)
+                        await resp.write(sse_event(chunk["meta"],
+                                                   event="meta"))
+                        continue
+                    total = chunk["cursor"]
+                    # one frame per engine chunk, flushed immediately:
+                    # a disconnected client surfaces here as a write
+                    # error/cancel -> gen closes -> sequence aborts and
+                    # its KV pages free (the router's abandon path)
+                    await resp.write(sse_event(
+                        {"tokens": chunk["tokens"], "cursor": total}))
+                await resp.write(sse_event(
+                    {"done": True, "tokens_total": total}, event="done"))
+            except (asyncio.CancelledError, ConnectionResetError,
+                    ConnectionError):
+                raise
+            except Exception as e:
+                status, _, doc = _error_response(e)
+                try:
+                    await resp.write(sse_event(
+                        {**doc, "status": status}, event="error"))
+                except (ConnectionError, RuntimeError):
+                    pass
+            finally:
+                try:
+                    await gen.aclose()  # no-op if exhausted; otherwise
+                except BaseException:   # triggers the abort path
+                    pass
+                try:
+                    await resp.write_eof()
+                except (ConnectionError, RuntimeError):
+                    pass
+            return resp
 
         async def handler(request: "web.Request"):
             # Fully async request path: route lookup is a plain dict get,
@@ -188,6 +268,9 @@ class HTTPProxy:
             token = tracing.push(ctx) if ctx is not None else None
             t0 = time.time()
             try:
+                if self._streaming.get(endpoint):
+                    return await stream_handler(request, endpoint,
+                                                router, data)
                 if self._legacy_path:
                     ref = await router.assign_async(data)
                     result = await asyncio.wait_for(

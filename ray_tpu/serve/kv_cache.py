@@ -1,0 +1,321 @@
+"""Paged KV-cache for the streaming inference tier (ROADMAP item 1,
+the vLLM PagedAttention idea sized for this runtime).
+
+One pool per decode engine (per gang RANK: each shard caches only its
+own column-sharded slice of the per-token KV vectors, so an N-way gang
+holds an N-way-partitioned cache with no cross-rank traffic on reads).
+The pool is a single fixed arena of `num_pages` pages of `page_size`
+token rows each; sequences own pages through a page table (logical
+token index -> (page, slot)), so a sequence's cache grows in page-sized
+quanta with zero copying and frees back to the pool the moment the
+sequence finishes or aborts.
+
+Arena residency: in-cluster pools place their backing buffer in the
+same tmpfs as the plasma store arena (`<session>/objects/kvpool`,
+beside the collective segments) — shard-resident across steps like
+PR 10 payloads, and visible in /dev/shm accounting. The file is
+unlinked immediately after mapping (anonymous-by-unlink), so a
+hard-killed member can never leak a segment file; logical page leaks
+are the observable kind and are named by `leak_report()` + the
+conftest leak sweep.
+
+Backends: numpy (host gangs — the default) or jax, where the append is
+a jitted update with the arena DONATED (`donate_argnums=0`), so the
+per-token write mutates the buffer in place instead of copying the
+whole arena per token.
+
+Chaos seam: `serve.kv_page_alloc` fires on every page allocation.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+import numpy as np
+
+from ray_tpu._private import failpoints as _fp
+from ray_tpu.serve.metrics import M_KV_PAGES
+
+
+class KVCacheExhausted(RuntimeError):
+    """The pool has no free page. Admission paths shed on this; decode
+    paths abort the requesting sequence (typed SequenceAborted)."""
+
+    def __init__(self, pool: str, num_pages: int):
+        self.pool = pool
+        self.num_pages = num_pages
+        super().__init__(
+            f"KV page pool {pool!r} exhausted ({num_pages} pages all "
+            f"in use)")
+
+
+def _arena_dir() -> str | None:
+    """Directory beside the plasma store arena for in-cluster pools
+    (mirrors the collective segment_dir convention); None outside a
+    runtime — the pool then uses a plain anonymous buffer."""
+    from ray_tpu._private import global_state
+
+    cw = global_state.get_core_worker()
+    root = getattr(getattr(cw, "store", None), "root", None) if cw else None
+    if not root:
+        return None
+    return os.path.join(os.path.dirname(os.path.normpath(root)), "kvpool")
+
+
+def _alloc_arena(name: str, nbytes: int) -> np.ndarray:
+    """Flat uint8 buffer for the page arena: shm-file-backed beside the
+    store arena when a runtime is up (unlinked after mapping — no leak
+    path), else a plain numpy allocation."""
+    d = None
+    try:
+        d = _arena_dir()
+    except Exception:
+        d = None
+    if d is not None:
+        try:
+            os.makedirs(d, exist_ok=True)
+            path = os.path.join(d, f"{name}-{os.getpid()}")
+            buf = np.memmap(path, dtype=np.uint8, mode="w+",
+                            shape=(max(nbytes, 1),))
+            os.unlink(path)  # anonymous-by-unlink: survives only as long
+            return buf       # as this mapping; a SIGKILL can't leak it
+        except OSError:
+            pass
+    return np.zeros(max(nbytes, 1), dtype=np.uint8)
+
+
+# Live pools in this process, for debug_state / the conftest leak sweep
+# (named logical-page leaks, not bare gauge numbers).
+_live_pools: dict[int, "PagedKVCache"] = {}
+_pools_lock = threading.Lock()
+
+
+def debug_pools() -> list[dict]:
+    with _pools_lock:
+        pools = list(_live_pools.values())
+    out = []
+    for p in pools:
+        try:
+            out.append(p.debug_state())
+        except Exception:
+            continue
+    return out
+
+
+class PageTable:
+    """One sequence's (or cached session's) view of the pool: ordered
+    page ids + the count of token rows written."""
+
+    __slots__ = ("owner", "pages", "length")
+
+    def __init__(self, owner: str):
+        self.owner = owner
+        self.pages: list[int] = []
+        self.length = 0
+
+
+class PagedKVCache:
+    """Fixed-size page pool + per-owner page tables (thread-safe: the
+    engine thread appends while actor threads open/abort/inspect)."""
+
+    def __init__(self, num_pages: int, page_size: int, width: int,
+                 name: str = "kv", backend: str = "numpy"):
+        if num_pages < 1 or page_size < 1 or width < 1:
+            raise ValueError("num_pages, page_size and width must be >= 1")
+        self.name = name
+        self.num_pages = num_pages
+        self.page_size = page_size
+        self.width = width
+        self.backend = backend
+        nbytes = num_pages * page_size * width * 4
+        if backend == "jax":
+            import jax.numpy as jnp
+
+            self._pages = jnp.zeros((num_pages, page_size, width),
+                                    dtype=jnp.float32)
+            self._donated_update = _make_donated_update()
+        else:
+            raw = _alloc_arena(name, nbytes)
+            self._pages = np.frombuffer(
+                raw, dtype=np.float32,
+                count=num_pages * page_size * width).reshape(
+                    num_pages, page_size, width)
+        self._free: list[int] = list(range(num_pages - 1, -1, -1))
+        self._tables: dict[str, PageTable] = {}
+        self._lock = threading.Lock()
+        with _pools_lock:
+            _live_pools[id(self)] = self
+
+    # -- allocation ------------------------------------------------------
+
+    def alloc_table(self, owner: str) -> PageTable:
+        with self._lock:
+            if owner in self._tables:
+                raise ValueError(f"owner {owner!r} already has a table")
+            t = self._tables[owner] = PageTable(owner)
+        return t
+
+    def has(self, owner: str) -> bool:
+        return owner in self._tables
+
+    def adopt(self, old_owner: str, new_owner: str) -> int:
+        """Re-key a table (session cache -> live sequence and back).
+        Returns the token length carried over."""
+        with self._lock:
+            t = self._tables.pop(old_owner)
+            t.owner = new_owner
+            self._tables[new_owner] = t
+            return t.length
+
+    def _alloc_page(self) -> int:
+        # under self._lock
+        if _fp.ARMED:
+            _fp.fire_strict("serve.kv_page_alloc")
+        if not self._free:
+            raise KVCacheExhausted(self.name, self.num_pages)
+        page = self._free.pop()
+        M_KV_PAGES.add(1)
+        return page
+
+    def append(self, owner: str, vectors) -> None:
+        """Write `vectors` ((T, width) float32) as the owner's next T
+        token rows, allocating pages on demand. Raises KVCacheExhausted
+        with the table intact (already-written rows stay valid) when the
+        pool runs dry — the caller aborts/sheds and frees."""
+        vectors = np.asarray(vectors, dtype=np.float32)
+        if vectors.ndim == 1:
+            vectors = vectors[None, :]
+        with self._lock:
+            t = self._tables[owner]
+            for row in vectors:
+                slot = t.length % self.page_size
+                if slot == 0:
+                    t.pages.append(self._alloc_page())
+                page = t.pages[-1]
+                if self.backend == "jax":
+                    self._pages = self._donated_update(
+                        self._pages, page, slot, row)
+                else:
+                    self._pages[page, slot] = row
+                t.length += 1
+
+    def gather_sum(self, owner: str):
+        """Sum of the owner's cached token rows ((width,) float32) — the
+        read path of the reference model's decode step (page-table
+        indirection: full pages summed whole, the tail page masked)."""
+        with self._lock:
+            t = self._tables[owner]
+            out = np.zeros(self.width, dtype=np.float32)
+            if not t.pages:
+                return out
+            pages = (np.asarray(self._pages) if self.backend == "jax"
+                     else self._pages)
+            full, tail = divmod(t.length, self.page_size)
+            for page in t.pages[:full]:
+                out += pages[page].sum(axis=0)
+            if tail:
+                out += pages[t.pages[full]][:tail].sum(axis=0)
+            return out
+
+    def truncate(self, owner: str, length: int) -> int:
+        """Drop the owner's rows past `length` (freeing now-empty tail
+        pages); returns pages freed. Deterministic from the same
+        arithmetic on every rank — the warm-session shed path restores
+        an adopted prefix to exactly its pre-admission state."""
+        import math
+
+        freed = 0
+        with self._lock:
+            t = self._tables[owner]
+            if length >= t.length:
+                return 0
+            keep = math.ceil(length / self.page_size)
+            tail = t.pages[keep:]
+            del t.pages[keep:]
+            self._free.extend(reversed(tail))
+            t.length = length
+            freed = len(tail)
+        if freed:
+            M_KV_PAGES.add(-freed)
+        return freed
+
+    def length(self, owner: str) -> int:
+        with self._lock:
+            t = self._tables.get(owner)
+            return t.length if t else 0
+
+    def free(self, owner: str) -> int:
+        """Return every page of `owner` to the pool; returns the count
+        (0 for an unknown owner — free is idempotent: abort paths race
+        finish paths and must both be safe to run)."""
+        with self._lock:
+            t = self._tables.pop(owner, None)
+            if t is None:
+                return 0
+            n = len(t.pages)
+            self._free.extend(reversed(t.pages))
+            t.pages.clear()
+        if n:
+            M_KV_PAGES.add(-n)
+        return n
+
+    def free_all(self) -> int:
+        with self._lock:
+            owners = list(self._tables)
+        return sum(self.free(o) for o in owners)
+
+    def close(self):
+        self.free_all()
+        with _pools_lock:
+            _live_pools.pop(id(self), None)
+
+    # -- introspection ---------------------------------------------------
+
+    def pages_in_use(self) -> int:
+        with self._lock:
+            return self.num_pages - len(self._free)
+
+    def owners(self) -> dict[str, int]:
+        """owner -> page count (the per-session page-count rows of
+        `ray-tpu state serve` / the dashboard)."""
+        with self._lock:
+            return {o: len(t.pages) for o, t in self._tables.items()}
+
+    def leak_report(self, live_owners) -> list[dict]:
+        """Tables whose owner is NOT in `live_owners` (live sequences +
+        retained sessions): by construction the engine frees on finish/
+        abort, so anything here is a leaked-page bug the conftest sweep
+        names."""
+        live = set(live_owners)
+        with self._lock:
+            return [{"owner": o, "pages": len(t.pages),
+                     "tokens": t.length}
+                    for o, t in self._tables.items()
+                    if o not in live and t.pages]
+
+    def debug_state(self) -> dict:
+        with self._lock:
+            return {
+                "name": self.name,
+                "backend": self.backend,
+                "pages_total": self.num_pages,
+                "pages_in_use": self.num_pages - len(self._free),
+                "page_size": self.page_size,
+                "width": self.width,
+                "owners": {o: len(t.pages)
+                           for o, t in self._tables.items()},
+            }
+
+
+def _make_donated_update():
+    """Jitted single-row page write with the arena DONATED: XLA reuses
+    the input buffer for the output, so the per-token update is in-place
+    instead of an O(arena) copy (the jax path of `append`)."""
+    import jax
+
+    def _update(pages, page, slot, row):
+        return pages.at[page, slot].set(row)
+
+    return jax.jit(_update, donate_argnums=(0,),
+                   static_argnums=())

@@ -34,3 +34,36 @@ M_ZERO_COPY_BYTES_TOTAL = _stats.Count(
     "serve.zero_copy_bytes_total",
     "request/response body bytes that rode plasma + the bulk channel as "
     "ObjectRefs instead of being pickled through the router")
+
+# -- streaming inference tier (continuous batching / paged KV-cache) -----
+
+M_TOKENS_TOTAL = _stats.Count(
+    "serve.tokens_total",
+    "tokens emitted by decode engines in this process (the streaming "
+    "tier's goodput counter; tokens/s = delta over the metrics history)")
+
+M_TTFT_S = _stats.Histogram(
+    "serve.ttft_s", _stats.LATENCY_BOUNDARIES_S,
+    "sequence admission -> first emitted token (engine side): the "
+    "latency continuous batching decouples from total generation time")
+
+M_DECODE_BATCH = _stats.Gauge(
+    "serve.decode_batch_size",
+    "running sequences in this process's decode engine batch (occupancy "
+    "of the token-level scheduler; waiting sequences are not counted)")
+
+M_DECODE_STEP_S = _stats.Histogram(
+    "serve.decode_step_s", _stats.LATENCY_BOUNDARIES_S,
+    "one decode step: batch assembly + (gang fan-out +) forward + "
+    "allreduce + token append/emit (the stall doctor's decode stage)")
+
+M_KV_PAGES = _stats.Gauge(
+    "serve.kv_pages_in_use",
+    "allocated KV-cache pages across this process's page pools (moves "
+    "with every alloc/free; sequence finish/abort must return it)")
+
+M_SESSIONS_EVICTED_TOTAL = _stats.Count(
+    "serve.sessions_evicted_total",
+    "session KV-cache entries evicted (LRU past session_cache_max): the "
+    "evicted session's next turn opens COLD — stream_open reports "
+    "session_cached=false and the client must resend full history")

@@ -10,7 +10,9 @@ import time
 
 import cloudpickle
 
+from ray_tpu._private import failpoints as _fp
 from ray_tpu._private import stats as _stats
+from ray_tpu.serve.engine import StreamingEngineHost
 
 M_REPLICA_EXEC_S = _stats.Histogram(
     "serve.replica_exec_s", _stats.LATENCY_BOUNDARIES_S,
@@ -29,13 +31,17 @@ def accept_batch(fn):
     return fn
 
 
-class Replica:
-    """Hosts one copy of the user's callable."""
+class Replica(StreamingEngineHost):
+    """Hosts one copy of the user's callable — and, for streaming
+    backends, an unsharded decode engine (allreduce = identity): the
+    continuous-batching tier doesn't require sharding."""
 
     def __init__(self, pickled_callable: bytes, init_args: tuple,
                  user_config: dict | None,
-                 large_payload_threshold: int = 0):
+                 large_payload_threshold: int = 0,
+                 config: dict | None = None):
         self._threshold = large_payload_threshold
+        self._backend_name = (config or {}).get("_backend_name") or ""
         target = cloudpickle.loads(pickled_callable)
         if inspect.isclass(target):
             self._callable = target(*init_args)
@@ -50,8 +56,18 @@ class Replica:
             reconfigure = getattr(self._callable, "reconfigure", None)
             if reconfigure:
                 reconfigure(user_config)
+        self._streaming = bool((config or {}).get("streaming"))
+        if self._streaming:
+            self._start_engine(self._callable, config or {},
+                               self._backend_name)
         self._batches_handled = 0
         self._last_batch_at = 0.0
+
+    def arm_failpoint(self, name: str, action: str, **kw):
+        """Test hook: arm a failpoint in THIS replica's process (chaos
+        picks one victim; env arming would fire in every replica)."""
+        _fp.arm(name, action, **kw)
+        return True
 
     def reconfigure(self, user_config: dict):
         fn = getattr(self._callable, "reconfigure", None)
@@ -67,6 +83,14 @@ class Replica:
         or over the threshold ride plasma back the same way."""
         from ray_tpu.serve import payload as _payload
 
+        if self._streaming:
+            # the decode loop owns this replica's compute (and, sharded,
+            # its collective op stream): request/response callers go
+            # through the stream API instead of racing it
+            raise RuntimeError(
+                "streaming backend: use the stream API "
+                "(handle.stream(...) / SSE through the proxy), not "
+                "request/response dispatch")
         # wrap responses only for callers speaking the zero-copy
         # protocol (the HTTP proxy): a plain handle.remote() caller gets
         # values, never markers
@@ -97,8 +121,12 @@ class Replica:
 
     def __ray_debug_state__(self) -> dict:
         """Live-state hook (debug_state.py)."""
-        return {"kind": "serve-replica",
-                "batches_handled": self._batches_handled,
-                "last_batch_age_s": (round(time.time()
-                                           - self._last_batch_at, 3)
-                                     if self._last_batch_at else None)}
+        out = {"kind": "serve-replica",
+               "backend": self._backend_name,
+               "batches_handled": self._batches_handled,
+               "last_batch_age_s": (round(time.time()
+                                          - self._last_batch_at, 3)
+                                    if self._last_batch_at else None)}
+        if self._engine is not None:
+            out["engine"] = self._engine.debug_state()
+        return out

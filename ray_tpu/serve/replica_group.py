@@ -39,6 +39,7 @@ from ray_tpu._private import failpoints as _fp
 from ray_tpu._private import stats as _stats
 from ray_tpu.collective.collective import CollectiveActorMixin
 from ray_tpu.serve import payload as _payload
+from ray_tpu.serve.engine import StreamingEngineHost
 
 M_GROUP_EXEC_S = _stats.Histogram(
     "serve.group_exec_s", _stats.LATENCY_BOUNDARIES_S,
@@ -96,19 +97,26 @@ class ShardedMLP:
 # ---------------------------------------------------------------------------
 
 
-class ReplicaGroupMember(CollectiveActorMixin):
+class ReplicaGroupMember(CollectiveActorMixin, StreamingEngineHost):
     """One shard of a replica group. Rank 0 is the LEADER: it is the
     handle the router dispatches to; `handle_batch` there drives the
     collective forward. Ranks 1..N-1 only ever see `shard_exec` pushes
     from their leader (actor-call ordering from one caller keeps every
     rank's op sequence aligned, so the allreduces pair up without a
-    sequence protocol)."""
+    sequence protocol).
+
+    Streaming backends (`streaming=True`) host the continuous-batching
+    decode engine instead: the LEADER runs the scheduler + decode loop
+    (started in set_peers, once the gang exists), followers run mirror
+    engines driven one `decode_step_exec` per step — the Megatron gang
+    forward becomes one *step*, not the whole request."""
 
     def __init__(self, pickled_callable: bytes, init_args: tuple,
                  user_config: dict | None, backend: str, group_name: str,
                  world_size: int, rank: int,
                  large_payload_threshold: int = 0,
-                 group_timeout_s: float = 10.0):
+                 group_timeout_s: float = 10.0,
+                 config: dict | None = None):
         target = cloudpickle.loads(pickled_callable)
         inst = target(*init_args) if inspect.isclass(target) else target
         shard = getattr(inst, "shard", None)
@@ -129,17 +137,42 @@ class ReplicaGroupMember(CollectiveActorMixin):
         self._rank = rank
         self._threshold = large_payload_threshold
         self._group_timeout_s = group_timeout_s
+        self._config = dict(config or {})
+        self._streaming = bool(self._config.get("streaming"))
         self._peers: list = []
         self._batches_handled = 0
         self._last_batch_at = 0.0
+        if self._streaming and rank > 0:
+            # follower mirror: same KV shard + model, no scheduler —
+            # the leader drives it one decode_step_exec per step
+            self._start_engine(self._callable, self._config, backend,
+                               allreduce=self._group_allreduce,
+                               driver=False)
+
+    def _group_allreduce(self, arr):
+        from ray_tpu.collective import collective as col
+
+        return col.allreduce(arr, self._group_name)
 
     # -- controller wiring ----------------------------------------------
 
     def set_peers(self, peers: list):
         """Leader only: handles of ranks 1..N-1, set once the collective
-        group is bootstrapped."""
+        group is bootstrapped. For streaming backends this is also where
+        the decode engine starts — the gang is whole from here on."""
         self._peers = list(peers)
+        if self._streaming and self._engine is None:
+            self._start_engine(self._callable, self._config,
+                               self._backend,
+                               allreduce=self._group_allreduce,
+                               peers=self._peers, driver=True)
         return True
+
+    def decode_step_exec(self, plan: dict):
+        """Follower entry, one call per decode step: replay the
+        leader's step plan on this rank's KV shard (joins the step's
+        allreduce; the plan keeps every rank's state identical)."""
+        return self._require_engine().apply_plan(plan)
 
     def ping(self):
         return "pong"
@@ -187,6 +220,11 @@ class ReplicaGroupMember(CollectiveActorMixin):
         from ray_tpu.collective import collective as col
         from ray_tpu import exceptions as exc
 
+        if self._streaming:
+            raise RuntimeError(
+                "streaming backend: use the stream API "
+                "(handle.stream(...) / SSE through the proxy), not "
+                "request/response dispatch")
         start = time.time()
         # own partial FIRST: a leader-side user error (bad input) raises
         # plainly before any follower was involved — no gang restart
@@ -259,7 +297,7 @@ class ReplicaGroupMember(CollectiveActorMixin):
         return ""
 
     def __ray_debug_state__(self) -> dict:
-        return {
+        out = {
             "kind": "serve-replica-group-member",
             "backend": self._backend,
             "group": self._group_name,
@@ -269,6 +307,9 @@ class ReplicaGroupMember(CollectiveActorMixin):
             "last_batch_age_s": (round(time.time() - self._last_batch_at, 3)
                                  if self._last_batch_at else None),
         }
+        if self._engine is not None:
+            out["engine"] = self._engine.debug_state()
+        return out
 
 
 # ---------------------------------------------------------------------------
@@ -319,7 +360,7 @@ def spawn_replica_group(backend: str, pickled_callable: bytes,
                 pickled_callable, init_args, config.get("user_config"),
                 backend, group_name, n, rank,
                 int(config.get("large_payload_threshold") or 0),
-                timeout_s))
+                timeout_s, dict(config)))
         create_collective_group(
             members, n, list(range(n)), backend="host",
             group_name=group_name, timeout=timeout_s,

@@ -45,6 +45,14 @@ def debug_routers() -> list[dict]:
     return out
 
 
+def _parse_session(data):
+    """(prompt, max_tokens, session, stream?) — the engine's request
+    schema; the router only needs the session key for affinity."""
+    from ray_tpu.serve.engine import parse_stream_request
+
+    return parse_stream_request(data)
+
+
 class _PendingQuery:
     __slots__ = ("data", "event", "ref", "error", "abandoned", "loop",
                  "future", "want_result", "trace", "t_enqueue")
@@ -100,6 +108,13 @@ class Router:
         self._lock = threading.Lock()
         self._queue: list[_PendingQuery] = []
         self._inflight: dict[bytes, int] = {}   # actor_id -> live batches
+        # streaming tier: sticky session -> replica actor key, plus live
+        # open-stream accounting (streams hold an _inflight slot for
+        # their whole life, not one batch)
+        self._sessions: dict[str, bytes] = {}
+        self._streams_open = 0
+        self._affinity_hits = 0
+        self._affinity_misses = 0
         self._state = None
         self._state_time = 0.0
         self._shed_total = 0
@@ -129,6 +144,10 @@ class Router:
             "max_queued": maxq or 0,
             "shed_total": self._shed_total,
             "admitted_total": self._admitted_total,
+            "streams_open": self._streams_open,
+            "sessions": len(self._sessions),
+            "affinity_hits": self._affinity_hits,
+            "affinity_misses": self._affinity_misses,
             "oldest_age_s": (round(max(now - q.t_enqueue
                                        for q in queue), 3)
                              if queue else 0.0),
@@ -288,6 +307,169 @@ class Router:
             # dispatches and its orphaned future collects exception spam
             self._abandon(q)
             raise
+
+    # -- streaming (continuous-batching backends) ------------------------
+
+    def _pick_stream_replica(self, state: dict, backend: str,
+                             session: str | None):
+        """Session-affinity pick: a sticky session key routes to the
+        replica already holding that session's KV pages; cold sessions
+        (and sessions whose replica vanished — gang restart, downscale)
+        fall back to least-loaded and re-stick there."""
+        st = state["backends"].get(backend)
+        if st is None or not st["replicas"]:
+            return None
+        with self._lock:
+            if session:
+                want = self._sessions.get(session)
+                if want is not None:
+                    for handle in st["replicas"]:
+                        if handle._actor_id.binary() == want:
+                            self._affinity_hits += 1
+                            return handle
+            best, best_load = None, None
+            for handle in st["replicas"]:
+                load = self._inflight.get(handle._actor_id.binary(), 0)
+                if best_load is None or load < best_load:
+                    best, best_load = handle, load
+            if session and best is not None:
+                self._affinity_misses += 1
+                self._sessions[session] = best._actor_id.binary()
+                while len(self._sessions) > 4096:  # bounded stick table
+                    self._sessions.pop(next(iter(self._sessions)))
+        return best
+
+    async def stream_async(self, data, timeout: float = 60.0):
+        """Async generator of token chunks from a streaming backend:
+        open a sequence on the affine replica, long-poll its channel,
+        yield each chunk as it lands. `timeout` bounds time WITHOUT
+        progress (admission included), not total generation.
+
+        Accounting (the long-lived-request fix): the stream holds the
+        queued gauge only until the sequence is admitted, then one
+        in-flight slot on its replica until it ends — and the ABANDON
+        path (caller cancelled / disconnected mid-stream) aborts the
+        remote sequence so its KV pages free, then returns both gauges,
+        exactly like a one-shot query's withdraw."""
+        import asyncio
+
+        from ray_tpu import exceptions as exc
+
+        state = self._state
+        backend = self._pick_backend(state) if state else None
+        if backend is None or backend not in state.get("backends", {}):
+            raise RuntimeError(
+                f"no backend serving endpoint {self._endpoint!r}")
+        cfg = state["backends"][backend]["config"]
+        if not cfg.get("streaming"):
+            raise RuntimeError(
+                f"backend {backend!r} is not a streaming backend "
+                f"(deploy with BackendConfig(streaming=True))")
+        poll_s = float(cfg.get("stream_poll_s") or 2.0)
+        _, _, session, _ = _parse_session(data)
+        deadline = time.monotonic() + timeout
+        replica = None
+        while replica is None:
+            replica = self._pick_stream_replica(state, backend, session)
+            if replica is None:
+                # gang restarting / replicas scaling: wait for cutover
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"no replica for {backend!r} within {timeout}s")
+                await asyncio.sleep(0.05)
+                state = self._state
+        key = replica._actor_id.binary()
+        with self._lock:
+            self._inflight[key] = self._inflight.get(key, 0) + 1
+        M_ROUTER_QUEUED.add(1)
+        queued = True
+        opened = False
+        seq_id = None
+        finished = False
+        try:
+            try:
+                reply = await replica.stream_open.remote(data)
+            except BaseException as e:
+                if isinstance(e, exc.ServeOverloadedError):
+                    with self._lock:
+                        self._shed_total += 1
+                    M_SHED_TOTAL.inc()
+                    raise
+                raise self._map_group_error(e, cfg) from None
+            seq_id = reply["seq"]
+            M_ROUTER_QUEUED.add(-1)
+            queued = False
+            opened = True
+            M_ADMITTED_TOTAL.inc()  # admitted = the engine accepted it
+            with self._lock:
+                self._admitted_total += 1
+                self._streams_open += 1
+            # meta chunk first: session-cache hit/miss is part of the
+            # stream contract (a delta-prompt client must resend full
+            # history on a miss — see stream_open)
+            yield {"meta": {"seq": seq_id,
+                            "session_cached": reply.get(
+                                "session_cached", False)},
+                   "tokens": [], "cursor": 0, "done": False}
+            cursor = 0
+            deadline = time.monotonic() + timeout
+            while True:
+                try:
+                    chunk = await replica.stream_next.remote(
+                        seq_id, cursor, poll_s)
+                except BaseException as e:
+                    raise self._map_group_error(e, cfg) from None
+                if chunk["tokens"]:
+                    cursor = chunk["cursor"]
+                    deadline = time.monotonic() + timeout  # progress
+                    yield chunk
+                if chunk["done"]:
+                    finished = True
+                    err = chunk.get("error")
+                    if err is not None:
+                        if isinstance(err, exc.ServeOverloadedError):
+                            # engine-side shed (KV pool / prefill): the
+                            # 503 must move the shed counters even
+                            # though stream_open itself succeeded
+                            with self._lock:
+                                self._shed_total += 1
+                            M_SHED_TOTAL.inc()
+                        raise self._map_group_error(err, cfg)
+                    return
+                if time.monotonic() > deadline:
+                    finished = True  # we abort it: not abandoned
+                    await self._abort_stream(replica, seq_id,
+                                             "stream idle timeout")
+                    raise TimeoutError(
+                        f"stream {seq_id} made no progress within "
+                        f"{timeout}s")
+        finally:
+            if queued:
+                M_ROUTER_QUEUED.add(-1)
+            with self._lock:
+                self._inflight[key] -= 1
+                if opened:
+                    self._streams_open -= 1
+            if opened and not finished:
+                # abandon path: caller cancelled / client disconnected
+                # mid-stream — abort the sequence so the engine frees
+                # its KV pages (fire-and-forget on the caller's loop;
+                # we cannot await inside GeneratorExit)
+                try:
+                    asyncio.get_running_loop().create_task(
+                        self._abort_stream(replica, seq_id,
+                                           "client disconnect"))
+                except RuntimeError:
+                    pass  # caller's loop is gone; the engine's stream
+                    # reaper and gang teardown bound the leak
+            self._wake.set()
+
+    @staticmethod
+    async def _abort_stream(replica, seq_id: str, reason: str):
+        try:
+            await replica.stream_abort.remote(seq_id, reason)
+        except Exception:
+            pass  # replica already dead: pages died with it
 
     def _abandon(self, q: _PendingQuery):
         """Caller gave up (timeout / client disconnect). While still
@@ -598,6 +780,59 @@ class ServeHandle:
 
     def remote(self, data=None):
         return self._router.assign(data)
+
+    def stream(self, data=None, timeout: float = 60.0):
+        """Sync token generator over a streaming backend: bridges the
+        router's async stream onto a private loop thread so plain
+        callers iterate tokens as they decode. Abandoning the generator
+        mid-stream cancels the async side, which aborts the remote
+        sequence (KV pages free) — same contract as an HTTP client
+        disconnecting."""
+        import asyncio
+        import queue as _queue
+
+        out: _queue.Queue = _queue.Queue()
+        holder: dict = {}
+
+        def run():
+            async def go():
+                holder["task"] = asyncio.current_task()
+                try:
+                    async for chunk in self._router.stream_async(
+                            data, timeout=timeout):
+                        out.put(("tokens", chunk["tokens"]))
+                except asyncio.CancelledError:
+                    out.put(("done", None))
+                    raise
+                except BaseException as e:
+                    out.put(("error", e))
+                    return
+                out.put(("done", None))
+
+            try:
+                asyncio.run(go())
+            except BaseException:
+                pass
+            holder["loop_done"] = True
+
+        thread = threading.Thread(target=run, daemon=True)
+        thread.start()
+        try:
+            while True:
+                kind, val = out.get()
+                if kind == "tokens":
+                    yield from val
+                elif kind == "error":
+                    raise val
+                else:
+                    return
+        finally:
+            task = holder.get("task")
+            if task is not None and not holder.get("loop_done"):
+                try:
+                    task.get_loop().call_soon_threadsafe(task.cancel)
+                except RuntimeError:
+                    pass
 
     def __repr__(self):
         return f"ServeHandle({self.endpoint!r})"

@@ -230,6 +230,17 @@ def _leak_notes(leaked_pids: dict, leaked_segs: set) -> str:
                     f"/{comp.get('world_size')} of backend "
                     f"{comp.get('backend')!r} on {label} "
                     f"(group {comp.get('group')})")
+            # streaming tier: KV pages whose owner sequence is gone are
+            # a leak named per owner (the chaos sweeps' zero-leaked-
+            # pages invariant reads from the same snapshot)
+            eng = comp.get("engine") or {}
+            for leak in eng.get("kv_leaked") or []:
+                notes.append(
+                    f"  leaked KV pages on {label} (backend "
+                    f"{eng.get('backend')!r}): owner {leak.get('owner')} "
+                    f"holds {leak.get('pages')} page(s) / "
+                    f"{leak.get('tokens')} token(s) with no live "
+                    f"sequence or session")
     except Exception:
         return ""
     if not notes:

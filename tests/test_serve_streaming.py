@@ -1,0 +1,784 @@
+"""Streaming inference tier (ISSUE 12 / ROADMAP item 1): token-level
+continuous batching, paged KV-cache, SSE end-to-end, session affinity.
+
+Tier-1: bit-exact continuous-batching decode vs request-level batching
+(and vs the unsharded reference) with interleaved admission and early
+retire, paged KV accounting incl. the jax donated-update backend,
+typed sheds and aborts with honest router/engine bookkeeping, the SSE
+round trip through the proxy, session-affinity hit/miss routing, state
+introspection + doctor rows, and the recorded serve_stream bench gate.
+
+Chaos (`pytest -m chaos`): seeded member-kill-mid-decode sweep — every
+open stream terminates with typed ReplicaGroupDied within the group
+timeout, zero KV pages leak, the gang restarts and fresh streams
+decode bit-exact."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import exceptions as exc
+from ray_tpu import serve
+from ray_tpu.serve.engine import DecodeEngine, ShardedTokenLM
+from ray_tpu.serve.kv_cache import KVCacheExhausted, PagedKVCache
+from ray_tpu.serve.streaming import TokenChannel, iter_sse_lines, sse_event
+from tests.conftest import scale_timeout, state_dump_on_failure
+
+
+def _model_args(seed: int, **kw):
+    m = ShardedTokenLM.make(seed, **kw)
+    return m.embed.copy(), m.w_up.copy(), m.w_out.copy()
+
+
+def _drain(channel: TokenChannel, timeout: float) -> list[int]:
+    """Read a channel to completion, re-raising its terminal error."""
+    deadline = time.monotonic() + timeout
+    toks, cur = [], 0
+    while True:
+        chunk = channel.wait(cur, 0.5)
+        toks.extend(chunk["tokens"])
+        cur = chunk["cursor"]
+        if chunk["done"]:
+            if chunk["error"] is not None:
+                raise chunk["error"]
+            return toks
+        assert time.monotonic() < deadline, "channel never finished"
+
+
+@pytest.fixture
+def serve_client(ray_start_shared):
+    client = serve.start()
+    try:
+        yield client
+    finally:
+        client.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# engine unit tier (no cluster): scheduler + paged cache semantics
+# ---------------------------------------------------------------------------
+
+
+def test_engine_bit_exact_interleaved_and_early_retire():
+    """In-process engine: sequences admitted at different times into
+    the RUNNING batch produce exactly the reference model's tokens, and
+    a short sequence retires (pages freed) while a long one decodes."""
+    eng = DecodeEngine(ShardedTokenLM.make(3),
+                       {"max_decode_batch": 4, "kv_page_size": 4,
+                        "kv_pages_total": 64}, "unit")
+    try:
+        long_id = eng.submit([3, 5, 9], 40)
+        time.sleep(0.02)  # long seq is mid-generation...
+        short_id = eng.submit([1, 2], 5)  # ...when the short one joins
+        short = _drain(eng.channel(short_id), scale_timeout(20))
+        # early retire: short finished while long still running
+        long_ch = eng.channel(long_id)
+        assert not long_ch.done or len(long_ch.tokens) == 40
+        assert eng._kv.has(long_id) or long_ch.done
+        assert not eng._kv.has(short_id), "retired seq kept pages"
+        long_toks = _drain(long_ch, scale_timeout(30))
+        ref = ShardedTokenLM.make(3)
+        assert short == ref.generate([1, 2], 5)
+        assert long_toks == ShardedTokenLM.make(3).generate([3, 5, 9], 40)
+        assert eng._kv.pages_in_use() == 0
+        assert eng.debug_state()["kv_leaked"] == []
+    finally:
+        eng.close()
+
+
+def test_engine_matches_lockstep_request_level_batch():
+    """The A/B pin, engine-free half: generate_batch (request-level
+    lockstep) row outputs == generate == what the engine streams."""
+    ref = ShardedTokenLM.make(9)
+    prompts = [[1, 3, 5], [2, 4], [6], [7, 7, 7]]
+    maxs = [6, 11, 17, 29]
+    batch_out = ref.generate_batch(prompts, maxs)
+    for p, mt, got in zip(prompts, maxs, batch_out):
+        assert got == ShardedTokenLM.make(9).generate(p, mt)
+
+
+def test_engine_shed_typed_when_waiting_full():
+    """Admission past max_waiting_sequences sheds with the typed
+    ServeOverloadedError (deterministic: a delay failpoint pins the
+    decode loop while the queue fills)."""
+    from ray_tpu._private import failpoints as _fp
+
+    _fp.arm("serve.decode_step", "delay", ms=400)
+    eng = DecodeEngine(ShardedTokenLM.make(3),
+                       {"max_decode_batch": 1, "max_waiting_sequences": 1,
+                        "overload_retry_after_s": 2.5}, "shed")
+    try:
+        first = eng.submit([1], 50)   # admitted into the (slow) batch
+        deadline = time.monotonic() + scale_timeout(10)
+        while eng.debug_state()["decode_batch"] == 0:
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+        eng.submit([2], 50)           # fills the 1-deep waiting queue
+        with pytest.raises(exc.ServeOverloadedError) as ei:
+            eng.submit([3], 50)
+        assert ei.value.retry_after_s == 2.5
+        eng.abort(first, "test done")
+    finally:
+        _fp.reset()
+        eng.close()
+
+
+def test_engine_abort_frees_pages_and_finishes_typed():
+    """abort() mid-generation finishes the channel with typed
+    SequenceAborted and returns every page to the pool."""
+    from ray_tpu._private import failpoints as _fp
+
+    _fp.arm("serve.decode_step", "delay", ms=50)
+    eng = DecodeEngine(ShardedTokenLM.make(3),
+                       {"max_decode_batch": 2, "kv_pages_total": 64},
+                       "abort")
+    try:
+        sid = eng.submit([3, 5, 9], 500)
+        ch = eng.channel(sid)
+        ch.wait(0, scale_timeout(10))  # at least one token out
+        assert eng.abort(sid, "client disconnect")
+        with pytest.raises(exc.SequenceAborted):
+            _drain(ch, scale_timeout(10))
+        deadline = time.monotonic() + scale_timeout(10)
+        while eng._kv.pages_in_use():
+            assert time.monotonic() < deadline, "abort leaked KV pages"
+            time.sleep(0.02)
+    finally:
+        _fp.reset()
+        eng.close()
+
+
+def test_engine_session_cache_reuse_and_eviction():
+    """Finished session-keyed sequences retain their KV table (next
+    turn adopts the prefix instead of re-prefilling); LRU eviction past
+    session_cache_max frees pages and counts."""
+    eng = DecodeEngine(ShardedTokenLM.make(3),
+                       {"max_decode_batch": 2, "session_cache_max": 1,
+                        "kv_page_size": 4, "kv_pages_total": 64}, "sess")
+    try:
+        t1 = _drain(eng.channel(eng.submit([3, 5], 4, session="a")),
+                    scale_timeout(20))
+        info = eng.session_info("a")
+        assert info["cached"] and info["tokens"] == 2 + len(t1)
+        # turn 2 adopts the cached prefix: tokens == reference decode of
+        # the FULL history (turn-1 prompt + turn-1 output + new prompt)
+        t2 = _drain(eng.channel(eng.submit([7], 4, session="a")),
+                    scale_timeout(20))
+        ref = ShardedTokenLM.make(3)
+        ref_hist = ref.generate([3, 5] + t1 + [7], 4)
+        assert t2 == ref_hist
+        # a second session evicts the first (session_cache_max=1)
+        _drain(eng.channel(eng.submit([1], 3, session="b")),
+               scale_timeout(20))
+        assert not eng.session_info("a")["cached"]
+        assert eng.debug_state()["sessions_evicted"] >= 1
+    finally:
+        eng.close()
+
+
+def test_kv_cache_truncate_restores_prefix():
+    """truncate() drops rows past a length and frees emptied tail
+    pages — the warm-session shed path's restore primitive."""
+    kv = PagedKVCache(num_pages=4, page_size=2, width=3, name="trunc")
+    try:
+        kv.alloc_table("s")
+        kv.append("s", np.ones((5, 3), dtype=np.float32))   # 3 pages
+        kv.append("s", 2 * np.ones((1, 3), dtype=np.float32))
+        assert kv.pages_in_use() == 3 and kv.length("s") == 6
+        assert kv.truncate("s", 5) == 0   # tail page still half-used
+        assert kv.gather_sum("s").tolist() == [5.0] * 3
+        assert kv.truncate("s", 2) == 2   # pages 2+3 freed
+        assert kv.pages_in_use() == 1 and kv.length("s") == 2
+        assert kv.gather_sum("s").tolist() == [2.0] * 3
+    finally:
+        kv.close()
+
+
+def test_engine_warm_session_shed_preserves_cache():
+    """A warm-session turn shed at admission (KV pool exhausted) must
+    restore the adopted prefix to the session key intact — a retryable
+    503 never destroys session state."""
+    eng = DecodeEngine(ShardedTokenLM.make(3),
+                       {"max_decode_batch": 2, "kv_page_size": 2,
+                        "kv_pages_total": 8}, "warm")
+    try:
+        t1 = _drain(eng.channel(eng.submit([3, 5], 4, session="a")),
+                    scale_timeout(20))
+        cached = eng.session_info("a")["tokens"]
+        assert cached == 2 + len(t1)
+        # hog the rest of the pool so the next turn's prompt append
+        # exhausts mid-admission
+        hog = eng._kv
+        hog.alloc_table("hog")
+        while True:
+            try:
+                hog.append("hog", np.zeros((1, eng._kv.width),
+                                           dtype=np.float32))
+            except KVCacheExhausted:
+                break
+        sid = eng.submit(list(range(8)), 4, session="a")
+        with pytest.raises(exc.ServeOverloadedError):
+            _drain(eng.channel(sid), scale_timeout(20))
+        info = eng.session_info("a")
+        assert info["cached"] and info["tokens"] == cached, info
+        # retry after pressure clears: adopts the intact prefix
+        hog.free("hog")
+        t2 = _drain(eng.channel(eng.submit([7], 4, session="a")),
+                    scale_timeout(20))
+        assert t2 == ShardedTokenLM.make(3).generate([3, 5] + t1 + [7], 4)
+    finally:
+        eng.close()
+
+
+def test_kv_cache_paging_exhaustion_and_leak_report():
+    """Page-table arithmetic: multi-page growth, typed exhaustion with
+    the table intact, idempotent frees, leak_report naming."""
+    kv = PagedKVCache(num_pages=3, page_size=2, width=4, name="unit")
+    try:
+        kv.alloc_table("a")
+        kv.append("a", np.ones((5, 4), dtype=np.float32))  # 3 pages
+        assert kv.pages_in_use() == 3 and kv.length("a") == 5
+        assert kv.gather_sum("a").tolist() == [5.0] * 4
+        kv.alloc_table("b")
+        with pytest.raises(KVCacheExhausted):
+            kv.append("b", np.ones((1, 4), dtype=np.float32))
+        assert kv.length("a") == 5  # intact
+        report = kv.leak_report(live_owners=["b"])
+        assert report and report[0]["owner"] == "a"
+        assert kv.free("a") == 3 and kv.free("a") == 0
+        assert kv.pages_in_use() == 0
+        assert kv.leak_report(live_owners=[]) == []
+    finally:
+        kv.close()
+
+
+def test_kv_cache_jax_donated_update_matches_numpy():
+    """The jax backend's page update is a jitted donated write: same
+    gather_sum as the numpy pool for the same appends."""
+    rows = np.arange(12, dtype=np.float32).reshape(3, 4)
+    pools = [PagedKVCache(4, 2, 4, name=f"ab-{b}", backend=b)
+             for b in ("numpy", "jax")]
+    try:
+        for kv in pools:
+            kv.alloc_table("s")
+            kv.append("s", rows[:2])
+            kv.append("s", rows[2])
+        a, b = (kv.gather_sum("s") for kv in pools)
+        assert a.tolist() == b.tolist()
+        assert [kv.pages_in_use() for kv in pools] == [2, 2]
+    finally:
+        for kv in pools:
+            kv.close()
+
+
+def test_sse_framing_roundtrip_unit():
+    frames = (sse_event({"tokens": [1, 2]})
+              + sse_event({"done": True}, event="done"))
+    parsed = list(iter_sse_lines(frames.splitlines(keepends=True)))
+    assert parsed == [(None, {"tokens": [1, 2]}), ("done", {"done": True})]
+
+
+def test_error_mapping_sequence_aborted_unit():
+    from ray_tpu.serve.http_proxy import _error_response
+
+    st, _, doc = _error_response(exc.SequenceAborted("s1", "gone"))
+    assert st == 499 and doc["type"] == "SequenceAborted"
+
+
+# ---------------------------------------------------------------------------
+# cluster tier: continuous vs request-level A/B, affinity, SSE, state
+# ---------------------------------------------------------------------------
+
+
+def test_continuous_vs_request_level_bit_exact(serve_client):
+    """The acceptance pin: a num_shards=2 continuous-batching gang and
+    a request-level (lockstep batch) deployment of the SAME model emit
+    the SAME tokens, with admissions interleaved mid-decode on the
+    streaming side."""
+    margs = _model_args(5)
+    serve_client.create_backend(
+        "ab_stream", ShardedTokenLM, *margs,
+        config=serve.BackendConfig(
+            streaming=True, num_shards=2, max_decode_batch=4,
+            shard_group_timeout_s=scale_timeout(10)))
+    serve_client.create_endpoint("ab_stream_ep", backend="ab_stream")
+    serve_client.create_backend(
+        "ab_reqlvl", ShardedTokenLM, *margs,
+        config=serve.BackendConfig(max_batch_size=4,
+                                   batch_wait_timeout=0.05))
+    serve_client.create_endpoint("ab_reqlvl_ep", backend="ab_reqlvl")
+    hs = serve_client.get_handle("ab_stream_ep")
+    hr = serve_client.get_handle("ab_reqlvl_ep")
+
+    cases = [([3, 5, 9], 24), ([1, 2], 5), ([7], 12)]
+    got: dict = {}
+
+    def one(i, prompt, max_tokens):
+        got[i] = list(hs.stream({"prompt": prompt,
+                                 "max_tokens": max_tokens},
+                                timeout=scale_timeout(60)))
+
+    threads = []
+    for i, (p, mt) in enumerate(cases):
+        t = threading.Thread(target=one, args=(i, p, mt))
+        threads.append(t)
+        t.start()
+        time.sleep(0.05)  # interleaved admission, not one batch
+    for t in threads:
+        t.join(scale_timeout(90))
+    assert not any(t.is_alive() for t in threads)
+
+    refs = [hr.remote({"prompt": p, "max_tokens": mt})
+            for p, mt in cases]
+    reqlvl = ray_tpu.get(refs, timeout=scale_timeout(60))
+    for i, (p, mt) in enumerate(cases):
+        want = ShardedTokenLM.make(5).generate(p, mt)
+        assert got[i] == want, f"continuous != reference for case {i}"
+        assert list(reqlvl[i]) == want, f"request-level != reference {i}"
+
+
+def test_session_affinity_hit_miss_and_reuse(serve_client):
+    """Sticky sessions: the second turn routes to the replica already
+    holding the session's KV pages (router counts a hit), and the
+    engine's cached prefix grows across turns."""
+    margs = _model_args(6)
+    serve_client.create_backend(
+        "aff", ShardedTokenLM, *margs,
+        config=serve.BackendConfig(streaming=True, num_replicas=2,
+                                   max_decode_batch=4))
+    serve_client.create_endpoint("aff_ep", backend="aff")
+    handle = serve_client.get_handle("aff_ep")
+    router = handle._router
+
+    t1 = list(handle.stream({"prompt": [2, 3], "max_tokens": 4,
+                             "session": "alice"},
+                            timeout=scale_timeout(60)))
+    assert t1 == ShardedTokenLM.make(6).generate([2, 3], 4)
+    snap = router.debug_state()
+    assert snap["sessions"] == 1 and snap["affinity_misses"] >= 1
+    t2 = list(handle.stream({"prompt": [4], "max_tokens": 4,
+                             "session": "alice"},
+                            timeout=scale_timeout(60)))
+    snap = router.debug_state()
+    assert snap["affinity_hits"] >= 1, snap
+    # the affine replica's engine holds the whole two-turn history
+    state = ray_tpu.get(
+        serve_client._controller.get_routing_state.remote("aff_ep"),
+        timeout=scale_timeout(30))
+    infos = ray_tpu.get(
+        [r.engine_state.remote()
+         for r in state["backends"]["aff"]["replicas"]],
+        timeout=scale_timeout(30))
+    cached = [i["sessions"].get("alice") for i in infos
+              if i["sessions"].get("alice")]
+    assert cached == [2 + len(t1) + 1 + len(t2)], infos
+    # and the tokens match a reference decode of the full history
+    assert t2 == ShardedTokenLM.make(6).generate([2, 3] + t1 + [4], 4)
+
+
+def test_mixed_streaming_traffic_split_rejected(serve_client):
+    """The controller refuses traffic/shadow splits that mix streaming
+    and request-level backends (the proxy dispatches per endpoint, the
+    router picks per request — a mixed split would 500 one arm)."""
+    margs = _model_args(3)
+    serve_client.create_backend(
+        "mx_s", ShardedTokenLM, *margs,
+        config=serve.BackendConfig(streaming=True))
+    serve_client.create_backend("mx_r", ShardedTokenLM, *margs)
+    serve_client.create_endpoint("mx_ep", backend="mx_r")
+    with pytest.raises(Exception, match="streaming"):
+        serve_client.set_traffic("mx_ep", {"mx_r": 0.5, "mx_s": 0.5})
+    with pytest.raises(Exception, match="streaming"):
+        serve_client.shadow_traffic("mx_ep", "mx_s", 0.5)
+    # same-mode canary still works
+    serve_client.create_backend(
+        "mx_s2", ShardedTokenLM, *margs,
+        config=serve.BackendConfig(streaming=True))
+    serve_client.create_endpoint("mx_sep", backend="mx_s")
+    serve_client.set_traffic("mx_sep", {"mx_s": 0.9, "mx_s2": 0.1})
+
+
+def test_stream_meta_reports_session_cached(serve_client):
+    """The stream preamble carries the session-cache hit/miss a
+    delta-prompt client needs: miss on turn 1, hit on turn 2."""
+    import asyncio
+
+    margs = _model_args(10)
+    serve_client.create_backend(
+        "meta", ShardedTokenLM, *margs,
+        config=serve.BackendConfig(streaming=True))
+    serve_client.create_endpoint("meta_ep", backend="meta")
+    router = serve_client.get_handle("meta_ep")._router
+
+    async def turn(prompt):
+        metas, toks = [], []
+        async for chunk in router.stream_async(
+                {"prompt": prompt, "max_tokens": 3, "session": "m"},
+                timeout=scale_timeout(60)):
+            if "meta" in chunk:
+                metas.append(chunk["meta"])
+            toks.extend(chunk["tokens"])
+        return metas, toks
+
+    metas1, _ = asyncio.run(turn([1, 2]))
+    metas2, _ = asyncio.run(turn([3]))
+    assert [m["session_cached"] for m in metas1] == [False]
+    assert [m["session_cached"] for m in metas2] == [True]
+
+
+def test_stream_abandon_aborts_and_frees(serve_client):
+    """The router-accounting satellite: a caller abandoning a live
+    stream (sync generator dropped = client disconnect) aborts the
+    sequence, frees its KV pages, and returns the queued/in-flight
+    gauges — no decode slot stays burned."""
+    margs = _model_args(4)
+    serve_client.create_backend(
+        "ab_drop", ShardedTokenLM, *margs,
+        config=serve.BackendConfig(streaming=True, max_decode_batch=2))
+    serve_client.create_endpoint("ab_drop_ep", backend="ab_drop")
+    handle = serve_client.get_handle("ab_drop_ep")
+    router = handle._router
+
+    gen = handle.stream({"prompt": [3, 5, 9], "max_tokens": 100000},
+                        timeout=scale_timeout(60))
+    assert next(gen) is not None  # stream is live
+    gen.close()  # client disconnect mid-stream
+
+    state = ray_tpu.get(
+        serve_client._controller.get_routing_state.remote("ab_drop_ep"),
+        timeout=scale_timeout(30))
+    replica = state["backends"]["ab_drop"]["replicas"][0]
+    deadline = time.monotonic() + scale_timeout(20)
+    while True:
+        eng = ray_tpu.get(replica.engine_state.remote(),
+                          timeout=scale_timeout(30))
+        snap = router.debug_state()
+        if (eng["decode_batch"] == 0 and eng["open_streams"] == 0
+                and eng["kv"]["pages_in_use"] == 0
+                and snap["streams_open"] == 0 and snap["queued"] == 0
+                and not any(snap["inflight_batches"].values())):
+            break
+        assert time.monotonic() < deadline, (eng, snap)
+        time.sleep(0.1)
+    assert eng["kv_leaked"] == []
+
+
+def test_sse_roundtrip_through_proxy(serve_client):
+    """SSE end-to-end: tokens arrive as event-stream frames through the
+    HTTP proxy, match the reference decode, and the FIRST frame lands
+    while the generation is still running (TTFT decoupled)."""
+    margs = _model_args(8)
+    serve_client.create_backend(
+        "sse", ShardedTokenLM, *margs,
+        config=serve.BackendConfig(streaming=True, max_decode_batch=4))
+    serve_client.create_endpoint("sse_ep", backend="sse", route="/sse",
+                                 methods=["POST"])
+    port = serve_client.enable_http()
+
+    def post(body, accept=None):
+        import http.client
+
+        conn = http.client.HTTPConnection("127.0.0.1", port,
+                                          timeout=scale_timeout(60))
+        headers = {"Content-Type": "application/json"}
+        if accept:
+            headers["Accept"] = accept
+        conn.request("POST", "/sse", body=json.dumps(body),
+                     headers=headers)
+        return conn, conn.getresponse()
+
+    deadline = time.monotonic() + scale_timeout(30)
+    while True:  # route-table sync
+        conn, r = post({"prompt": [1], "max_tokens": 1})
+        ok = r.status == 200
+        r.read()
+        conn.close()
+        if ok:
+            break
+        assert time.monotonic() < deadline
+        time.sleep(0.2)
+
+    ref = ShardedTokenLM.make(8).generate([3, 5, 9], 40)
+    # aggregate JSON path rides the same engine
+    conn, r = post({"prompt": [3, 5, 9], "max_tokens": 40})
+    assert json.loads(r.read())["result"] == ref
+    conn.close()
+    # SSE path: incremental frames
+    conn, r = post({"prompt": [3, 5, 9], "max_tokens": 40,
+                    "stream": True}, accept="text/event-stream")
+    assert r.status == 200
+    assert r.headers.get("Content-Type", "").startswith(
+        "text/event-stream")
+    toks, frames, done = [], 0, False
+    for ev, data in iter_sse_lines(r.fp):
+        if ev == "done" or data.get("done"):
+            done = True
+            break
+        frames += 1
+        toks.extend(data.get("tokens") or [])
+    conn.close()
+    assert done and toks == ref
+    assert frames >= 1
+
+
+def test_state_serve_rows_and_doctor_decode_stage(serve_client):
+    """`ray-tpu state serve` / /api/state rows carry decode-batch
+    occupancy + KV gauges for streaming replicas, and the stall doctor
+    flags a wedged decode loop through the decode_step stage."""
+    from ray_tpu._private import debug_state
+
+    margs = _model_args(2)
+    serve_client.create_backend(
+        "st", ShardedTokenLM, *margs,
+        config=serve.BackendConfig(streaming=True, max_decode_batch=2))
+    serve_client.create_endpoint("st_ep", backend="st")
+    handle = serve_client.get_handle("st_ep")
+    gen = handle.stream({"prompt": [1, 2], "max_tokens": 100000},
+                        timeout=scale_timeout(60))
+    next(gen)
+    try:
+        from ray_tpu._private import global_state
+
+        cw = global_state.get_core_worker()
+        deadline = time.monotonic() + scale_timeout(30)
+        while True:
+            snap = cw.get_cluster_state(timeout=scale_timeout(10))
+            rows = debug_state.flatten(snap, "serve")
+            busy = [r for r in rows
+                    if r.get("kind") == "serve-replica"
+                    and str(r.get("decode_batch", "")).startswith("1/")]
+            if busy:
+                break
+            assert time.monotonic() < deadline, rows
+            time.sleep(0.2)
+        row = busy[0]
+        assert row["kv_pages"].split("/")[0] != "0"
+        assert row["open_streams"] >= 1
+    finally:
+        gen.close()
+
+    # doctor unit: a synthetic stalled engine flags stage decode_step
+    fake = {"driver": {"component": {
+        "kind": "serve-replica", "engine": {
+            "backend": "st", "stall_age_s": 99.0, "decode_batch": 2,
+            "open_streams": 2, "steps": 17, "dead": ""}}}}
+    findings = debug_state.diagnose(fake, {}, floor_s=1.0)
+    assert [f for f in findings if f["stage"] == "decode_step"
+            and f["kind"] == "decode"], findings
+
+
+def test_member_kill_mid_decode_typed_and_no_leak(serve_client):
+    """Deterministic chaos seam: a follower rank armed with
+    `serve.decode_step=exit` dies mid-decode -> every open stream
+    terminates with typed ReplicaGroupDied within the group timeout,
+    the fresh gang decodes bit-exact, and its engine starts with ZERO
+    KV pages in use."""
+    margs = _model_args(12)
+    timeout_s = scale_timeout(5)
+    serve_client.create_backend(
+        "ck", ShardedTokenLM, *margs,
+        config=serve.BackendConfig(
+            streaming=True, num_shards=2, max_decode_batch=4,
+            shard_group_timeout_s=timeout_s))
+    serve_client.create_endpoint("ck_ep", backend="ck")
+    handle = serve_client.get_handle("ck_ep")
+    ref = ShardedTokenLM.make(12).generate([3, 5], 8)
+    assert list(handle.stream({"prompt": [3, 5], "max_tokens": 8},
+                              timeout=scale_timeout(60))) == ref
+
+    gangs = ray_tpu.get(
+        serve_client._controller.get_gang_members.remote("ck"),
+        timeout=scale_timeout(30))
+    victim = gangs[0][1]  # follower rank
+    ray_tpu.get(victim.arm_failpoint.remote(
+        "serve.decode_step", "exit", nth=3), timeout=scale_timeout(30))
+
+    t0 = time.monotonic()
+    with state_dump_on_failure("stream-member-kill"):
+        with pytest.raises(exc.ReplicaGroupDied):
+            for _ in handle.stream({"prompt": [3, 5],
+                                    "max_tokens": 100000},
+                                   timeout=scale_timeout(60)):
+                pass
+        assert time.monotonic() - t0 < timeout_s + scale_timeout(15), \
+            "typed error took longer than the group timeout + grace"
+
+        # gang restarts; fresh engine decodes bit-exact with 0 pages
+        deadline = time.monotonic() + scale_timeout(90)
+        while True:
+            try:
+                out = list(handle.stream(
+                    {"prompt": [3, 5], "max_tokens": 8},
+                    timeout=scale_timeout(20)))
+                break
+            except (exc.ReplicaGroupDied, exc.ActorDiedError,
+                    exc.ActorUnavailableError, exc.SequenceAborted,
+                    TimeoutError, RuntimeError):
+                assert time.monotonic() < deadline, "gang never came back"
+                time.sleep(0.5)
+        assert out == ref
+        fresh = ray_tpu.get(
+            serve_client._controller.get_gang_members.remote("ck"),
+            timeout=scale_timeout(30))
+        leader_state = ray_tpu.get(fresh[0][0].engine_state.remote(),
+                                   timeout=scale_timeout(30))
+        deadline = time.monotonic() + scale_timeout(20)
+        while leader_state["kv"]["pages_in_use"]:
+            assert time.monotonic() < deadline, leader_state
+            time.sleep(0.2)
+            leader_state = ray_tpu.get(
+                fresh[0][0].engine_state.remote(),
+                timeout=scale_timeout(30))
+        assert leader_state["kv_leaked"] == []
+
+
+# ---------------------------------------------------------------------------
+# CI gate: recorded serve_stream bench rows (deterministic, no
+# benchmarking in CI — same pattern as the serve_mixed gate)
+# ---------------------------------------------------------------------------
+
+
+def test_microbench_serve_stream_gate():
+    """The recorded 2x-overload streaming rows must show the tier doing
+    its job: TTFT p99 decoupled from generation length (< 25% of the
+    continuous arm's full-generation p99) and continuous tokens/s at or
+    above the preserved request-level arm."""
+    import os
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    doc = json.load(open(os.path.join(root, "MICROBENCH.json")))
+    rows = {r["name"]: r for r in doc["results"]}
+    for name in ("serve_stream continuous 2x",
+                 "serve_stream request-level 2x"):
+        assert name in rows, f"missing {name!r} row in MICROBENCH.json"
+    cont = rows["serve_stream continuous 2x"]
+    reqlvl = rows["serve_stream request-level 2x"]
+    assert cont["generations"] > 0 and reqlvl["generations"] > 0
+    assert cont["ttft_p99_ms"] < 0.25 * cont["gen_p99_ms"], (
+        f"TTFT p99 {cont['ttft_p99_ms']}ms not decoupled from "
+        f"generation p99 {cont['gen_p99_ms']}ms at 2x overload")
+    assert cont["tokens_per_s_per_replica"] >= \
+        reqlvl["tokens_per_s_per_replica"], (
+        f"continuous {cont['tokens_per_s_per_replica']} tok/s fell "
+        f"below request-level {reqlvl['tokens_per_s_per_replica']}")
+
+
+# ---------------------------------------------------------------------------
+# seeded chaos: member killed mid-decode under open streams (slow tier)
+# ---------------------------------------------------------------------------
+
+_CHAOS_SEEDS = [301, 302, 303]
+
+_CHAOS_TYPED = (exc.ReplicaGroupDied, exc.ActorDiedError,
+                exc.ActorUnavailableError, exc.SequenceAborted)
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+@pytest.mark.parametrize("seed", _CHAOS_SEEDS)
+def test_chaos_member_kill_mid_decode(seed):
+    """Per seed: draw a victim rank and a kill step, kill that member
+    mid-decode under several open streams. Every stream terminates
+    (typed) within its deadline, the gang restarts, fresh streams are
+    bit-exact, and the fresh engine holds zero KV pages (conftest
+    leak-check names leaked pages + orphaned members)."""
+    import random
+
+    rng = random.Random(seed)
+    num_shards = 3
+    victim_rank = rng.randrange(num_shards)
+    nth = rng.randint(2, 8)
+    print(f"[chaos] seed={seed} victim_rank={victim_rank} nth={nth}")
+    budget = scale_timeout(90)
+    timeout_s = scale_timeout(5)
+    margs = _model_args(seed)
+    ref = ShardedTokenLM.make(seed).generate([3, 5], 8)
+    ray_tpu.init(num_cpus=8)
+    client = None
+    try:
+        client = serve.start()
+        client.create_backend(
+            "chs", ShardedTokenLM, *margs,
+            config=serve.BackendConfig(
+                streaming=True, num_shards=num_shards,
+                max_decode_batch=4, shard_group_timeout_s=timeout_s))
+        client.create_endpoint("chs_ep", backend="chs")
+        handle = client.get_handle("chs_ep")
+        with state_dump_on_failure(f"stream-chaos-seed{seed}"):
+            assert list(handle.stream({"prompt": [3, 5],
+                                       "max_tokens": 8},
+                                      timeout=budget)) == ref
+            gangs = ray_tpu.get(
+                client._controller.get_gang_members.remote("chs"),
+                timeout=scale_timeout(30))
+            victim = gangs[0][victim_rank]
+            ray_tpu.get(victim.arm_failpoint.remote(
+                "serve.decode_step", "exit", nth=nth),
+                timeout=scale_timeout(30))
+
+            outcomes: list = [None] * 4
+
+            def one(i):
+                try:
+                    toks = list(handle.stream(
+                        {"prompt": [3, 5, i], "max_tokens": 100000},
+                        timeout=budget))
+                    outcomes[i] = ("finished?", len(toks))
+                except _CHAOS_TYPED as e:
+                    outcomes[i] = ("typed", e)
+                except TimeoutError as e:
+                    outcomes[i] = ("timeout", e)
+                except RuntimeError as e:
+                    outcomes[i] = ("typed", e)  # dispatch window races
+
+            threads = [threading.Thread(target=one, args=(i,))
+                       for i in range(4)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=budget + scale_timeout(30))
+            assert not any(t.is_alive() for t in threads), \
+                f"[seed={seed}] stream thread HUNG: {outcomes}"
+            kinds = [o[0] for o in outcomes if o]
+            print(f"[chaos seed={seed}] outcomes: {kinds}")
+            assert "timeout" not in kinds, outcomes
+            assert "typed" in kinds, (
+                f"[seed={seed}] the armed kill never surfaced")
+
+            # gang restarts, streams decode bit-exact, zero pages held
+            deadline = time.monotonic() + budget
+            while True:
+                try:
+                    out = list(handle.stream(
+                        {"prompt": [3, 5], "max_tokens": 8},
+                        timeout=scale_timeout(20)))
+                    break
+                except (_CHAOS_TYPED + (TimeoutError, RuntimeError)):
+                    assert time.monotonic() < deadline, (
+                        f"[seed={seed}] gang never came back")
+                    time.sleep(0.5)
+            assert out == ref
+            fresh = ray_tpu.get(
+                client._controller.get_gang_members.remote("chs"),
+                timeout=scale_timeout(30))
+            deadline = time.monotonic() + scale_timeout(30)
+            while True:
+                states = ray_tpu.get(
+                    [m.engine_state.remote() for m in fresh[0]],
+                    timeout=scale_timeout(30))
+                if all(s["kv"]["pages_in_use"] == 0 for s in states):
+                    break
+                assert time.monotonic() < deadline, (
+                    f"[seed={seed}] leaked KV pages: "
+                    f"{[s['kv'] for s in states]}")
+                time.sleep(0.3)
+            assert all(s["kv_leaked"] == [] for s in states)
+    finally:
+        if client is not None:
+            client.shutdown()
+        ray_tpu.shutdown()
