@@ -24,8 +24,10 @@ from ray_tpu.api import (
     is_initialized,
     kill,
     nodes,
+    profile,
     put,
     remote,
+    set_profiling,
     set_trace_sampling,
     shutdown,
     start_doctor,
@@ -57,8 +59,10 @@ __all__ = [
     "is_initialized",
     "kill",
     "nodes",
+    "profile",
     "put",
     "remote",
+    "set_profiling",
     "set_trace_sampling",
     "shutdown",
     "start_doctor",

@@ -38,6 +38,7 @@ from ray_tpu import exceptions as exc
 from ray_tpu._private import common, global_state, rpc, serialization
 from ray_tpu._private import debug_state as _debug
 from ray_tpu._private import failpoints as _fp
+from ray_tpu._private import sampling_profiler as _sprof
 from ray_tpu._private import tracing
 from ray_tpu._private.config import Config
 from ray_tpu._private.ids import ActorID, JobID, NodeID, ObjectID, TaskID, WorkerID
@@ -283,6 +284,15 @@ class CoreWorker:
         self._last_profile_flush = 0.0
         # Trace spans (tracing.py) share this buffer/flush pipeline.
         tracing.bind_buffer(self._profile)
+        # Continuous profiling plane: the always-on wall-clock sampler
+        # (sampling_profiler.py); its window flushes on the same ~2s
+        # cadence below. A KV-armed rate override lands via pubsub.
+        _sprof.start(mode)
+        # exemplar trace ids resolve against THIS cluster's trace table:
+        # drop any kept by a previous connection in this process
+        from ray_tpu._private import stats as _stats_mod
+
+        _stats_mod.reset_exemplars()
 
         # connections
         self.raylet: rpc.Connection | None = None
@@ -380,6 +390,10 @@ class CoreWorker:
                 rate = await conn.call("kv_get", {"key": tracing.KV_KEY})
                 if rate is not None:
                     tracing.apply_kv_value(rate)
+                await conn.call("subscribe", {"channel": _sprof.CHANNEL})
+                hz = await conn.call("kv_get", {"key": _sprof.KV_KEY})
+                if hz is not None:
+                    _sprof.apply_kv_value(hz)
                 if self.mode == DRIVER:
                     await conn.call("subscribe",
                                     {"channel": "worker_logs"})
@@ -427,6 +441,11 @@ class CoreWorker:
             rate = await self.gcs.call("kv_get", {"key": tracing.KV_KEY})
             if rate:
                 tracing.apply_kv_value(rate)
+            # Live profiler arming (ray_tpu.set_profiling): same plane.
+            await self.gcs.call("subscribe", {"channel": _sprof.CHANNEL})
+            hz = await self.gcs.call("kv_get", {"key": _sprof.KV_KEY})
+            if hz:
+                _sprof.apply_kv_value(hz)
             # Duplex: the raylet sends actor-creation/kill requests back
             # over this same connection. A worker cannot function without
             # its raylet — it dies with it (reference: worker exits when
@@ -1166,8 +1185,9 @@ class CoreWorker:
                 self.leases.setdefault(key, []).append(lease)
             if grants:
                 now = time.time()
-                M_LEASE_WAIT_S.observe(now - lease_t0)
                 root = tracing.from_wire(spec.get("trace"))
+                M_LEASE_WAIT_S.observe(now - lease_t0,
+                                       exemplar=tracing.exemplar_of(root))
                 if root is not None:
                     tracing.record_span("task.lease_wait", lease_t0, now,
                                         tracing.child(root),
@@ -1384,8 +1404,9 @@ class CoreWorker:
         now = time.time()
         t0 = rec.get("t0")
         if t0 is not None and "t_push" not in rec:
-            M_QUEUE_WAIT_S.observe(now - t0)
             ctx = rec.get("trace")
+            M_QUEUE_WAIT_S.observe(now - t0,
+                                   exemplar=tracing.exemplar_of(ctx))
             if ctx is not None:
                 tracing.record_span("task.queue_wait", t0, now,
                                     tracing.child(ctx),
@@ -1532,8 +1553,9 @@ class CoreWorker:
         if rec is not None:
             now = time.time()
             t0 = rec.get("t0")
+            exemplar = tracing.exemplar_of(rec.get("trace"))
             if t0 is not None:
-                M_E2E_S.observe(now - t0)
+                M_E2E_S.observe(now - t0, exemplar=exemplar)
             t_push = rec.get("t_push")
             held_s = (reply.get("held_s", reply.get("exec_s"))
                       if isinstance(reply, dict) else None)
@@ -1541,7 +1563,8 @@ class CoreWorker:
                 # durations only — clock-skew-free wire+loop overhead.
                 # held_s (not exec_s): worker-side queueing behind other
                 # in-flight pushes must not read as reply overhead.
-                M_REPLY_OVERHEAD_S.observe(max(0.0, now - t_push - held_s))
+                M_REPLY_OVERHEAD_S.observe(max(0.0, now - t_push - held_s),
+                                           exemplar=exemplar)
             ctx = rec.get("trace")
             if ctx is not None and t0 is not None:
                 # the ROOT span of this task's tree (children: queue_wait,
@@ -1700,6 +1723,16 @@ class CoreWorker:
             # profiling.events_dropped_total instead of lost silently.
             self._profile.requeue(events)
 
+    async def _flush_profile_samples(self):
+        """Flush the continuous-profiler window into the GCS profile
+        ring on the 2s cadence (sampling_profiler.flush_to: the shared
+        drain + `profile.flush` seam + bounded merge-back contract)."""
+        if self._shutdown:
+            return
+        await _sprof.flush_to(
+            self.gcs, self._profile.component_type,
+            node_id=self.node_id.binary() if self.node_id else None)
+
     async def _push_metrics_now(self):
         """Push this process's metric snapshot to the GCS time-series
         ring (heartbeat-piggyback analog for workers/drivers, which
@@ -1728,6 +1761,7 @@ class CoreWorker:
         while not self._shutdown:
             await asyncio.sleep(2.0)
             await self._flush_profile_now(force=True)
+            await self._flush_profile_samples()
             await self._push_metrics_now()
 
     def get_cluster_events(self, severity: str | None = None) -> list[dict]:
@@ -1744,6 +1778,15 @@ class CoreWorker:
         one trace (hex trace id)."""
         return self._io.run(self.gcs.call(
             "get_trace_spans", {"trace_id": trace_id}))
+
+    def get_profile_samples(self, since: float | None = None,
+                            component: str | None = None) -> list[dict]:
+        """Collapsed-stack sample batches from the GCS profile ring
+        (sampling_profiler.py), optionally filtered to one component
+        class and/or to windows ending at/after `since`."""
+        return self._io.run(self.gcs.call(
+            "get_profile_samples",
+            {"since": since, "component": component}))
 
     def get_metrics_history(self, samples: int = 0) -> dict:
         """Per-source metric time series from the GCS ring buffers:
@@ -1886,6 +1929,13 @@ class CoreWorker:
                     "server_conns": len(self.server.connections)},
             "collectives": _collective_debug(),
         }
+        from ray_tpu._private import profiling as _profiling
+
+        compiles = _profiling.compile_state()
+        if compiles["total"]:
+            # jit-compile activity (profiling.record_compile seams): the
+            # stall doctor's compile-storm signal rides this snapshot
+            snap["jax_compiles"] = compiles
         routers = _serve_router_debug()
         if routers:
             snap["routers"] = routers
@@ -1968,6 +2018,9 @@ class CoreWorker:
             return
         if channel == tracing.CHANNEL:
             tracing.apply_kv_value(data)
+            return
+        if channel == _sprof.CHANNEL:
+            _sprof.apply_kv_value(data)
             return
         if channel.startswith("pg:"):
             # placement-group transition (CREATED / REMOVED): wake every
@@ -2830,7 +2883,8 @@ class CoreWorker:
             tracing.pop(token)
             tracing.record_span("task", start, end, exec_ctx,
                                 {"name": spec.get("name", "?")})
-            M_EXEC_S.observe(end - start)
+            M_EXEC_S.observe(end - start,
+                             exemplar=tracing.exemplar_of(exec_ctx))
             scope["exec_s"] = end - start
             scope["held_s"] = end - (arrived if arrived is not None
                                      else start)
@@ -3188,6 +3242,7 @@ class CoreWorker:
             except Exception:
                 pass
         self._shutdown = True
+        _sprof.stop()
 
         async def _close():
             for key, leases in list(self.leases.items()):

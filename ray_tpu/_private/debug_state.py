@@ -50,6 +50,10 @@ M_STATE_COLLECT = _stats.Gauge(
 # Default doctor knobs (api.doctor accepts overrides; env for the CLI).
 DOCTOR_FLOOR_S = float(os.environ.get("RAY_TPU_DOCTOR_FLOOR_S", "1.0"))
 DOCTOR_P99_FACTOR = float(os.environ.get("RAY_TPU_DOCTOR_P99_K", "3.0"))
+# compile-storm finding: >= this many jit compiles within the last 60s
+# (with >= floor_s of wall time behind them) flags the process
+COMPILE_STORM_MIN = int(os.environ.get("RAY_TPU_DOCTOR_COMPILE_STORM_MIN",
+                                       "4"))
 
 # stage -> latency histogram whose p99 scales the stall threshold (the
 # PR 6 per-hop histograms; stages with no histogram gate on the floor)
@@ -351,15 +355,25 @@ def flatten(snapshot: dict, component: str) -> list[dict]:
 # ---------------------------------------------------------------------------
 
 
-def _merged_p99(metrics: dict) -> dict[str, float]:
+def _merged_p99(metrics: dict,
+                exemplars: dict | None = None) -> dict[str, float]:
     """p99 per histogram name, merged across every process snapshot in a
-    cluster_metrics() result (raylets already fold worker snapshots in)."""
+    cluster_metrics() result (raylets already fold worker snapshots in).
+    With `exemplars` (a dict to fill), also merges each histogram's
+    best p99 exemplar — the trace id a finding can print when the live
+    item itself is untraced."""
     merged: dict[str, dict] = {}
 
     def fold(snap):
         for name, m in (snap or {}).items():
             if not isinstance(m, dict) or m.get("type") != "histogram":
                 continue
+            if exemplars is not None and m.get("exemplars"):
+                ex = _stats.quantile_exemplar(m, 0.99)
+                cur_ex = exemplars.get(name)
+                if ex is not None and (cur_ex is None
+                                       or ex["value"] >= cur_ex["value"]):
+                    exemplars[name] = ex
             cur = merged.get(name)
             if cur is None:
                 merged[name] = {"boundaries": m.get("boundaries") or [],
@@ -397,7 +411,8 @@ def diagnose(snapshot: dict, metrics: dict | None = None, *,
     IO, so it runs identically in the driver, the CLI, and tests."""
     floor_s = DOCTOR_FLOOR_S if floor_s is None else float(floor_s)
     k = DOCTOR_P99_FACTOR if p99_factor is None else float(p99_factor)
-    p99s = _merged_p99(metrics or {})
+    exemplars: dict[str, dict] = {}
+    p99s = _merged_p99(metrics or {}, exemplars)
     findings: list[dict] = []
 
     def flag(kind, proc, stage, age, item, detail=""):
@@ -406,13 +421,24 @@ def diagnose(snapshot: dict, metrics: dict | None = None, *,
         limit = _threshold(stage, p99s, floor_s, k)
         if age <= limit:
             return
+        trace_id = item.get("trace_id") or ""
+        trace_source = "item" if trace_id else ""
+        if not trace_id:
+            # untraced item: fall back to the stage histogram's p99
+            # EXEMPLAR — one real outlier of the same stage whose span
+            # tree `ray-tpu trace --trace-id` resolves
+            hist = STAGE_HISTOGRAMS.get(stage)
+            ex = exemplars.get(hist) if hist else None
+            if ex is not None:
+                trace_id, trace_source = ex["trace_id"], "exemplar"
         findings.append({
             "kind": kind,
             "process": proc,
             "stage": stage,
             "age_s": round(float(age), 3),
             "threshold_s": round(limit, 3),
-            "trace_id": item.get("trace_id") or "",
+            "trace_id": trace_id,
+            "trace_source": trace_source,
             "id": item.get("task_id") or item.get("object_id")
                   or item.get("group") or item.get("lease_id") or "",
             "name": (item.get("name") or item.get("op")
@@ -456,6 +482,27 @@ def diagnose(snapshot: dict, metrics: dict | None = None, *,
                  detail=f"batch={eng.get('decode_batch')} "
                         f"open_streams={eng.get('open_streams')} "
                         f"steps={eng.get('steps')}")
+        compiles = proc.get("jax_compiles")
+        if (isinstance(compiles, dict)
+                and compiles.get("recent_60s", 0) >= COMPILE_STORM_MIN
+                and compiles.get("recent_s", 0.0) >= floor_s):
+            # recompile storm: many compile events in the last minute
+            # with real wall time behind them — a shape-churning loader
+            # or a cache-thrashing collective, not a wedged item
+            findings.append({
+                "kind": "compile_storm",
+                "process": label,
+                "stage": "compile",
+                "age_s": round(float(compiles["recent_s"]), 3),
+                "threshold_s": round(floor_s, 3),
+                "trace_id": "",
+                "trace_source": "",
+                "id": "",
+                "name": compiles.get("last_key", ""),
+                "detail": (f"{compiles['recent_60s']} compiles in 60s "
+                           f"({compiles['recent_s']:.1f}s wall, "
+                           f"{compiles.get('total', 0)} total)"),
+            })
     findings.sort(key=lambda f: -f["age_s"])
     return findings
 

@@ -11,6 +11,7 @@ GCS trace table and export with cross-process flow arrows."""
 from __future__ import annotations
 
 import collections
+import contextlib
 import os
 import threading
 import time
@@ -23,6 +24,118 @@ from ray_tpu._private import stats as _stats
 M_EVENTS_DROPPED = _stats.Count(
     "profiling.events_dropped_total",
     "profile/trace events dropped by the local buffer bound")
+
+# jit-compile observability (drift-gated): every recompile the runtime
+# can see — _DeviceOps cache fills, the paged-KV jax update, Trainer
+# step shape changes — counts here and lands as a `jax.compile` span,
+# so a recompile storm reads as a flamegraph band + a rising
+# jax.compiles_total rate + a doctor finding instead of a mystery stall.
+M_COMPILES = _stats.Count(
+    "jax.compiles_total",
+    "jit compile events observed at the runtime's compile seams "
+    "(_DeviceOps cache fill, KV-cache jax update, Trainer step)")
+M_COMPILE_S = _stats.Histogram(
+    "jax.compile_s", _stats.COMPILE_BOUNDARIES_S,
+    "wall seconds per observed jit compile (first dispatch of a new "
+    "shape class — compile + first execution)")
+
+# recent-compile window for debug_state / the stall doctor's
+# compile-storm finding (bounded ring; pruned on read)
+COMPILE_RECENT_WINDOW_S = 60.0
+_compile_recent: collections.deque = collections.deque(maxlen=256)
+_compile_lock = threading.Lock()
+
+
+def record_compile(key: str, start: float, end: float) -> None:
+    """Record one observed jit compile: metrics + a `jax.compile` span
+    (joining the ambient trace when one is active) + the recent window
+    the doctor reads."""
+    from ray_tpu._private import tracing
+
+    seconds = max(0.0, end - start)
+    M_COMPILES.inc()
+    M_COMPILE_S.observe(seconds)
+    with _compile_lock:
+        _compile_recent.append((end, seconds, key))
+    tracing.record_span("jax.compile", start, end, tracing.current(),
+                        {"name": f"jax.compile {key}", "key": key,
+                         "compile_s": round(seconds, 4)})
+
+
+def compile_state() -> dict:
+    """Compile activity summary for debug_state snapshots: total count
+    plus the last-60s window (count, wall seconds, last key) — the
+    stall doctor's compile-storm signal."""
+    now = time.time()
+    with _compile_lock:
+        recent = [(ts, s, k) for ts, s, k in _compile_recent
+                  if now - ts <= COMPILE_RECENT_WINDOW_S]
+        last = _compile_recent[-1] if _compile_recent else None
+    return {
+        "total": int(M_COMPILES.snapshot()["value"]),
+        "recent_60s": len(recent),
+        "recent_s": round(sum(s for _, s, _ in recent), 4),
+        "last_key": last[2] if last else "",
+        "last_age_s": round(now - last[0], 3) if last else None,
+    }
+
+
+class CompileProbe:
+    """First-dispatch-per-shape-class timer for jitted callables.
+
+    jit recompiles exactly when the traced shape class changes, so the
+    first dispatch of a new key carries the compile; `watch(key)` times
+    that first call and records it via record_compile (later calls of a
+    seen key cost one set lookup). The measured time includes the first
+    execution — the standard proxy when the runtime can't hook XLA
+    directly."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._seen: set = set()
+        self._lock = threading.Lock()
+
+    @contextlib.contextmanager
+    def watch(self, *key_parts):
+        key = ":".join(str(p) for p in key_parts)
+        with self._lock:
+            fresh = key not in self._seen
+            if fresh:
+                self._seen.add(key)
+        if not fresh:
+            yield False
+            return
+        t0 = time.time()
+        try:
+            yield True
+        except BaseException:
+            # a failed first dispatch (transient OOM, interrupt) did
+            # not prove a compile: un-mark the key so the retry is
+            # timed, and record nothing for the failed attempt
+            with self._lock:
+                self._seen.discard(key)
+            raise
+        record_compile(f"{self.name}:{key}", t0, time.time())
+
+
+def shape_class(batch) -> str:
+    """Stable shape-class key for a (possibly nested) batch of arrays —
+    the thing whose change forces a jit recompile."""
+    shapes: list[str] = []
+
+    def walk(x):
+        shape = getattr(x, "shape", None)
+        if shape is not None:
+            shapes.append("x".join(map(str, shape)) or "scalar")
+        elif isinstance(x, dict):
+            for k in sorted(x):
+                walk(x[k])
+        elif isinstance(x, (list, tuple)):
+            for v in x:
+                walk(v)
+
+    walk(batch)
+    return ",".join(shapes) or "none"
 
 
 class ProfileBuffer:

@@ -1,0 +1,507 @@
+"""Continuous profiling plane: an always-on wall-clock sampler in every
+runtime process class (reference analog: `py-spy record` / Google-wide
+profiling — here in-process, zero-dependency, riding the existing
+`sys._current_frames` machinery behind `debug_stacks`).
+
+Each process (driver/worker core worker, raylet, GCS director + store
+shards) runs ONE daemon sampler thread ("ray-tpu-profiler") at a low
+rate (`RAY_TPU_PROFILE_HZ`, default ~67 Hz), walking every thread's
+Python stack and aggregating COLLAPSED stacks (root-first,
+';'-separated, Brendan-Gregg flamegraph format) into a bounded
+per-(thread, stack) count table. The table drains on the existing ~2 s
+profile-flush cadence into a bounded GCS **profile ring**
+(`add_profile_samples` / `get_profile_samples`); a failed flush merges
+the batch back (bounded, drops counted in
+`profiling.flush_dropped_total`) and retries next cycle — the same
+lossy-but-typed degradation contract as the span flush.
+
+Export surfaces: `ray_tpu.profile()`, `ray-tpu profile [--component
+--seconds -o]`, dashboard `/api/profile` — all emit cluster-wide
+collapsed-stack text (feed it to flamegraph.pl / speedscope / any
+flamegraph viewer) plus merged Perfetto tracks
+(`samples_to_chrome_trace`: one slice per flush window per thread,
+top stacks in args).
+
+Live arming: `ray_tpu.set_profiling(hz)` rides the internal KV
+(KV_KEY) + pubsub (CHANNEL) plane exactly like failpoint arming and
+trace-sampling overrides — running processes flip within a beat,
+later-spawned ones read the KV at bootstrap; hz=0 stops the sampler
+thread everywhere.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+import weakref
+
+from ray_tpu._private import stats as _stats
+
+KV_KEY = "ray_tpu:profiling"
+CHANNEL = "profiling_config"
+
+DEFAULT_HZ = 67.0  # full rate (~50-100 Hz band); odd, avoids 10ms lockstep
+MIN_DEFAULT_HZ = 7.0  # always-on floor on the most oversubscribed boxes
+MAX_HZ = 1000.0
+MAX_STACK_DEPTH = 48
+MAX_STACKS = 4000  # bound on distinct (thread, stack) keys per window
+
+
+def default_hz() -> float:
+    """The always-on default rate: an overhead BUDGET, not a fixed
+    number. Each sampler pays a fixed per-wakeup cost (one sample is
+    ~30µs + the wakeup syscall tax), and every runtime process runs
+    one — so a box where a dozen processes share 1-2 cores derates
+    toward MIN_DEFAULT_HZ to keep the whole plane inside the tier-1
+    ≤5% overhead gate, while an 8+-core box runs the full ~67 Hz.
+    RAY_TPU_PROFILE_HZ pins an explicit rate; investigation bumps the
+    cluster live (`ray_tpu.set_profiling` / `ray-tpu profile --hz`)."""
+    cores = (len(os.sched_getaffinity(0))
+             if hasattr(os, "sched_getaffinity")
+             else (os.cpu_count() or 1))
+    if cores >= 8:
+        return DEFAULT_HZ
+    return max(MIN_DEFAULT_HZ, DEFAULT_HZ * cores / 8.0)
+
+
+# (the 1-2 core tier lands on the floor: a dozen runtime processes'
+# wakeups share one core with the workload, and the ≤5% tier-1 gate
+# prices every wakeup; `ray-tpu profile --hz 100` bumps a window live)
+
+THREAD_NAME = "ray-tpu-profiler"
+
+# sentinel stack for counts folded past the distinct-stack bound
+OVERFLOW_STACK = "(other)"
+
+M_SAMPLES = _stats.Count(
+    "profiling.samples_total",
+    "thread-stack samples captured by the continuous wall-clock sampler")
+M_FLUSH_DROPPED = _stats.Count(
+    "profiling.flush_dropped_total",
+    "sampled stacks dropped past the bounded table (flush-failure "
+    "merge-back overflow or distinct-stack cap)")
+
+
+def _env_hz() -> float:
+    raw = os.environ.get("RAY_TPU_PROFILE_HZ", "")
+    if not raw:
+        return default_hz()
+    try:
+        return min(MAX_HZ, max(0.0, float(raw)))
+    except ValueError:
+        return default_hz()
+
+
+# code object -> collapsed-frame label. The sampler's hot path never
+# formats strings: labels memoize per code object (function identity —
+# co_firstlineno, not the live line, so stacks aggregate across
+# samples), and the count table keys on code-object tuples until drain.
+# Holding code refs keeps the memo valid (ids can't be recycled).
+_label_memo: dict = {}
+
+
+def _frame_label(code) -> str:
+    label = _label_memo.get(code)
+    if label is None:
+        fname = os.path.basename(code.co_filename)
+        # ';' is the collapsed-format frame separator so it can never
+        # appear inside a label
+        label = f"{code.co_name} ({fname}:{code.co_firstlineno})".replace(
+            ";", ",")
+        if len(_label_memo) > 50_000:  # leak guard for pathological eval
+            _label_memo.clear()
+        _label_memo[code] = label
+    return label
+
+
+class SamplingProfiler:
+    """Bounded collapsed-stack aggregator + its sampler thread."""
+
+    def __init__(self, role: str, max_stacks: int = MAX_STACKS):
+        _instances.add(self)
+        self.role = role or "process"
+        self.max_stacks = max_stacks
+        self.hz = 0.0
+        # (thread name, tuple-of-code-objects | collapsed str) -> count
+        self._table: dict[tuple, int] = {}
+        self._thread_names: dict[int, str] = {}
+        self._lock = threading.Lock()
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+        self._window_start = time.time()
+        self._samples = 0  # samples in the current window
+
+    # -- sampling ---------------------------------------------------------
+
+    def sample_once(self) -> int:
+        """Capture one sample of every thread's stack into the table
+        (public for tests and for single-shot collection). Returns the
+        number of thread-stacks recorded.
+
+        Hot-path discipline: no string work here — the table keys on
+        (thread name, tuple-of-code-objects); labels/joins happen once
+        per DISTINCT stack at drain(). One sample costs a frame walk
+        plus a dict upsert per thread."""
+        me = threading.get_ident()
+        names = self._thread_names
+        n = 0
+        for tid, frame in sys._current_frames().items():
+            if tid == me:
+                continue  # never profile the profiler
+            name = names.get(tid)
+            if name is None:
+                # new thread since the cached enumerate: refresh once.
+                # Threads invisible to threading.enumerate (C-spawned
+                # with a thread state) get their fallback name CACHED,
+                # or every later sample would rebuild this dict.
+                names = self._thread_names = {
+                    t.ident: t.name for t in threading.enumerate()}
+                name = names.setdefault(tid, f"tid-{tid}")
+            codes: list = []
+            depth = 0
+            while frame is not None and depth < MAX_STACK_DEPTH:
+                codes.append(frame.f_code)
+                frame = frame.f_back
+                depth += 1
+            if not codes:
+                continue
+            key = (name, tuple(codes))  # leaf-first; reversed at drain
+            with self._lock:
+                cur = self._table.get(key)
+                if cur is None and len(self._table) >= self.max_stacks:
+                    # keep counts honest past the distinct-stack bound:
+                    # fold into a per-thread overflow bucket
+                    key = (name, OVERFLOW_STACK)
+                    cur = self._table.get(key)
+                    M_FLUSH_DROPPED.inc()
+                self._table[key] = (cur or 0) + 1
+                self._samples += 1
+            n += 1
+        if n:
+            M_SAMPLES.inc(n)
+        return n
+
+    def _run(self):
+        while not self._stop.is_set():
+            period = 1.0 / self.hz if self.hz > 0 else 0.5
+            if self._stop.wait(period):
+                return
+            if self.hz <= 0:
+                continue
+            try:
+                self.sample_once()
+            except Exception:
+                # a torn frame walk must never kill the sampler; the
+                # next tick resamples
+                pass
+
+    def set_rate(self, hz: float) -> None:
+        """Arm/re-rate/disarm the sampler thread. hz<=0 stops it (the
+        thread exits; a later arm starts a fresh one)."""
+        hz = min(MAX_HZ, max(0.0, float(hz)))
+        self.hz = hz
+        if hz <= 0:
+            self.stop()
+            return
+        if self._thread is None or not self._thread.is_alive():
+            self._stop = threading.Event()
+            self._thread = threading.Thread(
+                target=self._run, name=THREAD_NAME, daemon=True)
+            self._thread.start()
+
+    def stop(self, join_timeout: float = 1.0) -> None:
+        self.hz = 0.0
+        t = self._thread
+        if t is not None:
+            self._stop.set()
+            if t.is_alive():
+                t.join(timeout=join_timeout)
+            self._thread = None
+
+    @property
+    def running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    # -- flush ------------------------------------------------------------
+
+    def drain(self) -> dict | None:
+        """Drain the window into one wire batch (None when empty):
+        {"role", "t_start", "t_end", "hz", "samples",
+         "stacks": [{"thread", "stack", "count"}, ...]}."""
+        with self._lock:
+            if not self._table:
+                return None
+            table, self._table = self._table, {}
+            samples, self._samples = self._samples, 0
+            t_start, self._window_start = self._window_start, time.time()
+        # string work happens HERE, once per distinct stack per window —
+        # never on the sampling hot path. Code tuples are leaf-first;
+        # the collapsed format is root-first. Distinct code tuples can
+        # format to one string (same name/file/line) — merge counts.
+        merged: dict[tuple[str, str], int] = {}
+        for (thread, stack), count in table.items():
+            if not isinstance(stack, str):
+                stack = ";".join(_frame_label(c) for c in reversed(stack))
+            key = (thread, stack)
+            merged[key] = merged.get(key, 0) + count
+        return {
+            "role": self.role,
+            "t_start": t_start,
+            "t_end": time.time(),
+            "hz": self.hz,
+            "samples": samples,
+            "stacks": [{"thread": thread, "stack": stack, "count": count}
+                       for (thread, stack), count in merged.items()],
+        }
+
+    def merge_back(self, batch: dict | None) -> int:
+        """Re-merge a drained-but-unflushed batch (failed GCS flush)
+        so the next cycle retries it. Bounded: stacks past the cap fold
+        into the per-thread overflow bucket and count as dropped.
+        Returns how many stack rows were folded."""
+        if not batch:
+            return 0
+        dropped = 0
+        with self._lock:
+            self._window_start = min(self._window_start,
+                                     batch.get("t_start", time.time()))
+            for row in batch.get("stacks", ()):
+                key = (row["thread"], row["stack"])
+                cur = self._table.get(key)
+                if cur is None and len(self._table) >= self.max_stacks:
+                    key = (row["thread"], OVERFLOW_STACK)
+                    cur = self._table.get(key)
+                    dropped += 1
+                self._table[key] = (cur or 0) + row["count"]
+                self._samples += row["count"]
+        if dropped:
+            M_FLUSH_DROPPED.inc(dropped)
+        return dropped
+
+    def __len__(self):
+        with self._lock:
+            return len(self._table)
+
+
+# ---------------------------------------------------------------------------
+# per-process singleton + live arming (KV/pubsub plane)
+# ---------------------------------------------------------------------------
+
+_profiler: SamplingProfiler | None = None
+_lock = threading.Lock()
+# every live profiler (the singleton AND direct instances): module-level
+# stop() must be able to stop all of them, or a leaked instance thread
+# would be unkillable from the outside (conftest's leak remediation)
+_instances: weakref.WeakSet = weakref.WeakSet()
+# a live KV/pubsub override (ray_tpu.set_profiling) outranks the env
+# default for any later start() (e.g. a GCS applying a restored KV
+# before its run loop arms the sampler)
+_override_hz: float | None = None
+
+
+def get_profiler(role: str | None = None) -> SamplingProfiler:
+    global _profiler
+    if _profiler is None:
+        with _lock:
+            if _profiler is None:
+                if role is None:
+                    from ray_tpu._private import failpoints as _fp
+
+                    role = _fp.get_role() or "process"
+                _profiler = SamplingProfiler(role)
+    return _profiler
+
+
+def start(role: str, hz: float | None = None) -> SamplingProfiler:
+    """Bootstrap hook: start this process's sampler at `hz` (default: a
+    live KV override when one was already applied, else
+    RAY_TPU_PROFILE_HZ — the always-on default rate). Idempotent."""
+    prof = get_profiler(role)
+    prof.role = role or prof.role
+    if hz is None:
+        hz = _override_hz if _override_hz is not None else _env_hz()
+    prof.set_rate(hz)
+    return prof
+
+
+def stop() -> None:
+    """Process shutdown: stop EVERY live sampler thread — the singleton
+    and any directly-constructed instances (conftest's leak check names
+    any 'ray-tpu-profiler' thread that outlives its runtime, then calls
+    this to actually kill it) — and drop any live KV override: it was
+    cluster-scoped, and a process that later joins a NEW cluster must
+    start from the env default."""
+    global _override_hz
+    _override_hz = None
+    for prof in list(_instances):
+        prof.stop()
+
+
+def rate() -> float:
+    prof = _profiler
+    return prof.hz if prof is not None else 0.0
+
+
+def set_rate(hz: float) -> None:
+    get_profiler().set_rate(hz)
+
+
+def apply_kv_value(value) -> None:
+    """Apply a live override arriving via the GCS KV/pubsub: the rate in
+    Hz as a string (e.g. b"100"), or b"default" — drop the override and
+    return to each process's OWN env/budget default (`ray-tpu profile
+    --hz` restores through this, so a 2-core node keeps its derated
+    floor instead of inheriting the CLI host's default)."""
+    global _override_hz
+    if value is None:
+        return
+    if isinstance(value, (bytes, bytearray)):
+        value = bytes(value).decode(errors="replace")
+    if value == "default":
+        _override_hz = None
+        set_rate(_env_hz())
+        return
+    try:
+        hz = float(value)
+    except (TypeError, ValueError):
+        return
+    _override_hz = min(MAX_HZ, max(0.0, hz))
+    set_rate(_override_hz)
+
+
+def drain_batch(component_type: str, component_id: int | None = None,
+                node_id: bytes | None = None) -> dict | None:
+    """Drain this process's sampler into one GCS-wire batch (None when
+    there is nothing to flush)."""
+    prof = _profiler
+    if prof is None:
+        return None
+    batch = prof.drain()
+    if batch is None:
+        return None
+    batch["component_type"] = component_type
+    batch["component_id"] = (os.getpid() if component_id is None
+                             else component_id)
+    if node_id is not None:
+        batch["node_id"] = node_id
+    return batch
+
+
+def merge_back(batch: dict | None) -> None:
+    prof = _profiler
+    if prof is not None and batch:
+        prof.merge_back(batch)
+
+
+async def flush_to(gcs, component_type: str,
+                   node_id: bytes | None = None) -> None:
+    """Drain this process's sampler window and notify it into the GCS
+    profile ring — the ONE flush contract every process class shares:
+    the `profile.flush` failpoint seam models an unreachable GCS, and a
+    failed notify merges the window back into the bounded table
+    (drops counted) for the next cycle."""
+    from ray_tpu._private import failpoints as _fp
+
+    if gcs is None:
+        return
+    batch = drain_batch(component_type, node_id=node_id)
+    if batch is None:
+        return
+    try:
+        if _fp.ARMED:
+            _fp.fire_strict("profile.flush")
+        await gcs.notify("add_profile_samples", batch)
+    except Exception:
+        merge_back(batch)
+
+
+def wait_for_coverage(fetch, component: str | None = None,
+                      deadline_s: float = 3.0,
+                      poll_s: float = 0.3) -> list[dict]:
+    """Poll `fetch()` (returns profile-ring batches) until the expected
+    process-class coverage lands — one class when filtered, else the
+    driver/raylet/GCS trio a cluster flamegraph must span — or the
+    deadline passes (windows flush on the ~2s cadence, so a short
+    collection needs this tail-wait). Returns the last fetch."""
+    deadline = time.monotonic() + deadline_s
+    want = 1 if component else 3
+    while True:
+        batches = fetch()
+        if (len(components_of(batches)) >= want
+                or time.monotonic() > deadline):
+            return batches
+        time.sleep(poll_s)
+
+
+# ---------------------------------------------------------------------------
+# export: collapsed-stack text + merged Perfetto tracks
+# ---------------------------------------------------------------------------
+
+
+def collapse(batches: list[dict], component: str | None = None) -> dict:
+    """Merge GCS profile-ring batches into one cluster-wide collapsed
+    table: {"<component>;<thread>;<frame>;...": count}. Identical
+    stacks from every process of a component class merge (that IS the
+    cluster flamegraph); `component` filters to one class."""
+    merged: dict[str, int] = {}
+    for b in batches:
+        ctype = b.get("component_type") or b.get("role") or "?"
+        if component and ctype != component:
+            continue
+        for row in b.get("stacks", ()):
+            key = f"{ctype};{row['thread']};{row['stack']}"
+            merged[key] = merged.get(key, 0) + int(row["count"])
+    return merged
+
+
+def collapse_text(batches: list[dict], component: str | None = None) -> str:
+    """Flamegraph-ready collapsed text, hottest stacks first."""
+    merged = collapse(batches, component)
+    lines = [f"{stack} {count}" for stack, count in
+             sorted(merged.items(), key=lambda kv: -kv[1])]
+    return "\n".join(lines)
+
+
+def components_of(batches: list[dict]) -> list[str]:
+    return sorted({b.get("component_type") or b.get("role") or "?"
+                   for b in batches if b.get("stacks")})
+
+
+def samples_to_chrome_trace(batches: list[dict]) -> list[dict]:
+    """Merged Perfetto tracks: each flush window becomes one 'X' slice
+    per (process, thread) track, named by its hottest stack leaf, with
+    the top stacks in args — profile windows line up beside the span
+    timeline in Perfetto / chrome://tracing."""
+    trace: list[dict] = []
+    for b in batches:
+        ctype = b.get("component_type") or b.get("role") or "?"
+        nid = b.get("node_id")
+        pid = (f"{ctype}-prof "
+               f"{nid.hex()[:8] if isinstance(nid, bytes) else ''}").strip()
+        by_thread: dict[str, list] = {}
+        for row in b.get("stacks", ()):
+            by_thread.setdefault(row["thread"], []).append(row)
+        for thread, rows in by_thread.items():
+            rows.sort(key=lambda r: -r["count"])
+            top = rows[0]
+            leaf = top["stack"].rsplit(";", 1)[-1]
+            trace.append({
+                "cat": "profile.samples",
+                "name": f"{leaf} ({top['count']} samples)",
+                "ph": "X",
+                "ts": b.get("t_start", 0.0) * 1e6,
+                "dur": max(0.0, (b.get("t_end", 0.0)
+                                 - b.get("t_start", 0.0))) * 1e6,
+                "pid": pid,
+                "tid": f"{thread}/{b.get('component_id', '')}",
+                "args": {
+                    "hz": b.get("hz"),
+                    "samples": sum(r["count"] for r in rows),
+                    "top_stacks": [
+                        {"stack": r["stack"], "count": r["count"]}
+                        for r in rows[:5]],
+                },
+            })
+    return trace

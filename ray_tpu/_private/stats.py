@@ -5,19 +5,44 @@ Each runtime process (gcs, raylet, worker, driver) keeps one registry;
 raylets and the GCS expose theirs over RPC ("get_metrics"), aggregated by
 `ray-tpu metrics` / api.cluster_metrics(). No external metrics daemon: the
 control-plane RPC layer is the export path (the reference pushes to
-OpenCensus/Prometheus exporters instead)."""
+OpenCensus/Prometheus exporters instead).
+
+Histograms carry **exemplars** (the OpenMetrics idea): observe() may
+attach a trace id, and each bucket keeps its most recent and its
+max-valued exemplar — so a p99 read off the snapshot links straight to
+one real outlier's trace tree (`ray-tpu trace --trace-id`). Disable
+with RAY_TPU_EXEMPLARS=0."""
 
 from __future__ import annotations
 
+import logging
+import os
 import threading
+import time
 from bisect import bisect_right
+
+logger = logging.getLogger("ray_tpu.stats")
+
+# Exemplar knob: exemplars cost one dict write per observe-with-exemplar;
+# 0 disables capture everywhere (snapshots then carry no "exemplars").
+EXEMPLARS_ENABLED = os.environ.get("RAY_TPU_EXEMPLARS", "1") not in (
+    "0", "false", "")
 
 
 class Metric:
     def __init__(self, name: str, description: str = ""):
         self.name = name
         self.description = description
-        _REGISTRY.register(self)
+        existing = _REGISTRY.register(self)
+        if existing is not self:
+            # same-named re-registration: the FIRST instance stays the
+            # registered truth; this instance becomes a proxy to it so
+            # neither side's updates are lost (a replaced counter used
+            # to silently drop all prior increments)
+            self._delegate_to(existing)
+
+    def _delegate_to(self, existing: "Metric") -> None:  # pragma: no cover
+        pass
 
 
 class Count(Metric):
@@ -35,6 +60,10 @@ class Count(Metric):
     def snapshot(self):
         with self._lock:
             return {"type": "count", "value": self._value}
+
+    def _delegate_to(self, existing):
+        self.inc = existing.inc
+        self.snapshot = existing.snapshot
 
 
 class Gauge(Metric):
@@ -62,9 +91,15 @@ class Gauge(Metric):
         with self._lock:
             return {"type": "gauge", "value": self._value}
 
+    def _delegate_to(self, existing):
+        self.set = existing.set
+        self.add = existing.add
+        self.snapshot = existing.snapshot
+
 
 class Histogram(Metric):
-    """Fixed-boundary histogram (reference: metric.h Histogram)."""
+    """Fixed-boundary histogram (reference: metric.h Histogram), with
+    optional per-bucket trace-id exemplars."""
 
     def __init__(self, name: str, boundaries: list[float],
                  description: str = ""):
@@ -72,22 +107,57 @@ class Histogram(Metric):
         self._counts = [0] * (len(self.boundaries) + 1)
         self._sum = 0.0
         self._n = 0
+        # bucket index -> {"last": exemplar, "max": exemplar}; exemplar =
+        # {"trace_id", "value", "ts"}. Bounded by construction: <=2 per
+        # bucket, only buckets that ever saw an exemplar have an entry.
+        self._exemplars: dict[int, dict] = {}
         self._lock = threading.Lock()
         super().__init__(name, description)
 
-    def observe(self, value: float):
+    def observe(self, value: float, exemplar: str | None = None):
+        """Record one observation; `exemplar` is the hex trace id of the
+        call that produced it (threaded from the traced seams), kept as
+        the bucket's most recent and — separately — max-valued link."""
+        i = bisect_right(self.boundaries, value)
         with self._lock:
-            self._counts[bisect_right(self.boundaries, value)] += 1
+            self._counts[i] += 1
             self._sum += value
             self._n += 1
+            if exemplar and EXEMPLARS_ENABLED:
+                ex = {"trace_id": exemplar, "value": float(value),
+                      "ts": time.time()}
+                slot = self._exemplars.get(i)
+                if slot is None:
+                    slot = self._exemplars[i] = {}
+                slot["last"] = ex
+                cur_max = slot.get("max")
+                if cur_max is None or value >= cur_max["value"]:
+                    slot["max"] = ex
 
     def snapshot(self):
         # Locked: without it a snapshot can read a torn (counts, sum, n)
         # triple while observe() is mid-update on another thread.
         with self._lock:
-            return {"type": "histogram", "boundaries": self.boundaries,
+            snap = {"type": "histogram", "boundaries": self.boundaries,
                     "counts": list(self._counts), "sum": self._sum,
                     "count": self._n}
+            if self._exemplars:
+                # str bucket keys: the snapshot crosses msgpack AND the
+                # dashboard's JSON surfaces (JSON objects key by string)
+                snap["exemplars"] = {
+                    str(i): {k: dict(v) for k, v in slot.items()}
+                    for i, slot in self._exemplars.items()}
+            return snap
+
+    def reset_exemplars(self):
+        with self._lock:
+            self._exemplars.clear()
+
+    def _delegate_to(self, existing):
+        self.boundaries = existing.boundaries
+        self.observe = existing.observe
+        self.snapshot = existing.snapshot
+        self.reset_exemplars = existing.reset_exemplars
 
 
 class Registry:
@@ -95,16 +165,49 @@ class Registry:
         self._metrics: dict[str, Metric] = {}
         self._lock = threading.Lock()
 
-    def register(self, metric: Metric):
+    def register(self, metric: Metric) -> Metric:
+        """Register `metric`, returning the canonical instance for its
+        name: the existing one when a same-typed metric is already
+        registered (with a warning — the caller's instance proxies to
+        it), else `metric` itself. A same-named metric of a DIFFERENT
+        type replaces (the old registration was wrong), still warned."""
         with self._lock:
+            existing = self._metrics.get(metric.name)
+            if existing is not None and existing is not metric:
+                if type(existing) is type(metric):
+                    logger.warning(
+                        "metric %r registered twice; keeping the "
+                        "existing instance (prior values preserved)",
+                        metric.name)
+                    return existing
+                logger.warning(
+                    "metric %r re-registered as %s (was %s); replacing",
+                    metric.name, type(metric).__name__,
+                    type(existing).__name__)
             self._metrics[metric.name] = metric
+            return metric
 
     def get(self, name: str) -> Metric | None:
-        return self._metrics.get(name)
+        # Locked (satellite fix): an unlocked dict read can race a
+        # register() rehash on another thread.
+        with self._lock:
+            return self._metrics.get(name)
 
     def snapshot(self) -> dict:
         with self._lock:
             return {name: m.snapshot() for name, m in self._metrics.items()}
+
+    def reset_exemplars(self) -> None:
+        """Clear every histogram's exemplars. Exemplar trace ids are
+        CLUSTER-scoped (they resolve against one GCS trace table): a
+        process connecting to a new cluster must not keep advertising
+        outliers whose trees died with the previous one."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for m in metrics:
+            reset = getattr(m, "reset_exemplars", None)
+            if reset is not None:
+                reset()
 
 
 _REGISTRY = Registry()
@@ -118,31 +221,99 @@ def snapshot() -> dict:
     return _REGISTRY.snapshot()
 
 
+def reset_exemplars() -> None:
+    _REGISTRY.reset_exemplars()
+
+
 # Log-spaced seconds boundaries shared by the per-hop latency histograms
 # (task queue-wait/lease/exec/reply/e2e, serve router queue/e2e).
 LATENCY_BOUNDARIES_S = [0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
                         0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0]
 
+# Wider band for jit-compile wall time (compiles run 10ms..minutes; the
+# task-latency band would saturate at 10s and hide a compile storm).
+COMPILE_BOUNDARIES_S = [0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+                        5.0, 10.0, 30.0, 60.0, 120.0]
 
-def percentile(hist_snapshot: dict, q: float) -> float:
+
+def percentile(hist_snapshot: dict, q: float,
+               with_saturation: bool = False):
     """Estimate the q-quantile (0..1) from a histogram SNAPSHOT — the
     upper boundary of the bucket containing the quantile (how the serve
     autoscaler reads router p99 from cluster_metrics()). Quantiles
     landing in the unbounded overflow bucket CLAMP to the top boundary
     (Prometheus histogram_quantile convention; inf would not survive
-    the JSON surfaces) — a reading AT the top boundary means "at least
-    this", and consumers watching for saturation should pair it with
-    the .count rate."""
+    the JSON surfaces) — a clamped reading means "at least this much".
+
+    `with_saturation=True` returns `(value, saturated)` instead, where
+    `saturated` is True exactly when the quantile landed in the
+    overflow bucket — the explicit signal consumers (`ray-tpu top`'s
+    `≥` rendering, the stall doctor) need to tell saturation from a
+    real reading."""
     counts = hist_snapshot.get("counts") or []
     boundaries = hist_snapshot.get("boundaries") or []
     total = hist_snapshot.get("count", 0)
     if not total or not counts:
-        return 0.0
+        return (0.0, False) if with_saturation else 0.0
     target = q * total
     acc = 0
+    value, saturated = 0.0, False
     for i, c in enumerate(counts):
         acc += c
         if acc >= target:
-            return (boundaries[i] if i < len(boundaries)
-                    else boundaries[-1] if boundaries else 0.0)
-    return boundaries[-1] if boundaries else 0.0
+            saturated = i >= len(boundaries)
+            value = (boundaries[i] if i < len(boundaries)
+                     else boundaries[-1] if boundaries else 0.0)
+            break
+    else:
+        saturated = True
+        value = boundaries[-1] if boundaries else 0.0
+    return (value, saturated) if with_saturation else value
+
+
+def overflow_count(hist_snapshot: dict) -> int:
+    """Observations in the unbounded overflow bucket (surfaced beside
+    .p99 in the metrics-history flattening)."""
+    counts = hist_snapshot.get("counts") or []
+    boundaries = hist_snapshot.get("boundaries") or []
+    if len(counts) <= len(boundaries):
+        return 0
+    return int(counts[len(boundaries)])
+
+
+def quantile_exemplar(hist_snapshot: dict, q: float = 0.99) -> dict | None:
+    """The exemplar that best explains the q-quantile: the max-valued
+    exemplar of the highest populated bucket at/above the quantile
+    bucket (i.e. one real outlier whose trace id a p99 row can print).
+    Falls back to lower buckets' max exemplar when the tail carried
+    none. Returns {"trace_id", "value", "ts"} or None."""
+    exemplars = hist_snapshot.get("exemplars")
+    if not exemplars:
+        return None
+    counts = hist_snapshot.get("counts") or []
+    total = hist_snapshot.get("count", 0)
+    if not total:
+        return None
+    target = q * total
+    acc = 0
+    q_bucket = len(counts) - 1
+    for i, c in enumerate(counts):
+        acc += c
+        if acc >= target:
+            q_bucket = i
+            break
+    best = None
+    for key, slot in exemplars.items():
+        try:
+            i = int(key)
+        except (TypeError, ValueError):
+            continue
+        ex = slot.get("max") or slot.get("last")
+        if ex is None:
+            continue
+        # prefer the highest bucket >= the quantile bucket; else the
+        # highest bucket below it
+        rank = (1, i) if i >= q_bucket else (0, i)
+        if best is None or rank > best[0]:
+            best = (rank, ex)
+    return dict(best[1]) if best else None

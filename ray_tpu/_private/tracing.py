@@ -163,6 +163,18 @@ def current() -> TraceContext | None:
     return _CTX.get()
 
 
+def current_id() -> str | None:
+    """Hex trace id of the ambient context (the histogram-exemplar
+    form), or None when the current call is unsampled."""
+    ctx = _CTX.get()
+    return ctx.trace_id.hex() if ctx is not None else None
+
+
+def exemplar_of(ctx: TraceContext | None) -> str | None:
+    """Hex trace id of `ctx` for Histogram.observe(exemplar=...)."""
+    return ctx.trace_id.hex() if ctx is not None else None
+
+
 def push(ctx: TraceContext | None):
     """Set the ambient context (even to None — execution scopes shadow
     any caller-thread leftovers); returns the reset token."""

@@ -151,6 +151,9 @@ def shutdown():
     from ray_tpu._private import debug_state as _ds
 
     _ds.reset_stall_dedup()
+    from ray_tpu._private import sampling_profiler as _sprof
+
+    _sprof.stop()
     cw = global_state.get_core_worker()
     if cw is not None:
         cw.shutdown()
@@ -376,6 +379,69 @@ def trace_spans(trace_id: str | None = None) -> list[dict]:
     process (`component_type`/`component_id`/`node_id`) and the span's
     `tid`/`sid`/`psid` linkage in `extra_data`."""
     return global_state.require_core_worker().get_trace_spans(trace_id)
+
+
+def profile(seconds: float | None = 2.0, component: str | None = None,
+            out: str | None = None) -> dict:
+    """Cluster-wide CPU flamegraph off the continuous profiling plane
+    (sampling_profiler.py): every process class (driver, workers,
+    raylets, GCS director + shards) runs an always-on ~67 Hz wall-clock
+    sampler whose collapsed stacks flush to the GCS profile ring on the
+    ~2 s profile cadence.
+
+    With `seconds=N` collects a fresh window: waits N seconds (plus up
+    to one flush cadence for the tail) and returns the sampler windows
+    OVERLAPPING it — a ~2s flush window already open when collection
+    starts is included whole, so a short collection may carry up to one
+    cadence of immediately-preceding stacks. `seconds=None` returns
+    everything the ring holds.
+    `component` filters to one process class (driver|worker|raylet|
+    gcs|gcs-shard); `out` also writes the collapsed text to a file.
+
+    Returns {"collapsed": str, "components": [...], "samples": int,
+    "batches": [...]} — `collapsed` is Brendan-Gregg collapsed-stack
+    text (one `component;thread;frame;... count` line per stack; feed
+    it to flamegraph.pl / speedscope), `batches` the raw ring rows
+    (sampling_profiler.samples_to_chrome_trace renders them as merged
+    Perfetto tracks)."""
+    import time as _time
+
+    from ray_tpu._private import sampling_profiler as _sprof
+
+    cw = global_state.require_core_worker()
+    if seconds is not None:
+        since = _time.time()
+        _time.sleep(max(0.0, float(seconds)))
+        batches = _sprof.wait_for_coverage(
+            lambda: cw.get_profile_samples(since=since,
+                                           component=component),
+            component)
+    else:
+        batches = cw.get_profile_samples(component=component)
+    collapsed = _sprof.collapse_text(batches)
+    if out:
+        with open(out, "w") as f:
+            f.write(collapsed + ("\n" if collapsed else ""))
+    return {
+        "collapsed": collapsed,
+        "components": _sprof.components_of(batches),
+        "samples": sum(b.get("samples", 0) for b in batches),
+        "batches": batches,
+    }
+
+
+def set_profiling(hz: float) -> None:
+    """Arm/re-rate the continuous profiler cluster-wide, live: every
+    process's sampler thread flips to `hz` samples/s (0 stops it; the
+    default is RAY_TPU_PROFILE_HZ, ~67). Rides the internal KV + pubsub
+    plane exactly like failpoint arming and trace-sampling overrides,
+    so running processes and any spawned later both honor it."""
+    from ray_tpu._private import sampling_profiler as _sprof
+
+    hz = min(_sprof.MAX_HZ, max(0.0, float(hz)))
+    cw = global_state.require_core_worker()
+    cw.kv_put(_sprof.KV_KEY, repr(hz).encode())
+    _sprof.apply_kv_value(repr(hz))  # local apply; push also lands
 
 
 def set_trace_sampling(rate: float) -> None:

@@ -108,10 +108,28 @@ class _DeviceOps:
         if fn is None:
             from jax.sharding import PartitionSpec as P
 
-            fn = self._cache[key] = jax.jit(_shard_map(
+            from ray_tpu._private import profiling as _profiling
+
+            jitted = jax.jit(_shard_map(
                 body, self.mesh, P(self.axis, None),
                 out_specs if out_specs is not None
                 else P(self.axis, None)))
+
+            def first_call(*args, _jitted=jitted, _key=key):
+                # cache fill: the first dispatch carries the compile —
+                # record it (count + jax.compile_s + a `jax.compile`
+                # span) and swap the bare jitted fn into the cache
+                import time as _time
+
+                t0 = _time.time()
+                out = _jitted(*args)
+                _profiling.record_compile(
+                    "collective:" + ":".join(map(str, _key)),
+                    t0, _time.time())
+                self._cache[_key] = _jitted
+                return out
+
+            fn = self._cache[key] = first_call
         return fn
 
     # -- exact bodies ---------------------------------------------------

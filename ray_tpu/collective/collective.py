@@ -197,17 +197,30 @@ def _prep(tensor):
 def _traced_op(name: str, group_name: str, fn, nbytes: int | None = None):
     """Collective trace entry point (tracing.py): continues an ambient
     trace (op inside a traced task/replica call) or head-samples a fresh
-    root, recording one `collective.<op>` span over the op."""
+    root, recording one `collective.<op>` span over the op. The
+    `collective.op_s` histogram observes EVERY call (sampled or not),
+    with the sampled caller's trace id as its exemplar."""
+    import time as _time
+
     from ray_tpu._private import tracing
+    from ray_tpu.collective import metrics as _metrics
 
     ctx = tracing.maybe_trace()
+    t0 = _time.time()
     if ctx is None:
-        return fn()
+        try:
+            return fn()
+        finally:
+            _metrics.OP_S.observe(_time.time() - t0)
     extra = {"group": group_name}
     if nbytes is not None:
         extra["bytes"] = nbytes
-    with tracing.span(name, ctx, extra, ambient=True):
-        return fn()
+    try:
+        with tracing.span(name, ctx, extra, ambient=True):
+            return fn()
+    finally:
+        _metrics.OP_S.observe(_time.time() - t0,
+                              exemplar=tracing.exemplar_of(ctx))
 
 
 def allreduce(tensor, group_name: str = "default",

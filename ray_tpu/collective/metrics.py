@@ -21,3 +21,9 @@ QUANT_SAVED = stats.Count(
     "collective.quantized_bytes_saved_total",
     "wire bytes avoided by int8 block-scaled quantized collectives "
     "(exact-dtype bytes minus quantized payload+scale bytes)")
+
+OP_S = stats.Histogram(
+    "collective.op_s", stats.LATENCY_BOUNDARIES_S,
+    "collective op wall time (allreduce/reduce/broadcast/allgather/"
+    "reducescatter/barrier), every call on every tier; exemplar links "
+    "the sampled caller's trace")

@@ -10,6 +10,8 @@ Endpoints:
     /api/objects per-node object store usage
     /api/timeline chrome-trace JSON of recorded profile spans
     /api/trace   Perfetto JSON of the trace table (?trace_id= one tree)
+    /api/profile cluster flamegraph off the continuous-profiler ring
+                 (?component=, ?since=, ?format=collapsed|perfetto|raw)
     /api/metrics/history per-source metric time series (?samples=N)
     /api/events  structured cluster events ring
     /api/state   live debug_state of every process (?component=serve|
@@ -182,6 +184,28 @@ class Dashboard:
         rows = await self._gcs("get_trace_spans", {"trace_id": trace_id})
         return spans_to_chrome_trace(rows)
 
+    async def profile(self, component: str | None = None,
+                      since: float | None = None,
+                      fmt: str = "collapsed"):
+        """Cluster-wide flamegraph off the GCS profile ring
+        (sampling_profiler.py): ?format=collapsed (text lines) |
+        perfetto (merged tracks) | raw (ring batches);
+        ?component= one process class, ?since= unix-seconds floor."""
+        from ray_tpu._private import sampling_profiler as _sprof
+
+        batches = await self._gcs("get_profile_samples",
+                                  {"component": component,
+                                   "since": since})
+        if fmt == "raw":
+            return batches
+        if fmt == "perfetto":
+            return _sprof.samples_to_chrome_trace(batches)
+        return {
+            "collapsed": _sprof.collapse_text(batches, component),
+            "components": _sprof.components_of(batches),
+            "samples": sum(b.get("samples", 0) for b in batches),
+        }
+
     async def metrics_history(self, samples: int = 0) -> dict:
         """Per-source metric time series from the GCS ring buffers."""
         return await self._gcs("get_metrics_history", {"samples": samples})
@@ -261,7 +285,25 @@ class Dashboard:
                     {"error": "samples must be an integer"}, status=400)
             return web.json_response(await self.metrics_history(samples))
 
+        async def profile_handler(request):
+            q = request.rel_url.query
+            try:
+                since = float(q["since"]) if "since" in q else None
+            except ValueError:
+                return web.json_response(
+                    {"error": "since must be a unix timestamp"},
+                    status=400)
+            fmt = q.get("format", "collapsed")
+            if fmt not in ("collapsed", "perfetto", "raw"):
+                return web.json_response(
+                    {"error": "format must be collapsed|perfetto|raw"},
+                    status=400)
+            return web.json_response(await self.profile(
+                component=q.get("component"), since=since, fmt=fmt),
+                dumps=lambda o: json.dumps(o, default=_hexify))
+
         app.router.add_get("/api/trace", trace_handler)
+        app.router.add_get("/api/profile", profile_handler)
         app.router.add_get("/api/metrics/history", history_handler)
 
         async def state_handler(request):
@@ -313,6 +355,12 @@ class Dashboard:
             ready_cb(self._site_port)
         while True:
             await asyncio.sleep(3600)
+
+
+def _hexify(obj):
+    if isinstance(obj, bytes):
+        return obj.hex()
+    return repr(obj)
 
 
 def main():

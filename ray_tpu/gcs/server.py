@@ -31,6 +31,7 @@ import time
 from ray_tpu._private import debug_state as _debug
 from ray_tpu._private import failpoints as _fp
 from ray_tpu._private import rpc
+from ray_tpu._private import sampling_profiler as _sprof
 from ray_tpu._private import stats as _stats
 from ray_tpu._private import tracing as _tracing
 from ray_tpu._private.common import InsufficientResources, ResourceSet
@@ -101,12 +102,23 @@ class GcsServer:
         # one request's cross-process tree is queryable by trace id.
         self.trace_spans: _collections.deque = _collections.deque(
             maxlen=50_000)
+        # Continuous-profiling ring (sampling_profiler.py): collapsed-
+        # stack sample batches from every process class, bounded —
+        # director-memory-only like the other observability rings.
+        self.profile_samples: _collections.deque = _collections.deque(
+            maxlen=4000)
+        # per-shard t_end of the last ingested profiler window (the
+        # at-least-once ack _drain_shard_profiles carries)
+        self._shard_profile_acks: dict[int, float] = {}
         # Metrics time series: source -> metric -> ring of [ts, value]
         # samples, fed by raylet heartbeat piggybacks and worker/driver
         # push_metrics notifies (~2s cadence; ~10 min of history).
         self.metrics_history: dict[str, dict] = {}
         self.metrics_history_samples = 300
         self.metrics_last_push: dict[str, float] = {}
+        # histogram p99 exemplars (trace-id strings can't ride the
+        # scalar rings): source -> hist name -> {"trace_id","value","ts"}
+        self.metrics_exemplars: dict[str, dict] = {}
         # History epoch: metrics-history and trace rings are DIRECTOR
         # MEMORY ONLY by contract (ARCHITECTURE.md "State introspection"
         # — the lossy-restart contract): a restart resets them, and
@@ -128,6 +140,9 @@ class GcsServer:
         if _tracing.KV_KEY in self.kv:
             # so does a live trace-sampling override
             _tracing.apply_kv_value(self.kv[_tracing.KV_KEY])
+        if _sprof.KV_KEY in self.kv:
+            # and a live profiling-rate override
+            _sprof.apply_kv_value(self.kv[_sprof.KV_KEY])
         self.jobs = dict(st.table("jobs"))
         self.next_job = st.get("meta", "next_job", 1)
         now = time.monotonic()
@@ -214,6 +229,8 @@ class GcsServer:
             "add_profile_events": self.h_add_profile_events,
             "get_profile_events": self.h_get_profile_events,
             "get_trace_spans": self.h_get_trace_spans,
+            "add_profile_samples": self.h_add_profile_samples,
+            "get_profile_samples": self.h_get_profile_samples,
             "push_metrics": self.h_push_metrics,
             "get_metrics_history": self.h_get_metrics_history,
             "report_event": self.h_report_event,
@@ -267,6 +284,9 @@ class GcsServer:
         spec = self.kv.get(_fp.KV_KEY)
         if spec:
             await conn.notify("configure_failpoints", {"spec": spec})
+        hz = self.kv.get(_sprof.KV_KEY)
+        if hz:
+            await conn.notify("configure_profiling", {"spec": hz})
 
     async def _mirror(self, table: str, key, value):
         """Push one actor/pg public record (value=None deletes) to the
@@ -318,6 +338,15 @@ class GcsServer:
             # same apply-here + broadcast plane as the failpoints
             _tracing.apply_kv_value(d["value"])
             await self.publish(_tracing.CHANNEL, d["value"])
+        elif key == _sprof.KV_KEY:
+            # live profiler arming (ray_tpu.set_profiling): apply here,
+            # broadcast to subscribers, forward to the store shards
+            # (they don't subscribe to pubsub)
+            _sprof.apply_kv_value(d["value"])
+            await self.publish(_sprof.CHANNEL, d["value"])
+            if self.shard_addresses:
+                await self._broadcast_shards(
+                    "configure_profiling", {"spec": d["value"]})
         return True
 
     async def h_kv_get(self, conn, d):
@@ -858,6 +887,80 @@ class GcsServer:
             out = [s for s in out if s["extra_data"].get("tid") == tid]
         return out
 
+    async def h_add_profile_samples(self, conn, d):
+        """One collapsed-stack sample batch from any process's sampler
+        (sampling_profiler.py) into the bounded profile ring."""
+        if _fp.ARMED:
+            # same seam class as the trace table: `raise` models a
+            # failed ring apply — batch dropped here, typed; the
+            # sender's bounded merge-back path stays untouched
+            try:
+                await _fp.fire_async_strict("gcs.profile_ring.apply")
+            except _fp.FailpointError:
+                M_TRACE_APPLY_FAILURES.inc()
+                logger.warning("profile ring apply failed (failpoint); "
+                               "dropping batch of %d stacks",
+                               len(d.get("stacks", ())))
+                return False
+        if d.get("stacks"):
+            self.profile_samples.append({
+                k: d.get(k) for k in (
+                    "component_type", "component_id", "node_id",
+                    "t_start", "t_end", "hz", "samples", "stacks")})
+        return True
+
+    async def h_get_profile_samples(self, conn, d):
+        """Profile-ring read: optionally filtered to one component class
+        and/or to batches whose window ended at/after `since`."""
+        component = d.get("component")
+        since = d.get("since")
+        out = []
+        for b in self.profile_samples:
+            if component and b.get("component_type") != component:
+                continue
+            if since is not None and (b.get("t_end") or 0) < float(since):
+                continue
+            out.append(b)
+        return out
+
+    def _ingest_own_profile(self):
+        """The director IS the ring: its own sampler batches ingest
+        directly (no RPC), on the heartbeat-checker cadence."""
+        batch = _sprof.drain_batch("gcs")
+        if batch is not None:
+            self.profile_samples.append(batch)
+
+    async def _drain_shard_profiles(self):
+        """Pull the store shards' sampler windows into the ring (shards
+        don't dial the director; the director polls them on the same
+        cadence that mirrors flow). Each call acks the previously
+        ingested window's t_end — a timed-out reply makes the shard
+        merge that window back instead of losing it."""
+        for idx in range(len(self.shard_addresses)):
+            try:
+                conn = await self._shard_conn(idx)
+                batch = await asyncio.wait_for(
+                    conn.call("drain_profile_samples",
+                              {"ack": self._shard_profile_acks.get(idx)}),
+                    timeout=2.0)
+                if batch and batch.get("stacks"):
+                    self.profile_samples.append(batch)
+                    self._shard_profile_acks[idx] = batch.get("t_end")
+            except Exception:
+                pass  # delayed, not lost: the shard re-merges unacked
+
+    async def _profile_ingest_loop(self):
+        """~2s profile cadence for the control plane itself: fold the
+        director's own sampler window (and the shards') into the ring."""
+        while True:
+            await asyncio.sleep(2.0)
+            try:
+                self._ingest_own_profile()
+                if self.shard_addresses:
+                    await self._drain_shard_profiles()
+            except Exception:  # pragma: no cover - must never die
+                logger.exception("profile ingest tick failed")
+
     def _ingest_metrics(self, source: str, snap: dict):
         """One timestamped sample per metric into the per-source ring.
         Histograms flatten to scalar series (.count/.sum/.p99) so the
@@ -881,7 +984,22 @@ class GcsServer:
                 if kind == "histogram":
                     put(name + ".count", m.get("count", 0))
                     put(name + ".sum", m.get("sum", 0.0))
-                    put(name + ".p99", _stats.percentile(m, 0.99))
+                    p99, saturated = _stats.percentile(
+                        m, 0.99, with_saturation=True)
+                    put(name + ".p99", p99)
+                    # saturation is explicit, not inferred: a p99 AT the
+                    # top boundary means "at least this" only when the
+                    # quantile actually landed in the overflow bucket
+                    put(name + ".p99_saturated", 1.0 if saturated else 0.0)
+                    overflow = _stats.overflow_count(m)
+                    if overflow:
+                        put(name + ".overflow", overflow)
+                    ex = _stats.quantile_exemplar(m, 0.99)
+                    if ex is not None:
+                        # exemplars are strings; they ride a side table
+                        # beside the scalar rings, newest wins
+                        self.metrics_exemplars.setdefault(
+                            source, {})[name] = ex
                 else:
                     put(name, m.get("value", 0.0))
             except (TypeError, ValueError, AttributeError):
@@ -897,6 +1015,7 @@ class GcsServer:
                       if t < cutoff]:
             self.metrics_history.pop(stale, None)
             self.metrics_last_push.pop(stale, None)
+            self.metrics_exemplars.pop(stale, None)
 
     async def h_push_metrics(self, conn, d):
         """Metric sample push from a worker/driver process (raylets ride
@@ -920,6 +1039,11 @@ class GcsServer:
             return {"meta": {"started_at": self.started_at,
                              "retention_samples":
                                  self.metrics_history_samples},
+                    # p99 exemplars: the trace id behind each histogram's
+                    # current tail (`ray-tpu top` prints it; `ray-tpu
+                    # trace --trace-id` resolves it to the span tree)
+                    "exemplars": {s: dict(ex) for s, ex in
+                                  self.metrics_exemplars.items()},
                     "series": out}
         return out
 
@@ -967,6 +1091,7 @@ class GcsServer:
             "rings": {"events": len(self.events),
                       "profile_events": len(self.profile_events),
                       "trace_spans": len(self.trace_spans),
+                      "profile_samples": len(self.profile_samples),
                       "metrics_sources": len(self.metrics_history)},
             "rpc": {"server_conns": len(self.server.connections)},
         }
@@ -1356,6 +1481,11 @@ class GcsServer:
         actual = await self.server.start_tcp(host=cfg.bind_host, port=port,
                                              uds_dir=uds_dir)
         asyncio.create_task(self.heartbeat_checker())
+        # continuous profiling: the director samples itself (a KV-armed
+        # rate applied in _restore outranks the env default) and folds
+        # its own + the shards' windows into the profile ring
+        _sprof.start("gcs")
+        asyncio.create_task(self._profile_ingest_loop())
         if self.shard_addresses:
             asyncio.create_task(self._connect_shards())
         logger.info("GCS listening on %s:%d (advertised %s)",

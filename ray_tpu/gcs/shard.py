@@ -41,6 +41,7 @@ import msgpack
 from ray_tpu._private import debug_state as _debug
 from ray_tpu._private import failpoints as _fp
 from ray_tpu._private import rpc
+from ray_tpu._private import sampling_profiler as _sprof
 from ray_tpu._private import stats as _stats
 from ray_tpu._private.config import Config, get_config, set_config
 from ray_tpu.gcs.journal import Journal
@@ -65,6 +66,8 @@ class GcsShard:
         self.placement_groups: dict[bytes, dict] = {}
         self.journal = journal
         self._flush_fut: asyncio.Future | None = None
+        # last drained-but-unacked profiler window (h_drain_profile_samples)
+        self._profile_pending: dict | None = None
         if journal is not None:
             replayed = journal.recover(self._apply_snapshot, self._apply)
             if replayed:
@@ -199,6 +202,8 @@ class GcsShard:
             "mirror_apply": self.h_mirror_apply,
             "prune_node": self.h_prune_node,
             "configure_failpoints": self.h_configure_failpoints,
+            "configure_profiling": self.h_configure_profiling,
+            "drain_profile_samples": self.h_drain_profile_samples,
             "shard_snapshot": self.h_shard_snapshot,
             "get_metrics": self.h_get_metrics,
             "debug_state": self.h_debug_state,
@@ -309,6 +314,33 @@ class GcsShard:
         _fp.apply_kv_value(d["spec"])
         return True
 
+    async def h_configure_profiling(self, conn, d):
+        """Live profiler arming forwarded by the director (same push
+        path as configure_failpoints — shards don't subscribe to the
+        pubsub plane)."""
+        _sprof.apply_kv_value(d["spec"])
+        return True
+
+    async def h_drain_profile_samples(self, conn, d):
+        """Drain this shard's sampler window for the director's profile
+        ring (the director polls on its ~2s ingest cadence).
+
+        At-least-once: the drained batch is held until the NEXT call
+        carries `ack` = its t_end (proof the director ingested it); an
+        unmatched ack means the reply was lost (director timeout) — the
+        held window merges back into the bounded table and rides the
+        fresh drain, instead of being destroyed invisibly."""
+        pending = self._profile_pending
+        if pending is not None:
+            if d.get("ack") == pending.get("t_end"):
+                self._profile_pending = None
+            else:
+                _sprof.merge_back(pending)
+                self._profile_pending = None
+        batch = _sprof.drain_batch("gcs-shard")
+        self._profile_pending = batch
+        return batch or {}
+
     async def h_shard_snapshot(self, conn, d):
         """Canonical table-state bytes (chaos sweep bit-identical check)
         + op counter."""
@@ -327,6 +359,7 @@ class GcsShard:
                   uds_dir: str | None = None):
         cfg = get_config()
         _debug.start_loop_lag_monitor()
+        _sprof.start("gcs-shard")
         actual = await self.server.start_tcp(host=cfg.bind_host, port=port,
                                              uds_dir=uds_dir)
         logger.info("GCS shard %d listening on %s:%d", self.index,

@@ -301,6 +301,8 @@ def main(seconds_per_case: float = 2.0) -> list[dict]:
 
     _tracing_ab(results)
 
+    _profiling_ab(results)
+
     _state_ab(results)
 
     _serve_mixed(results)
@@ -1159,6 +1161,81 @@ def _tracing_ab(results: list[dict]):
         ("tracing A/B serve http qps", on_rates),
         ("tracing A/B serve http qps (tracing-off control)", off_rates),
     ], windows=5)
+    pool.shutdown()
+    serve.shutdown()
+
+
+def _profiling_ab(results: list[dict]):
+    """Continuous-profiler overhead A/B (the tier-1 gate in
+    test_observability reads these rows): the wall-clock sampler armed
+    at its DEFAULT rate (~67 Hz, what every process pays out of the
+    box) against a profiler-off control, paired-interleaved on the two
+    rows the gate watches — tasks sync and serve http qps. The arm flip
+    rides the live KV+pubsub plane (`ray_tpu.set_profiling`), so both
+    slices of each window run identical code; the only delta is the
+    sampler thread walking `sys._current_frames` plus the ~2s window
+    flush into the GCS profile ring."""
+    from ray_tpu import serve
+    from ray_tpu._private import sampling_profiler as _sprof
+
+    def arm(hz: float):
+        def setup():
+            ray_tpu.set_profiling(hz)
+            # the pubsub flip reaches raylet/worker/proxy processes
+            # asynchronously; give it a beat before the slice starts
+            time.sleep(0.1)
+
+        return setup
+
+    default_hz = _sprof.default_hz()
+    PR = lambda fn: {"": (arm(default_hz), fn),  # noqa: E731
+                     "profiler-off control": (arm(0.0), fn)}
+
+    @ray_tpu.remote
+    def small_task():
+        return b"ok"
+
+    def task_sync():
+        ray_tpu.get(small_task.remote())
+
+    # 5 windows (not the default 3): the sampler's per-window cost is
+    # small relative to box drift on this class of 1-2 core runner, so
+    # the median needs more interleaved windows to converge
+    timeit_ab("profiling A/B tasks sync", PR(task_sync), windows=5,
+              results=results)
+
+    client = serve.start(http=True)
+    client.create_backend("noop_pr", lambda _=None: "ok", config={
+        "num_replicas": 2, "max_batch_size": 32,
+        "batch_wait_timeout": 0.001, "max_concurrent_queries": 8})
+    client.create_endpoint("noop_pr", backend="noop_pr", route="/noop_pr")
+    handle = client.get_handle("noop_pr")
+    ray_tpu.get(handle.remote(None), timeout=60)  # warm the path
+
+    import threading as _threading
+    from concurrent.futures import ThreadPoolExecutor
+
+    pool = ThreadPoolExecutor(max_workers=16)
+    tls = _threading.local()
+    port = client.http_port
+
+    def http_window(seconds: float = 0.7) -> float:
+        return _http_qps_window(pool, tls, port, "/noop_pr", seconds)
+
+    arm(default_hz)()
+    http_window(0.2)  # warm keep-alive conns
+    on_rates, off_rates = [], []
+    for _ in range(9):  # see the tasks-sync note: more pairs, less drift
+        arm(default_hz)()
+        on_rates.append(http_window())
+        arm(0.0)()
+        off_rates.append(http_window())
+    arm(default_hz)()  # leave the cluster at the default rate
+    _rate_rows(results, [
+        ("profiling A/B serve http qps", on_rates),
+        ("profiling A/B serve http qps (profiler-off control)",
+         off_rates),
+    ], windows=9)
     pool.shutdown()
     serve.shutdown()
 

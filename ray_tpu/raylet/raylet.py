@@ -29,6 +29,7 @@ import time
 from ray_tpu._private import debug_state as _debug
 from ray_tpu._private import failpoints as _fp
 from ray_tpu._private import rpc
+from ray_tpu._private import sampling_profiler as _sprof
 from ray_tpu._private import tracing
 from ray_tpu._private.common import InsufficientResources, ResourceSet
 from ray_tpu._private.config import Config, get_config, set_config
@@ -877,8 +878,9 @@ class Raylet:
         span (child of the requesting task's root) when the spec carries
         a sampled trace context."""
         now = time.time()
-        self.m_lease_grant_s.observe(now - t0)
         root = tracing.from_wire(spec.get("trace"))
+        self.m_lease_grant_s.observe(now - t0,
+                                     exemplar=tracing.exemplar_of(root))
         if root is not None:
             tracing.record_span("raylet.lease", t0, now,
                                 tracing.child(root),
@@ -1353,6 +1355,8 @@ class Raylet:
             max_sources=cfg.max_pull_sources,
             io_timeout=cfg.bulk_transfer_io_timeout_s,
             trace=tracing.to_wire(ctx) if ctx is not None else None))
+        transfer.M_PULL_S.observe(time.time() - t0,
+                                  exemplar=tracing.exemplar_of(ctx))
         if ctx is not None:
             tracing.record_span("transfer.pull", t0, time.time(), ctx,
                                 {"object_id": oid[:6].hex(),
@@ -1937,6 +1941,9 @@ class Raylet:
         if channel == tracing.CHANNEL:
             tracing.apply_kv_value(data)
             return
+        if channel == _sprof.CHANNEL:
+            _sprof.apply_kv_value(data)
+            return
         if channel == "nodes":
             node = data["node"]
             if data["event"] in ("added", "updated"):
@@ -2049,8 +2056,11 @@ class Raylet:
         if now - self._last_profile_flush < 2.0:
             return
         self._last_profile_flush = now
+        if self.gcs is None:
+            return
+        await self._flush_profile_samples()
         events = self._profile.drain()
-        if not events or self.gcs is None:
+        if not events:
             return
         try:
             if _fp.ARMED:
@@ -2063,6 +2073,13 @@ class Raylet:
             })
         except Exception:
             self._profile.requeue(events)
+
+    async def _flush_profile_samples(self):
+        """Flush the continuous-profiler window into the GCS profile
+        ring (sampling_profiler.flush_to: the shared drain +
+        `profile.flush` seam + bounded merge-back contract)."""
+        await _sprof.flush_to(self.gcs, "raylet",
+                              node_id=self.node_id.binary())
 
     async def heartbeat_loop(self):
         interval = self.config.heartbeat_interval_s
@@ -2105,6 +2122,7 @@ class Raylet:
     async def run(self, port: int = 0, ready_file: str | None = None):
         self._loop = asyncio.get_running_loop()
         _debug.start_loop_lag_monitor()
+        _sprof.start("raylet")
         actual = await self.server.start_tcp(
             host=self.config.bind_host, port=port,
             uds_dir=os.path.join(self.session_dir, "sock"))
@@ -2133,6 +2151,10 @@ class Raylet:
             rate = await conn.call("kv_get", {"key": tracing.KV_KEY})
             if rate:
                 tracing.apply_kv_value(rate)
+            await conn.call("subscribe", {"channel": _sprof.CHANNEL})
+            hz = await conn.call("kv_get", {"key": _sprof.KV_KEY})
+            if hz:
+                _sprof.apply_kv_value(hz)
             nodes = await conn.call("get_all_nodes", {})
             self.cluster_nodes = {n["node_id"]: n for n in nodes}
             await conn.call("register_node", {

@@ -82,6 +82,10 @@ M_PULLS_STRIPED = _stats.Count(
 M_INFLIGHT_CHUNKS = _stats.Gauge(
     "raylet.transfer_inflight_chunks",
     "bulk-transfer chunk records currently being sent/received")
+M_PULL_S = _stats.Histogram(
+    "transfer.pull_s", _stats.LATENCY_BOUNDARIES_S,
+    "bulk pull wall time, registration -> object sealed (receiver "
+    "side); exemplar links the pulling request's trace")
 
 # ---------------------------------------------------------------------------
 # live-transfer registry (debug_state / stall doctor): every in-flight

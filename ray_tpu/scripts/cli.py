@@ -308,28 +308,81 @@ def cmd_trace(args) -> int:
     return 0
 
 
+def _top_snapshot(reply, flt=None) -> dict:
+    """Structured rate/p99 table off one get_metrics_history reply —
+    shared by the live text render and `--json --once` (scripts/CI).
+    {"meta", "sources": {source: {metric: {latest, ts, rate?, p99_ms?,
+    saturated?, exemplar?}}}}."""
+    if isinstance(reply, dict) and "series" in reply:
+        hist = reply["series"]
+        meta = reply.get("meta") or {}
+        exemplars = reply.get("exemplars") or {}
+    else:  # pre-meta GCS
+        hist, meta, exemplars = reply, {}, {}
+    sources: dict = {}
+    for source in sorted(hist):
+        rings = hist[source]
+        rows: dict = {}
+        for name in sorted(rings):
+            series = rings[name]
+            if not series or (flt and flt not in name):
+                continue
+            if name.endswith(".p99_saturated"):
+                continue  # folded into the .p99 row below
+            ts, val = series[-1]
+            row = {"latest": val, "ts": ts}
+            if name.endswith(".p99"):
+                row["p99_ms"] = val * 1e3
+                sat = rings.get(name + "_saturated")
+                row["saturated"] = bool(sat and sat[-1][1])
+                base = name[:-len(".p99")]
+                ex = (exemplars.get(source) or {}).get(base)
+                if ex:
+                    row["exemplar"] = ex.get("trace_id")
+                    row["exemplar_value_ms"] = ex.get("value", 0) * 1e3
+            elif len(series) >= 2 and (name.endswith("_total")
+                                       or name.endswith(".count")):
+                # rate-over-window is only meaningful for counters —
+                # a rising gauge (bytes in use) is a level, not a flow
+                (t0, v0), (t1, v1) = series[0], series[-1]
+                if t1 > t0 and v1 >= v0:
+                    row["rate_per_s"] = (v1 - v0) / (t1 - t0)
+            rows[name] = row
+        if rows:
+            sources[source] = rows
+    return {"meta": meta, "sources": sources}
+
+
 def cmd_top(args) -> int:
     """Live cluster metrics view off the GCS time-series ring (the
     `ray-tpu top` analog of `ray status -v`, refreshed in place).
     Shows, per source, the latest sample plus a rate over the window
-    for counters and the current p99 for latency histograms."""
+    for counters and the current p99 for latency histograms — with a
+    `≥` marker when the p99 saturated its top bucket and the p99
+    exemplar's trace id (resolve it: `ray-tpu trace --trace-id`).
+    `--json --once`: one machine-readable snapshot for scripts/CI."""
     import time as _time
 
     addr = _gcs_address(args)
     if not addr:
         print("no cluster found", file=sys.stderr)
         return 1
+    if getattr(args, "once", False) or getattr(args, "json", False):
+        # --json is a one-shot machine-readable snapshot: looping would
+        # interleave clear-screen escapes into the JSON stream
+        args.iterations = 1
 
     epoch = [None]  # GCS history epoch across renders (reset marker)
 
     def render() -> int:
         reply = _rpc_call(addr, "get_metrics_history",
                           {"samples": 0, "meta": True})
-        if isinstance(reply, dict) and "series" in reply:
-            hist = reply["series"]
-            started = (reply.get("meta") or {}).get("started_at")
-        else:  # pre-meta GCS
-            hist, started = reply, None
+        snap = _top_snapshot(reply, args.filter)
+        if getattr(args, "json", False):
+            snap["collected_at"] = _time.time()
+            print(json.dumps(snap, indent=1, default=str))
+            return len(snap["sources"])
+        started = snap["meta"].get("started_at")
         reset = (epoch[0] is not None and started is not None
                  and started != epoch[0])
         if started is not None:
@@ -342,36 +395,28 @@ def cmd_top(args) -> int:
             # splicing fresh samples onto the old view
             lines.append("  ===== history reset: GCS (re)started — "
                          "rings cleared, rates restart from zero =====")
-        for source in sorted(hist):
-            rings = hist[source]
+        for source, rows_d in snap["sources"].items():
             rows = []
-            for name in sorted(rings):
-                series = rings[name]
-                if not series:
+            newest = 0.0
+            for name, row in rows_d.items():
+                newest = max(newest, row["ts"])
+                if "p99_ms" in row:
+                    sat = "≥" if row.get("saturated") else " "
+                    ex = (f"  trace={row['exemplar']}"
+                          if row.get("exemplar") else "")
+                    rows.append(f"    {name:<44}{sat}"
+                                f"{row['p99_ms']:8.2f} ms{ex}")
                     continue
-                if args.filter and args.filter not in name:
-                    continue
-                ts, val = series[-1]
-                if name.endswith(".p99"):
-                    rows.append(f"    {name:<44} {val * 1e3:9.2f} ms")
-                    continue
-                rate = ""
-                # rate-over-window is only meaningful for counters —
-                # a rising gauge (bytes in use) is a level, not a flow
-                if len(series) >= 2 and (name.endswith("_total")
-                                         or name.endswith(".count")):
-                    (t0, v0), (t1, v1) = series[0], series[-1]
-                    if t1 > t0 and v1 >= v0:
-                        rate = f"  ({(v1 - v0) / (t1 - t0):8.1f}/s)"
-                rows.append(f"    {name:<44} {val:12g}{rate}")
+                rate = (f"  ({row['rate_per_s']:8.1f}/s)"
+                        if "rate_per_s" in row else "")
+                rows.append(f"    {name:<44} {row['latest']:12g}{rate}")
             if rows:
-                age = _time.time() - max(s[-1][0] for s in rings.values()
-                                         if s)
+                age = _time.time() - newest
                 lines.append(f"  {source}  (sample {age:.1f}s old, "
                              f"{len(rows)} metrics)")
                 lines.extend(rows)
         print(f"ray-tpu top — {_time.strftime('%H:%M:%S')} — "
-              f"{len(hist)} sources")
+              f"{len(snap['sources'])} sources")
         if lines:
             print("\n".join(lines))
         else:
@@ -393,6 +438,65 @@ def cmd_top(args) -> int:
                 _time.sleep(args.interval)
     except KeyboardInterrupt:
         pass
+    return 0
+
+
+def cmd_profile(args) -> int:
+    """Cluster-wide CPU flamegraph off the continuous profiling plane:
+    collect `--seconds` of sampler windows from the GCS profile ring
+    and write collapsed-stack text (flamegraph.pl / speedscope input),
+    optionally Perfetto tracks (--perfetto). `--hz` re-arms the
+    cluster sampler rate for the window (restored after)."""
+    import time as _time
+
+    from ray_tpu._private import sampling_profiler as _sprof
+
+    addr = _gcs_address(args)
+    if not addr:
+        print("no cluster found", file=sys.stderr)
+        return 1
+    prev_hz = None
+    if args.hz is not None:
+        prev_hz = _rpc_call(addr, "kv_get", {"key": _sprof.KV_KEY})
+        _rpc_call(addr, "kv_put", {"key": _sprof.KV_KEY,
+                                   "value": repr(float(args.hz)).encode()})
+    try:
+        since = _time.time()
+        _time.sleep(max(0.0, args.seconds))
+        batches = _sprof.wait_for_coverage(
+            lambda: _rpc_call(addr, "get_profile_samples",
+                              {"since": since,
+                               "component": args.component}),
+            args.component)
+        classes = _sprof.components_of(batches)
+    finally:
+        if args.hz is not None:
+            # restore the prior override, or b"default" — every process
+            # re-derives ITS OWN env/budget rate (writing this host's
+            # number would pin a derated node to the CLI box's default)
+            _rpc_call(addr, "kv_put", {
+                "key": _sprof.KV_KEY,
+                "value": prev_hz or b"default"})
+    if not batches:
+        print("(no profile samples — is the profiler armed? see "
+              "RAY_TPU_PROFILE_HZ / ray_tpu.set_profiling)")
+        return 1
+    collapsed = _sprof.collapse_text(batches, args.component)
+    out = args.out or "profile.collapsed"
+    if out == "-":
+        print(collapsed)
+    else:
+        with open(out, "w") as f:
+            f.write(collapsed + "\n")
+    if args.perfetto:
+        with open(args.perfetto, "w") as f:
+            json.dump(_sprof.samples_to_chrome_trace(batches), f)
+    samples = sum(b.get("samples", 0) for b in batches)
+    print(f"{samples} samples across {len(classes)} process class(es) "
+          f"({', '.join(classes)}); wrote {len(collapsed.splitlines())} "
+          f"collapsed stacks to {out}"
+          + (f" + Perfetto tracks to {args.perfetto}"
+             if args.perfetto else ""))
     return 0
 
 
@@ -793,7 +897,33 @@ def main(argv=None) -> int:
                    help="stop after N refreshes (0 = until Ctrl-C)")
     p.add_argument("--filter", default=None,
                    help="only metrics whose name contains this substring")
+    p.add_argument("--once", action="store_true",
+                   help="render one snapshot and exit (= --iterations 1)")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable snapshot (rates, p99s, "
+                        "saturation flags, exemplar trace ids) for "
+                        "scripts and CI")
     p.set_defaults(fn=cmd_top)
+
+    p = sub.add_parser("profile",
+                       help="cluster-wide CPU flamegraph (collapsed "
+                            "stacks off the continuous profiler)")
+    p.add_argument("--address", default=None)
+    p.add_argument("--seconds", type=float, default=2.0,
+                   help="collection window (default 2)")
+    p.add_argument("--component", default=None,
+                   choices=["driver", "worker", "raylet", "gcs",
+                            "gcs-shard"],
+                   help="one process class only (default: all)")
+    p.add_argument("-o", "--out", default=None,
+                   help="collapsed-stack output path "
+                        "(profile.collapsed; '-' = stdout)")
+    p.add_argument("--perfetto", default=None,
+                   help="also write merged Perfetto tracks JSON here")
+    p.add_argument("--hz", type=float, default=None,
+                   help="re-arm the cluster sampler at this rate for "
+                        "the window (restored after)")
+    p.set_defaults(fn=cmd_profile)
 
     p = sub.add_parser("state",
                        help="live cluster introspection (debug_state "

@@ -210,10 +210,11 @@ def parse_stream_request(data) -> tuple[list[int], int, str | None, bool]:
 class Sequence:
     __slots__ = ("seq_id", "prompt", "max_tokens", "session", "generated",
                  "channel", "submitted_at", "admitted_at", "cached_tokens",
-                 "kv_sum")
+                 "kv_sum", "trace_id")
 
     def __init__(self, seq_id: str, prompt: list[int], max_tokens: int,
-                 session: str | None, channel: TokenChannel | None):
+                 session: str | None, channel: TokenChannel | None,
+                 trace_id: str | None = None):
         self.seq_id = seq_id
         self.prompt = prompt
         self.max_tokens = max_tokens
@@ -223,6 +224,9 @@ class Sequence:
         self.submitted_at = time.time()
         self.admitted_at = None
         self.cached_tokens = 0  # session-cache prefix reused at admit
+        # hex trace id of the submitting request (when sampled): the
+        # decode-step histogram's exemplar link back to one stream
+        self.trace_id = trace_id
         # running sum of this sequence's cached KV rows, maintained
         # incrementally (one page-table gather at admission, O(width)
         # per step after — the decode loop must not re-walk T pages per
@@ -306,8 +310,11 @@ class DecodeEngine:
         engine's death error (typed ReplicaGroupDied) once dead."""
         from ray_tpu import exceptions as exc
 
+        from ray_tpu._private import tracing as _tracing
+
         seq_id = uuid.uuid4().hex[:12]
         ch = TokenChannel(seq_id)
+        trace_id = _tracing.current_id()
         with self._lock:
             if self._dead is not None:
                 raise self._dead
@@ -319,7 +326,7 @@ class DecodeEngine:
                     self._backend, len(self._waiting), self._max_waiting,
                     self._retry_after)
             seq = Sequence(seq_id, list(prompt), int(max_tokens),
-                           session, ch)
+                           session, ch, trace_id=trace_id)
             self._waiting.append(seq)
             self._channels[seq_id] = ch
         self._wake.set()
@@ -379,10 +386,17 @@ class DecodeEngine:
                     "decode step failed; killing engine")
                 self._die(e)
                 break
-            M_DECODE_STEP_S.observe(time.perf_counter() - t0)
+            # measure the step BEFORE taking the engine lock: a submit
+            # burst contending it must not inflate decode_step_s (the
+            # stall doctor scales its decode threshold from this p99)
+            step_s = time.perf_counter() - t0
             with self._lock:
+                exemplar = next((s.trace_id
+                                 for s in self._running.values()
+                                 if s.trace_id), None)
                 M_DECODE_BATCH.set(len(self._running))
                 self._last_step_at = time.time()
+            M_DECODE_STEP_S.observe(step_s, exemplar=exemplar)
             if self._steps % 256 == 0:
                 # under sustained load the idle-path reap never runs;
                 # finished channels must still age out
@@ -594,7 +608,8 @@ class DecodeEngine:
                     seq.session = None
             if seq.channel is not None:
                 if seq.channel.first_token_at is None:
-                    M_TTFT_S.observe(time.time() - seq.submitted_at)
+                    M_TTFT_S.observe(time.time() - seq.submitted_at,
+                                     exemplar=seq.trace_id)
                 seq.channel.push(tok)
                 emitted += 1
             if done:

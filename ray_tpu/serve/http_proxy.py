@@ -294,7 +294,8 @@ class HTTPProxy:
                                          headers=headers)
             finally:
                 end = time.time()
-                M_HTTP_E2E_S.observe(end - t0)
+                M_HTTP_E2E_S.observe(end - t0,
+                                     exemplar=tracing.exemplar_of(ctx))
                 if token is not None:
                     tracing.pop(token)
                     tracing.record_span("http.request", t0, end, ctx,

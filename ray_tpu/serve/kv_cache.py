@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 
 import numpy as np
 
@@ -311,11 +312,34 @@ class PagedKVCache:
 def _make_donated_update():
     """Jitted single-row page write with the arena DONATED: XLA reuses
     the input buffer for the output, so the per-token update is in-place
-    instead of an O(arena) copy (the jax path of `append`)."""
+    instead of an O(arena) copy (the jax path of `append`). The first
+    dispatch per arena shape records a compile event (the decode-step
+    seam of the jax.compile_s / recompile-storm plane)."""
     import jax
+
+    from ray_tpu._private import profiling as _profiling
 
     def _update(pages, page, slot, row):
         return pages.at[page, slot].set(row)
 
-    return jax.jit(_update, donate_argnums=(0,),
-                   static_argnums=())
+    jitted = jax.jit(_update, donate_argnums=(0,), static_argnums=())
+    # the arena shape is fixed for the cache's lifetime, so exactly the
+    # FIRST dispatch compiles — record it with a one-shot flag (this
+    # runs per token inside the cache lock; no per-call key building)
+    state = {"compiled": False}
+
+    def update(pages, page, slot, row):
+        if state["compiled"]:
+            return jitted(pages, page, slot, row)
+        t0 = time.time()
+        out = jitted(pages, page, slot, row)
+        # only a SUCCESSFUL first dispatch proves the compile (same
+        # contract as CompileProbe: a transient failure must leave the
+        # retry recordable)
+        state["compiled"] = True
+        _profiling.record_compile(
+            "serve.kv_update:" + _profiling.shape_class(pages),
+            t0, time.time())
+        return out
+
+    return update

@@ -12,6 +12,7 @@ import cloudpickle
 
 from ray_tpu._private import failpoints as _fp
 from ray_tpu._private import stats as _stats
+from ray_tpu._private import tracing as _tracing
 from ray_tpu.serve.engine import StreamingEngineHost
 
 M_REPLICA_EXEC_S = _stats.Histogram(
@@ -108,7 +109,11 @@ class Replica(StreamingEngineHost):
             else:
                 out = [self._callable(r) for r in requests]
         finally:
-            M_REPLICA_EXEC_S.observe(time.time() - start)
+            # the batch executes inside the traced task's ambient
+            # context (router's tracing.use around .remote()), so the
+            # exemplar links this batch's slowest-request tree
+            M_REPLICA_EXEC_S.observe(time.time() - start,
+                                     exemplar=_tracing.current_id())
             self._batches_handled += 1
             self._last_batch_at = time.time()
         if self._threshold:

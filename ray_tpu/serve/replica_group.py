@@ -37,6 +37,7 @@ import cloudpickle
 
 from ray_tpu._private import failpoints as _fp
 from ray_tpu._private import stats as _stats
+from ray_tpu._private import tracing as _tracing
 from ray_tpu.collective.collective import CollectiveActorMixin
 from ray_tpu.serve import payload as _payload
 from ray_tpu.serve.engine import StreamingEngineHost
@@ -241,7 +242,8 @@ class ReplicaGroupMember(CollectiveActorMixin, StreamingEngineHost):
                 self._peer_failure(refs) or f"{type(e).__name__}: {e}"
             ) from e
         finally:
-            M_GROUP_EXEC_S.observe(time.time() - start)
+            M_GROUP_EXEC_S.observe(time.time() - start,
+                                   exemplar=_tracing.current_id())
             self._batches_handled += 1
             self._last_batch_at = time.time()
         failure = self._peer_failure(refs, wait_s=self._group_timeout_s)

@@ -640,7 +640,9 @@ class Router:
             # so the replica-side exec span joins its request tree.
             now = time.time()
             for q in batch:
-                M_ROUTER_QUEUE_S.observe(now - q.t_enqueue)
+                M_ROUTER_QUEUE_S.observe(
+                    now - q.t_enqueue,
+                    exemplar=tracing.exemplar_of(q.trace))
                 if q.trace is not None:
                     tracing.record_span(
                         "serve.router_queue", q.t_enqueue, now,

@@ -26,6 +26,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.flatten_util import ravel_pytree
 
+from ray_tpu._private import profiling as _profiling
+
 
 class TrainingOperator:
     """Subclass and implement setup(); call self.register(...) there."""
@@ -126,6 +128,12 @@ class TrainingOperator:
         loss_fn, optimizer = self._loss_fn, self._optimizer
         unravel = self._unravel
         stateful = self._stateful
+        # compile observability (profiling.py): the first dispatch of a
+        # NEW batch shape class recompiles the jitted step — record it
+        # (jax.compiles_total / jax.compile_s / a `jax.compile` span) so
+        # a shape-churning loader reads as a recompile storm, not a
+        # mystery slowdown
+        self._compile_probe = _profiling.CompileProbe("train.step")
 
         # Fused path (single worker): grads + update in one jit, buffers
         # donated so XLA updates params/opt_state in place; loss stays on
@@ -210,23 +218,28 @@ class TrainingOperator:
 
     def _dispatch_batch(self, batch):
         """Run one step, returning the (possibly device-resident) loss."""
+        shape_key = _profiling.shape_class(batch)
         if self._mesh is not None:
             # SPMD over the (global) mesh — no HOST allreduce.
             batch = self._place_batch(batch)
-            self.params, self.model_state, self.opt_state, loss = (
-                self._fused_step(self.params, self.model_state,
-                                 self.opt_state, batch))
+            with self._compile_probe.watch("fused-mesh", shape_key):
+                self.params, self.model_state, self.opt_state, loss = (
+                    self._fused_step(self.params, self.model_state,
+                                     self.opt_state, batch))
             return loss
         if self.world_size == 1:
-            self.params, self.model_state, self.opt_state, loss = (
-                self._fused_step(self.params, self.model_state,
-                                 self.opt_state, batch))
+            with self._compile_probe.watch("fused", shape_key):
+                self.params, self.model_state, self.opt_state, loss = (
+                    self._fused_step(self.params, self.model_state,
+                                     self.opt_state, batch))
             return loss
-        loss, self.model_state, flat_grads = self._grad_step(
-            self.params, self.model_state, batch)
+        with self._compile_probe.watch("grad", shape_key):
+            loss, self.model_state, flat_grads = self._grad_step(
+                self.params, self.model_state, batch)
         flat_grads = self._allreduce_grads(flat_grads)
-        self.params, self.opt_state = self._apply_step(
-            self.params, self.opt_state, flat_grads)
+        with self._compile_probe.watch("apply", "flat"):
+            self.params, self.opt_state = self._apply_step(
+                self.params, self.opt_state, flat_grads)
         return loss
 
     def train_epoch(self, num_steps: int | None = None,
@@ -270,8 +283,11 @@ class TrainingOperator:
         for step, batch in enumerate(self._val_loader):
             if self._mesh is not None:
                 batch = self._place_batch(batch)
-            m = (self._jit_eval(self.params, self.model_state, batch)
-                 if self._stateful else self._jit_eval(self.params, batch))
+            with self._compile_probe.watch(
+                    "eval", _profiling.shape_class(batch)):
+                m = (self._jit_eval(self.params, self.model_state, batch)
+                     if self._stateful
+                     else self._jit_eval(self.params, batch))
             all_metrics.append({k: float(v) for k, v in m.items()})
             samples += _batch_size(batch)
             if num_steps is not None and step + 1 >= num_steps:

@@ -300,6 +300,24 @@ def leak_check(request):
     assert not leaked_segs, (
         f"test leaked /dev/shm collective segment(s) (now removed): "
         f"{sorted(leaked_segs)}{notes}")
+    # continuous-profiler hygiene: with no cluster held, this process
+    # must not keep a sampler thread alive (ray_tpu.shutdown stops it;
+    # a test that armed one directly must stop it too). Named so the
+    # failure reads as the sampler, not an anonymous thread.
+    import threading
+
+    from ray_tpu._private import sampling_profiler as _sprof
+
+    orphaned = [t for t in threading.enumerate()
+                if t.name == _sprof.THREAD_NAME and t.is_alive()]
+    if orphaned:
+        _sprof.stop()
+        orphan_names = [f"{t.name} (ident={t.ident}, daemon={t.daemon})"
+                        for t in orphaned]
+        raise AssertionError(
+            f"test leaked {len(orphaned)} orphaned sampler thread(s) "
+            f"(now stopped): {orphan_names} — a stopped runtime must "
+            f"stop its continuous profiler (sampling_profiler.stop)")
 
 
 @pytest.fixture

@@ -119,3 +119,55 @@ ray_tpu.shutdown()
         assert "RESULT: 42" in out.stdout
     finally:
         _cli(["stop"], env)
+
+
+def test_cli_profile_and_top_json(ray_start_regular, tmp_path, capsys):
+    """`ray-tpu profile --seconds 2` against a live cluster emits a
+    collapsed-stack flamegraph covering >=3 process classes (the
+    tentpole acceptance), and `ray-tpu top --json --once` returns the
+    machine-readable rate/p99 snapshot (satellite). In-process cli.main
+    against the fixture cluster — the start/stop plumbing is already
+    covered above."""
+    import json
+
+    from ray_tpu import api as _api
+    from ray_tpu.scripts import cli
+
+    addr = _api._global_node.gcs_address
+
+    @ray_tpu.remote
+    def f(x):
+        return x
+
+    assert ray_tpu.get([f.remote(i) for i in range(5)],
+                       timeout=60) == list(range(5))
+
+    collapsed = tmp_path / "prof.collapsed"
+    capsys.readouterr()
+    assert cli.main(["profile", "--address", addr, "--seconds", "2",
+                     "-o", str(collapsed)]) == 0
+    summary = capsys.readouterr().out
+    lines = collapsed.read_text().splitlines()
+    assert lines, "empty flamegraph"
+    classes = {line.split(";", 1)[0] for line in lines}
+    assert {"driver", "raylet", "gcs"} <= classes, (classes, summary)
+    # every line is collapsed-format: "frame;frame;... count"
+    for line in lines:
+        stack, count = line.rsplit(" ", 1)
+        assert int(count) > 0 and ";" in stack
+
+    # top --json --once: one-shot machine-readable snapshot
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline and not ray_tpu.cluster_metrics(
+            history=1):
+        time.sleep(0.3)
+    capsys.readouterr()
+    assert cli.main(["top", "--address", addr, "--json", "--once"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["sources"], doc
+    row = next(iter(next(iter(doc["sources"].values())).values()))
+    assert "latest" in row and "ts" in row
+    # p99 rows carry the saturation flag (and exemplars when traced)
+    p99s = [r for rs in doc["sources"].values()
+            for name, r in rs.items() if name.endswith(".p99")]
+    assert all("saturated" in r for r in p99s)

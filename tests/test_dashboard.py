@@ -107,5 +107,21 @@ def test_dashboard_endpoints(ray_start_regular):
     finally:
         ray_tpu.set_trace_sampling(0.01)
 
+    # continuous-profiler flamegraph endpoint: samples flow on the ~2s
+    # flush cadence from every process class
+    deadline = time.monotonic() + 20
+    prof = {}
+    while time.monotonic() < deadline:
+        prof = get_json("/api/profile")
+        if prof.get("samples") and len(prof.get("components", [])) >= 3:
+            break
+        time.sleep(0.4)
+    assert prof.get("samples"), prof
+    assert {"raylet", "gcs"} <= set(prof["components"]), prof["components"]
+    line = prof["collapsed"].splitlines()[0]
+    assert ";" in line and int(line.rsplit(" ", 1)[1]) > 0
+    perfetto = get_json("/api/profile?format=perfetto")
+    assert perfetto and all(e["ph"] == "X" for e in perfetto)
+
     with urllib.request.urlopen(base + "/", timeout=10) as r:
         assert b"ray_tpu cluster" in r.read()

@@ -694,14 +694,19 @@ def test_metrics_history_lossy_restart_contract(ray_start_regular):
 @pytest.mark.chaos
 def test_chaos_gcs_killed_mid_flush(ray_start_regular):
     """Seeded chaos case (satellite): the GCS dies while traced work is
-    flushing spans + metrics at 100% sampling. Required: no hang, no
-    unbounded buffer growth, and full recovery once the node monitor
-    restarts the GCS."""
+    flushing spans + metrics at 100% sampling AND the continuous
+    profiler is flushing sample windows at 100 Hz. Required: no hang,
+    no unbounded buffer growth on either plane (failed sample flushes
+    merge back into the bounded table — typed degradation, drops
+    counted), and full recovery once the node monitor restarts the GCS
+    (spans AND samples flow into the fresh rings)."""
     from ray_tpu import api as _api
     from ray_tpu._private import global_state
+    from ray_tpu._private import sampling_profiler as sp
 
     node = _api._global_node
     ray_tpu.set_trace_sampling(1.0)
+    ray_tpu.set_profiling(100.0)
     try:
         @ray_tpu.remote
         def work(i):
@@ -718,6 +723,9 @@ def test_chaos_gcs_killed_mid_flush(ray_start_regular):
             assert ray_tpu.get(work.remote(i), timeout=60) == i
         cw = global_state.require_core_worker()
         assert len(cw._profile) <= 20_000
+        time.sleep(2.5)  # at least one failed sample-flush cycle
+        prof = sp.get_profiler()
+        assert len(prof) <= prof.max_stacks
         deadline = time.monotonic() + 20
         while time.monotonic() < deadline:
             gcs = next((s for s in node.processes
@@ -738,8 +746,19 @@ def test_chaos_gcs_killed_mid_flush(ray_start_regular):
             s for s in spans
             if s["extra_data"].get("name", "").endswith("after")],
             timeout=30)
+        # and profiler samples refill the fresh profile ring from every
+        # process class (driver flush loop, raylet heartbeat, GCS self)
+        deadline = time.monotonic() + 30
+        classes: set = set()
+        while time.monotonic() < deadline:
+            classes = set(ray_tpu.profile(seconds=None)["components"])
+            if {"driver", "raylet", "gcs"} <= classes:
+                break
+            time.sleep(0.5)
+        assert {"driver", "raylet", "gcs"} <= classes, classes
     finally:
         ray_tpu.set_trace_sampling(0.01)
+        ray_tpu.set_profiling(0.0)
 
 
 # ---------------------------------------------------------------------------
@@ -800,3 +819,425 @@ def test_cli_trace_export_and_top(ray_start_regular, tmp_path, capsys):
         assert "ray-tpu top" in top_out and "raylet" in top_out, top_out
     finally:
         ray_tpu.set_trace_sampling(0.01)
+
+
+# ---------------------------------------------------------------------------
+# histogram exemplars: bucket capture -> p99 -> trace link
+# ---------------------------------------------------------------------------
+
+
+def test_histogram_exemplars_and_saturation_unit():
+    """Satellites: observe(exemplar=) keeps the most recent AND the
+    max-valued exemplar per bucket; percentile(with_saturation=True)
+    tells an overflow-bucket clamp from a real reading; overflow_count
+    surfaces the overflow population."""
+    h = stats.Histogram("obs_test.exemplar_hist",
+                        boundaries=[0.01, 0.1, 1.0])
+    h.observe(0.005)
+    h.observe(0.05, exemplar="aa01")
+    h.observe(0.09, exemplar="aa02")  # same bucket, later + larger
+    h.observe(0.5, exemplar="bb01")
+    snap = h.snapshot()
+    ex = snap["exemplars"]
+    mid = ex["1"]  # bucket (0.01, 0.1]
+    assert mid["last"]["trace_id"] == "aa02"
+    assert mid["max"]["trace_id"] == "aa02"
+    # a later-but-smaller observation updates `last`, keeps `max`
+    h.observe(0.02, exemplar="aa03")
+    mid = h.snapshot()["exemplars"]["1"]
+    assert mid["last"]["trace_id"] == "aa03"
+    assert mid["max"]["trace_id"] == "aa02"
+
+    # p99 in-range: not saturated; exemplar resolves to the tail bucket
+    val, sat = stats.percentile(h.snapshot(), 0.99,
+                                with_saturation=True)
+    assert not sat and val == 1.0
+    assert stats.quantile_exemplar(h.snapshot(), 0.99)[
+        "trace_id"] == "bb01"
+    assert stats.overflow_count(h.snapshot()) == 0
+
+    # push the tail into the overflow bucket: saturation is explicit
+    h.observe(5.0, exemplar="cc01")
+    h.observe(7.0)
+    snap = h.snapshot()
+    val, sat = stats.percentile(snap, 0.99, with_saturation=True)
+    assert sat and val == 1.0  # clamped to the top boundary
+    assert stats.overflow_count(snap) == 2
+    assert stats.quantile_exemplar(snap, 0.99)["trace_id"] == "cc01"
+    # plain percentile() keeps the old scalar shape for old callers
+    assert stats.percentile(snap, 0.99) == 1.0
+
+
+def test_registry_reregister_warns_and_preserves_counts():
+    """Satellite: registering a same-named metric twice keeps the FIRST
+    instance (prior increments preserved) and proxies the second to it
+    — a re-registered counter must not silently zero."""
+    c1 = stats.Count("obs_test.reregistered_counter")
+    c1.inc(3)
+    c2 = stats.Count("obs_test.reregistered_counter")
+    c2.inc(2)  # proxies to c1
+    assert stats.snapshot()["obs_test.reregistered_counter"][
+        "value"] == 5.0
+    assert stats.registry().get("obs_test.reregistered_counter") is c1
+    c1.inc()
+    assert c2.snapshot()["value"] == 6.0
+    # histograms proxy too (observe + snapshot share state)
+    h1 = stats.Histogram("obs_test.reregistered_hist", boundaries=[1.0])
+    h1.observe(0.5)
+    h2 = stats.Histogram("obs_test.reregistered_hist", boundaries=[1.0])
+    h2.observe(2.0)
+    assert h1.snapshot()["count"] == 2
+
+
+def test_exemplar_roundtrip_outlier_task_to_trace_tree(
+        ray_start_regular):
+    """Acceptance: a deliberately slow task becomes the task-e2e p99
+    exemplar, and its trace id resolves through trace_spans() to a
+    connected cross-process span tree — the `ray-tpu top` p99 row ->
+    `ray-tpu trace --trace-id` path."""
+    ray_tpu.set_trace_sampling(1.0)
+    try:
+        @ray_tpu.remote
+        def quick(i):
+            return i
+
+        @ray_tpu.remote
+        def outlier():
+            time.sleep(0.5)
+            return "slow"
+
+        assert ray_tpu.get([quick.remote(i) for i in range(10)],
+                           timeout=60) == list(range(10))
+        assert ray_tpu.get(outlier.remote(), timeout=60) == "slow"
+
+        snap = stats.snapshot()["core.task_e2e_s"]
+        ex = stats.quantile_exemplar(snap, 0.99)
+        assert ex is not None and ex["value"] >= 0.4, ex
+        tid = ex["trace_id"]
+        assert tid
+
+        # driver and worker flush their spans on INDEPENDENT ~2s
+        # cadences: wait until the tree holds both sides (the e2e root
+        # and the worker exec span), not merely until it exists
+        def whole_tree(spans):
+            t = _tree_of(spans, tid)
+            names = {s["event_type"] for s in t}
+            return t if {"task", "task.e2e"} <= names else None
+
+        tree = _wait_spans(whole_tree)
+        root = _assert_connected(tree)
+        assert root["event_type"] == "task.e2e"
+        kinds = {s["component_type"] for s in tree}
+        assert "driver" in kinds and "worker" in kinds, kinds
+    finally:
+        ray_tpu.set_trace_sampling(0.01)
+
+
+def test_metrics_history_carries_p99_exemplars(ray_start_regular):
+    """The GCS metrics-history meta reply surfaces each histogram's p99
+    exemplar beside the scalar rings (the `ray-tpu top` trace= link),
+    and the flattening adds the explicit .p99_saturated signal."""
+    from ray_tpu._private import global_state
+
+    ray_tpu.set_trace_sampling(1.0)
+    try:
+        @ray_tpu.remote
+        def tick():
+            return 1
+
+        assert ray_tpu.get([tick.remote() for _ in range(5)],
+                           timeout=60) == [1] * 5
+        cw = global_state.require_core_worker()
+        deadline = time.monotonic() + 20
+        exemplars, series = {}, {}
+        while time.monotonic() < deadline:
+            reply = cw._io.run(cw.gcs.call(
+                "get_metrics_history", {"samples": 0, "meta": True}))
+            exemplars = reply.get("exemplars") or {}
+            series = reply.get("series") or {}
+            if any("core.task_e2e_s" in d for d in exemplars.values()):
+                break
+            time.sleep(0.4)
+        src_name, d = next(
+            (s, d) for s, d in exemplars.items()
+            if "core.task_e2e_s" in d)
+        ex = d["core.task_e2e_s"]
+        assert ex["trace_id"] and ex["value"] > 0
+        # the GCS-side exemplar is one this driver actually recorded
+        # (same histogram the push carried); the trace-table resolution
+        # of the p99 exemplar is test_exemplar_roundtrip's pin
+        local = stats.snapshot()["core.task_e2e_s"]
+        local_tids = {slot[k]["trace_id"]
+                      for slot in (local.get("exemplars") or {}).values()
+                      for k in slot}
+        assert ex["trace_id"] in local_tids, (ex, local_tids)
+        # saturation flag series rides next to the p99 series (its
+        # VALUE is asserted on a deterministic histogram below — the
+        # accumulated task histogram may legitimately be saturated)
+        rings = series[src_name]
+        assert "core.task_e2e_s.p99" in rings
+        assert "core.task_e2e_s.p99_saturated" in rings
+
+        # deterministic saturation semantics end-to-end: in-range
+        # observations -> flag 0; overflow-bucket p99 -> flag 1 plus an
+        # .overflow count beside it
+        h = stats.Histogram("obs_test.sat_ring_hist",
+                            boundaries=[0.01, 0.1])
+        for _ in range(10):
+            h.observe(0.05)
+
+        def sat_rings():
+            reply = cw._io.run(cw.gcs.call(
+                "get_metrics_history", {"samples": 0, "meta": True}))
+            for rs in reply["series"].values():
+                if "obs_test.sat_ring_hist.p99_saturated" in rs:
+                    return rs
+            return None
+
+        deadline = time.monotonic() + 20
+        rs = None
+        while time.monotonic() < deadline:
+            rs = sat_rings()
+            if rs is not None:
+                break
+            time.sleep(0.4)
+        assert rs is not None, "saturation series never reached the ring"
+        assert rs["obs_test.sat_ring_hist.p99_saturated"][-1][1] == 0.0
+        assert "obs_test.sat_ring_hist.overflow" not in rs
+        for _ in range(50):
+            h.observe(5.0)  # past the 0.1 top boundary
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            rs = sat_rings()
+            if rs and rs["obs_test.sat_ring_hist.p99_saturated"][-1][1]:
+                break
+            time.sleep(0.4)
+        assert rs["obs_test.sat_ring_hist.p99_saturated"][-1][1] == 1.0
+        assert rs.get("obs_test.sat_ring_hist.overflow"), rs.keys()
+        assert rs["obs_test.sat_ring_hist.overflow"][-1][1] == 50.0
+    finally:
+        ray_tpu.set_trace_sampling(0.01)
+
+
+def test_doctor_exemplar_fallback_and_compile_storm_unit():
+    """diagnose() is pure: an untraced stalled item borrows the stage
+    histogram's p99 exemplar (trace_source="exemplar"), and a process
+    snapshot showing a recompile storm yields a compile_storm finding."""
+    from ray_tpu._private import debug_state
+
+    hist = {"type": "histogram", "boundaries": [0.1, 1.0],
+            "counts": [100, 1, 0], "count": 101, "sum": 12.0,
+            "exemplars": {"1": {"max": {"trace_id": "feed00", "value":
+                                        0.9, "ts": 1.0},
+                                "last": {"trace_id": "feed00", "value":
+                                         0.9, "ts": 1.0}}}}
+    snapshot = {
+        "driver": {
+            "pid": 1, "tasks": [
+                {"task_id": "t1", "name": "stuck", "stage": "exec",
+                 "age_s": 99.0}],  # untraced
+            "jax_compiles": {"total": 9, "recent_60s": 6,
+                             "recent_s": 4.2, "last_key":
+                             "train.step:grad:8x4"},
+        },
+    }
+    metrics = {"driver": {"core.task_exec_s": hist}}
+    findings = debug_state.diagnose(snapshot, metrics, floor_s=1.0,
+                                    p99_factor=3.0)
+    task = next(f for f in findings if f["kind"] == "task")
+    assert task["trace_id"] == "feed00"
+    assert task["trace_source"] == "exemplar"
+    storm = next(f for f in findings if f["kind"] == "compile_storm")
+    assert storm["stage"] == "compile"
+    assert "6 compiles" in storm["detail"]
+    # below the storm threshold: no finding
+    snapshot["driver"]["jax_compiles"]["recent_60s"] = 1
+    findings = debug_state.diagnose(snapshot, metrics, floor_s=1.0)
+    assert not any(f["kind"] == "compile_storm" for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# continuous profiling plane (sampling_profiler.py)
+# ---------------------------------------------------------------------------
+
+
+def test_sampling_profiler_collapse_flush_unit():
+    """Sampler unit contract: collapsed stacks aggregate per (thread,
+    stack), drain produces the wire batch, a failed flush merges back
+    bounded with drops counted, and exports render."""
+    import threading
+
+    from ray_tpu._private import sampling_profiler as sp
+
+    prof = sp.SamplingProfiler("testrole", max_stacks=8)
+    done = threading.Event()
+    t = threading.Thread(target=done.wait, name="parked-thread",
+                         daemon=True)
+    t.start()
+    try:
+        for _ in range(20):
+            prof.sample_once()
+        batch = prof.drain()
+        assert batch["samples"] >= 20
+        assert prof.drain() is None  # window cleared
+        threads = {r["thread"] for r in batch["stacks"]}
+        assert "parked-thread" in threads, threads
+        parked = next(r for r in batch["stacks"]
+                      if r["thread"] == "parked-thread")
+        # root-first collapsed format, ';'-separated, count aggregated
+        assert parked["stack"].split(";")[0].startswith("_bootstrap")
+        assert parked["stack"].split(";")[-1].startswith("wait")
+        assert parked["count"] == 20
+
+        # failed-flush merge-back: bounded, counted, retried next drain
+        base = sp.M_FLUSH_DROPPED.snapshot()["value"]
+        assert prof.merge_back(batch) == 0
+        again = prof.drain()
+        assert again["samples"] == batch["samples"]
+        big = {"t_start": 0.0, "stacks": [
+            {"thread": "x", "stack": f"frame{i}", "count": 1}
+            for i in range(12)]}
+        dropped = prof.merge_back(big)
+        assert dropped > 0
+        assert sp.M_FLUSH_DROPPED.snapshot()["value"] - base == dropped
+        kept = prof.drain()
+        folded = next(r for r in kept["stacks"]
+                      if r["stack"] == sp.OVERFLOW_STACK)
+        assert folded["count"] == dropped  # counts folded, not lost
+        assert sum(r["count"] for r in kept["stacks"]) == 12
+
+        # exports
+        batch["component_type"] = "testrole"
+        text = sp.collapse_text([batch])
+        line = text.splitlines()[0]
+        assert line.startswith("testrole;")
+        assert line.rsplit(" ", 1)[1].isdigit()
+        trace = sp.samples_to_chrome_trace([batch])
+        assert trace and all(e["ph"] == "X" for e in trace)
+        assert sp.components_of([batch]) == ["testrole"]
+    finally:
+        done.set()
+        prof.stop()
+        assert not prof.running
+
+
+def test_sampler_thread_arming_and_rate_zero():
+    """set_rate arms the named daemon thread; rate 0 stops it (the
+    conftest leak check names any survivor)."""
+    import threading
+
+    from ray_tpu._private import sampling_profiler as sp
+
+    prof = sp.SamplingProfiler("armrole")
+    prof.set_rate(200)
+    try:
+        assert prof.running
+        assert any(t.name == sp.THREAD_NAME
+                   for t in threading.enumerate())
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and len(prof) == 0:
+            time.sleep(0.05)
+        assert len(prof) > 0, "armed sampler never sampled"
+        prof.set_rate(0)
+        assert not prof.running
+    finally:
+        prof.stop()
+
+
+def test_profile_plane_end_to_end(ray_start_regular):
+    """Tentpole acceptance: the always-on plane covers >=3 process
+    classes (driver, raylet, GCS) in one collection window, and
+    set_profiling() re-arms it live cluster-wide."""
+    from ray_tpu._private import sampling_profiler as sp
+    from tests.conftest import scale_timeout
+
+    @ray_tpu.remote
+    def churn(i):
+        return sum(range(1000)) + i
+
+    assert ray_tpu.get([churn.remote(i) for i in range(8)],
+                       timeout=60) == [sum(range(1000)) + i
+                                       for i in range(8)]
+    rep = ray_tpu.profile(seconds=2.0)
+    assert rep["samples"] > 0
+    assert {"driver", "raylet", "gcs"} <= set(rep["components"]), (
+        rep["components"])
+    # collapsed text: component-prefixed, flamegraph-parseable
+    for line in rep["collapsed"].splitlines()[:5]:
+        stack, count = line.rsplit(" ", 1)
+        assert int(count) > 0 and stack.count(";") >= 1
+
+    # live disarm stops the local sampler thread; re-arm restarts it
+    ray_tpu.set_profiling(0.0)
+    deadline = time.monotonic() + scale_timeout(5)
+    while time.monotonic() < deadline and sp.rate() != 0.0:
+        time.sleep(0.1)
+    assert sp.rate() == 0.0
+    assert not sp.get_profiler().running
+    ray_tpu.set_profiling(100.0)
+    deadline = time.monotonic() + scale_timeout(5)
+    while time.monotonic() < deadline and not sp.get_profiler().running:
+        time.sleep(0.1)
+    assert sp.get_profiler().running
+    rep2 = ray_tpu.profile(seconds=1.0, component="driver")
+    assert rep2["components"] == ["driver"] and rep2["samples"] > 0
+
+
+def test_compile_probe_records_metrics_and_span(ray_start_regular):
+    """Compile observability: the paged-KV jax seam records its first-
+    dispatch compile into jax.compiles_total / jax.compile_s, and
+    record_compile emits a `jax.compile` span joining the ambient
+    trace."""
+    from ray_tpu._private import profiling, tracing
+    from ray_tpu.serve.kv_cache import PagedKVCache
+
+    base = profiling.M_COMPILES.snapshot()["value"]
+    kv = PagedKVCache(8, 4, 4, name="kv:obs_test", backend="jax")
+    kv.alloc_table("seq1")
+    import numpy as np
+
+    kv.append("seq1", np.ones((3, 4), dtype=np.float32))
+    assert profiling.M_COMPILES.snapshot()["value"] > base
+    hist = stats.snapshot()["jax.compile_s"]
+    assert hist["count"] >= 1
+    st = profiling.compile_state()
+    assert st["total"] >= 1 and st["last_key"]
+
+    # span joins an ambient trace
+    ray_tpu.set_trace_sampling(1.0)
+    try:
+        ctx = tracing.new_context()
+        with tracing.use(ctx):
+            profiling.record_compile("obs_test:shape", time.time() - 0.1,
+                                     time.time())
+        _wait_spans(lambda spans: [
+            s for s in spans
+            if s["event_type"] == "jax.compile"
+            and s["extra_data"].get("key") == "obs_test:shape"
+            and s["extra_data"].get("tid") == ctx.trace_id.hex()])
+    finally:
+        ray_tpu.set_trace_sampling(0.01)
+
+
+def test_microbench_profiling_overhead_gate():
+    """Gate on the recorded interleaved profiler-on/off A/B rows: >5%
+    throughput regression with the sampler armed at its default rate on
+    the tasks-sync or serve-http row fails tier-1 (reads
+    MICROBENCH.json — deterministic, no benchmarking in CI)."""
+    import json
+    import os
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    doc = json.load(open(os.path.join(root, "MICROBENCH.json")))
+    rows = {r["name"]: r for r in doc["results"]}
+    for case in ("profiling A/B tasks sync",
+                 "profiling A/B serve http qps"):
+        on_name, off_name = case, f"{case} (profiler-off control)"
+        assert on_name in rows and off_name in rows, (
+            f"missing profiling A/B row {case!r} in MICROBENCH.json")
+        on, off = rows[on_name], rows[off_name]
+        if on.get("high_variance") or off.get("high_variance"):
+            continue  # window noise, not signal (see timeit docstring)
+        assert on["per_second"] >= 0.95 * off["per_second"], (
+            f"{case}: profiler-on {on['per_second']:.1f}/s is >5% below "
+            f"profiler-off {off['per_second']:.1f}/s")
