@@ -2311,7 +2311,8 @@ class CoreWorker:
     # placement groups (reference: core_worker.cc:1524 CreatePlacementGroup)
     # ------------------------------------------------------------------
 
-    def create_placement_group(self, pg_id: bytes, bundles, strategy, name=""):
+    def create_placement_group(self, pg_id: bytes, bundles, strategy,
+                               name="", cost_model=""):
         # Quantize at the boundary: everything on the wire is FixedPoint
         # ints, same as task-spec resources (reference: fixed_point.h).
         return self._io.run(self.gcs.call("create_placement_group", {
@@ -2320,6 +2321,7 @@ class CoreWorker:
                         for b in bundles],
             "strategy": strategy,
             "name": name,
+            "cost_model": cost_model or "",
         }))
 
     def remove_placement_group(self, pg_id: bytes):
@@ -2357,7 +2359,10 @@ class CoreWorker:
                     if info is None or info.get("state") == "REMOVED":
                         raise ValueError(
                             f"placement group {pg_id.hex()} was removed")
-                    if info.get("state") == "CREATED":
+                    if info.get("state") in ("CREATED", "INFEASIBLE"):
+                        # INFEASIBLE is terminal-for-now: the caller
+                        # (PlacementGroup.ready) raises it typed rather
+                        # than parking until the fleet grows
                         return info
                     remaining = poll
                     if deadline is not None:

@@ -239,7 +239,8 @@ def collect_via_rpc(gcs_address: str, *, include_workers: bool = True,
 # flattening (the `ray-tpu state <component>` tables)
 # ---------------------------------------------------------------------------
 
-COMPONENTS = ("serve", "tasks", "actors", "objects", "leases", "transfers",
+COMPONENTS = ("serve", "placement", "tasks", "actors", "objects",
+              "leases", "transfers",
               "collectives")
 
 
@@ -303,6 +304,11 @@ def flatten(snapshot: dict, component: str) -> list[dict]:
         elif component == "collectives":
             for g in proc.get("collectives") or []:
                 rows.append({"process": label, **g})
+        elif component == "placement":
+            # per-pg bundle->node rows with topology coords and the
+            # chosen strategy/cost-model (GCS placement_table)
+            for row in proc.get("placement_table") or []:
+                rows.append({"process": label, **row})
         elif component == "serve":
             # per-router admission rows: queue depth vs bound, shed and
             # admitted totals (shed RATE comes from the metrics history;
@@ -503,8 +509,40 @@ def diagnose(snapshot: dict, metrics: dict | None = None, *,
                            f"({compiles['recent_s']:.1f}s wall, "
                            f"{compiles.get('total', 0)} total)"),
             })
+        # topology_mismatch: a CREATED gang whose members span ICI
+        # slices — its collectives pay DCN on every op even though a
+        # same-slice placement may exist; age-less (a property of the
+        # placement, not a stall)
+        for pg, rows in _pgs_by_id(proc.get("placement_table")).items():
+            slices = {r.get("slice") for r in rows if r.get("slice")}
+            if len(slices) > 1:
+                findings.append({
+                    "kind": "placement_group",
+                    "process": label,
+                    "stage": "topology_mismatch",
+                    "age_s": 0.0,
+                    "threshold_s": 0.0,
+                    "trace_id": "",
+                    "trace_source": "",
+                    "id": pg,
+                    "name": rows[0].get("name", ""),
+                    "detail": (f"gang spans slices "
+                               f"{sorted(slices)} "
+                               f"(strategy={rows[0].get('strategy')}): "
+                               f"collective ops cross DCN"),
+                })
     findings.sort(key=lambda f: -f["age_s"])
     return findings
+
+
+def _pgs_by_id(table) -> dict[str, list[dict]]:
+    """Group GCS placement_table bundle rows by pg id (CREATED rows
+    only — pending/infeasible rows carry no bundle geometry)."""
+    out: dict[str, list[dict]] = {}
+    for row in table or []:
+        if row.get("state") == "CREATED" and "bundle" in row:
+            out.setdefault(row.get("pg", "?"), []).append(row)
+    return out
 
 
 # Doctor findings dedup (satellite: one WARNING event per stalled trace,

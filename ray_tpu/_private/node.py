@@ -200,7 +200,8 @@ def start_raylet(session_dir: str, gcs_address: str, config: Config, *,
                  num_tpus: float = 0, resources: dict | None = None,
                  labels: dict | None = None, is_head=False,
                  store_root: str | None = None,
-                 tpu_slice: dict | None = None) -> tuple[ServiceProcess, str, NodeID, str]:
+                 tpu_slice: dict | None = None,
+                 topology: dict | None = None) -> tuple[ServiceProcess, str, NodeID, str]:
     node_id = node_id or NodeID.from_random()
     ready = os.path.join(session_dir, f"raylet_ready_{node_id.hex()[:8]}")
     log_file = os.path.join(session_dir, "logs",
@@ -227,6 +228,10 @@ def start_raylet(session_dir: str, gcs_address: str, config: Config, *,
         if hasattr(tpu_slice, "to_dict"):  # TpuSliceDescriptor
             tpu_slice = tpu_slice.to_dict()
         cmd += ["--tpu-slice", json.dumps(tpu_slice)]
+    if topology:
+        if hasattr(topology, "to_dict"):  # topology.TopologyCoord
+            topology = topology.to_dict()
+        cmd += ["--topology", json.dumps(topology)]
     if is_head:
         cmd += ["--is-head"]
     svc = _spawn(cmd, config, f"raylet-{node_id.hex()[:8]}")

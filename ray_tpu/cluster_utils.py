@@ -60,11 +60,13 @@ class Cluster:
     def add_node(self, *, num_cpus: float | None = None, num_tpus: float = 0,
                  resources: dict | None = None, labels: dict | None = None,
                  is_head: bool = False,
-                 tpu_slice: dict | None = None) -> ClusterNode:
+                 tpu_slice: dict | None = None,
+                 topology: dict | None = None) -> ClusterNode:
         svc, address, node_id, store_root = start_raylet(
             self.session_dir, self.gcs_address, self.config,
             num_cpus=num_cpus, num_tpus=num_tpus, resources=resources,
-            labels=labels, is_head=is_head, tpu_slice=tpu_slice)
+            labels=labels, is_head=is_head, tpu_slice=tpu_slice,
+            topology=topology)
         node = ClusterNode(svc, address, node_id, store_root)
         self.nodes.append(node)
         return node

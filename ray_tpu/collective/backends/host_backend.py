@@ -301,7 +301,7 @@ class _CollectiveState:
 class HostGroup:
     def __init__(self, group_name: str, world_size: int, rank: int,
                  timeout: float = 60.0, transport: str = "auto",
-                 quantize=None):
+                 quantize=None, placement_plan: dict | None = None):
         from ray_tpu.experimental import internal_kv
 
         self.group_name = group_name
@@ -321,6 +321,21 @@ class HostGroup:
         # (tests/benchmarks); "auto" routes by size and node placement.
         tr = Transport(transport)
         self.force_transport = None if tr == Transport.AUTO else tr.value
+        # Placement-derived tier (topology.transport_plan riding the
+        # gang's ICI_RING record): pins the transport WITHOUT the probe
+        # rounds the auto router pays (shm ok-flag exchange on non-shm
+        # groups, device vote). Explicit transport= wins over the plan.
+        self._transport_derived = False
+        self._placement_plan = placement_plan
+        self._probe_rounds = 0  # auto-router discovery rounds paid
+        if (placement_plan and placement_plan.get("transport")
+                and self.force_transport is None):
+            self.force_transport = Transport(
+                placement_plan["transport"]).value
+            self._transport_derived = True
+            from ray_tpu.collective import metrics as _metrics
+
+            _metrics.TRANSPORT_DERIVED.inc()
         # Group-default wire quantization (per-op quantize= overrides)
         self.quantize = normalize_quantize(quantize)
         # DEVICE tier state: built lazily on the first unanimous vote;
@@ -353,10 +368,18 @@ class HostGroup:
             self._listener.bind(("127.0.0.1", 0))
             self._listener.listen(world_size)
             port = self._listener.getsockname()[1]
+            # group metadata rides the rendezvous KV entry: a derived
+            # tier (and its per-rank placement rows) is visible to every
+            # joining rank, so an ad-hoc member initialized WITHOUT the
+            # plan (probe fallback path) still adopts the gang's tier
             internal_kv._kv_put(
                 self._key,
                 msgpack.packb({"addr": f"127.0.0.1:{port}",
-                               "world_size": world_size}))
+                               "world_size": world_size,
+                               "transport": (self.force_transport
+                                             if self._transport_derived
+                                             else None),
+                               "plan": self._placement_plan}))
             self._conn_threads = []
             accept_thread = threading.Thread(target=self._accept_loop,
                                              daemon=True)
@@ -375,6 +398,16 @@ class HostGroup:
                     f"rendezvous for group {group_name!r} timed out")
             if info["world_size"] != world_size:
                 raise ValueError("world_size mismatch at rendezvous")
+            if (info.get("transport") and self.force_transport is None
+                    and not self._transport_derived):
+                # adopt the leader's placement-derived tier from the KV
+                # metadata (this rank joined without the plan)
+                self.force_transport = Transport(info["transport"]).value
+                self._transport_derived = True
+                self._placement_plan = info.get("plan")
+                from ray_tpu.collective import metrics as _metrics
+
+                _metrics.TRANSPORT_DERIVED.inc()
             host, port = info["addr"].rsplit(":", 1)
             self._sock = socket.create_connection((host, int(port)),
                                                   timeout=timeout)
@@ -464,6 +497,8 @@ class HostGroup:
             "world_size": self.world_size,
             "backend": "host",
             "transport": self._forced() or "auto",
+            "transport_derived": self._transport_derived,
+            "probe_rounds": self._probe_rounds,
             "quantize": self.quantize or "",
             "op": op or "",
             "phase": dbg.get("phase", "idle"),
@@ -553,6 +588,30 @@ class HostGroup:
                 f"forced collective transport {tr!r} is unavailable for "
                 f"group {self.group_name!r} (world={self.world_size})")
 
+    def _demote_derived(self) -> None:
+        """A placement-DERIVED pin (not user-forced) turned out
+        unbuildable on this rank's runtime: fall back to auto routing.
+        Only called at group-uniform decision points (device shape
+        check, post-allgather vote result, the shm ok-flag exchange),
+        so every rank demotes in the same op and the routes stay
+        aligned."""
+        logger.warning(
+            "group %s: placement-derived transport %r unavailable; "
+            "demoting to auto routing", self.group_name,
+            self.force_transport)
+        self.force_transport = None
+        self._transport_derived = False
+
+    def _tier_unavailable(self, tr: str) -> bool:
+        """A routed tier could not be built. A placement-derived pin is
+        SOFT: demote to auto routing and tell the caller to re-route
+        (returns True). A user-forced pin raises."""
+        if self._transport_derived and self.force_transport == tr:
+            self._demote_derived()
+            return True
+        self._forced_unavailable(tr)
+        return False
+
     @staticmethod
     def _abort_not_hang(e: Exception):
         """Normalize transport failures: a dead/stalled peer surfaces as
@@ -631,9 +690,13 @@ class HostGroup:
             return False
         if not self._device_group_shaped():
             if forced == Transport.DEVICE.value:
-                self._forced_unavailable(forced)
+                # the shape gate is decided once at construction and is
+                # group-uniform by contract, so a derived-pin demotion
+                # here happens on every rank together
+                self._tier_unavailable(forced)
             return False
         self._dbg["phase"] = "device_vote"
+        self._probe_rounds += 1
         if _fp.ARMED:
             # fires BEFORE the agreement round: a rank hard-killed here
             # leaves every survivor timing out in the hub exchange
@@ -653,6 +716,11 @@ class HostGroup:
                                     kind="allgather_ctl_device")
         agreed = all(int(f[0]) for f in flags)
         if not agreed and forced == Transport.DEVICE.value:
+            if self._transport_derived:
+                # the vote result is an allgather — identical on every
+                # rank, so a derived pin demotes in unison here
+                self._demote_derived()
+                return False
             raise RuntimeError(
                 f"forced collective transport 'device' is unavailable "
                 f"for group {self.group_name!r}: the placement/dtype "
@@ -741,6 +809,11 @@ class HostGroup:
             self._shm.close()
             self._shm = None
         slot = max(1 << 20, 1 << (need_bytes - 1).bit_length())
+        if self._forced() != Transport.SHM.value:
+            # auto-routing discovery: the ok-flag exchange below is a
+            # probe round (a placement-derived/forced shm group pays
+            # the segment setup but not a *probe* — the tier was known)
+            self._probe_rounds += 1
         self._shm_gen += 1
         key = f"{self._key}/shm{self._shm_gen}"
         seg, ok = None, 0
@@ -1347,22 +1420,34 @@ class HostGroup:
                     hub_fn):
         """One route/fallback/poison dispatch for the uniform-geometry
         collectives (allgather is bespoke: its geometry may be ragged).
-        shm_fn(transport), ring_fn(pipelined: bool), hub_fn()."""
-        for tr in self._route(arr):
-            if tr == Transport.SHM.value:
-                t = self._ensure_shm(shm_need)
-                if t is None:
-                    self._forced_unavailable(tr)
-                    continue
-                return self._shm_op(lambda: shm_fn(t))
-            if tr in (Transport.RING.value, Transport.RING_UNPIPELINED.value):
-                if not self._ring_op(self._ensure_ring):
-                    self._forced_unavailable(tr)
-                    continue
-                pipelined = tr == Transport.RING.value
-                return self._ring_op(lambda: ring_fn(pipelined))
-            return hub_fn()
-        raise RuntimeError("no collective transport available")
+        shm_fn(transport), ring_fn(pipelined: bool), hub_fn(). A
+        placement-derived pin whose tier can't be built demotes
+        (group-uniformly — shm's ok-flag exchange / the uniform ring
+        build result) and re-routes, instead of raising like a
+        user-forced one."""
+        while True:
+            rerouted = False
+            for tr in self._route(arr):
+                if tr == Transport.SHM.value:
+                    t = self._ensure_shm(shm_need)
+                    if t is None:
+                        if self._tier_unavailable(tr):
+                            rerouted = True
+                            break
+                        continue
+                    return self._shm_op(lambda: shm_fn(t))
+                if tr in (Transport.RING.value,
+                          Transport.RING_UNPIPELINED.value):
+                    if not self._ring_op(self._ensure_ring):
+                        if self._tier_unavailable(tr):
+                            rerouted = True
+                            break
+                        continue
+                    pipelined = tr == Transport.RING.value
+                    return self._ring_op(lambda: ring_fn(pipelined))
+                return hub_fn()
+            if not rerouted:
+                raise RuntimeError("no collective transport available")
 
     @_op_entry("allreduce")
     def allreduce(self, arr: np.ndarray, op: ReduceOp = ReduceOp.SUM,
@@ -1450,7 +1535,9 @@ class HostGroup:
             if tr == Transport.SHM.value:
                 t = self._ensure_shm(self._shm_need(arr, None))
                 if t is None:
-                    self._forced_unavailable(tr)
+                    # derived pin demotes (uniform) and this op falls
+                    # through to the unconditional hub below
+                    self._tier_unavailable(tr)
                     continue
                 out = self._shm_op(lambda: t.allgather(arr))
                 if out is not None:
@@ -1458,7 +1545,7 @@ class HostGroup:
                 continue  # defense-in-depth: shm saw ragged metas
             if tr in (Transport.RING.value, Transport.RING_UNPIPELINED.value):
                 if not self._ring_op(self._ensure_ring):
-                    self._forced_unavailable(tr)
+                    self._tier_unavailable(tr)
                     continue
                 return self._ring_op(
                     lambda: self._ring_allgather_pipelined(arr))

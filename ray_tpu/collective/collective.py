@@ -25,7 +25,8 @@ class GroupManager:
 
     def create_group(self, group_name: str, world_size: int, rank: int,
                      backend: Backend, timeout: float = 60.0,
-                     transport: str = "auto", quantize=None):
+                     transport: str = "auto", quantize=None,
+                     placement_plan: dict | None = None):
         backend = Backend(backend)
         quantize = normalize_quantize(quantize)
         if backend == Backend.AUTO:
@@ -38,7 +39,8 @@ class GroupManager:
 
             group = HostGroup(group_name, world_size, rank, timeout=timeout,
                               transport=Transport(transport).value,
-                              quantize=quantize)
+                              quantize=quantize,
+                              placement_plan=placement_plan)
         else:
             from ray_tpu.parallel import multihost
 
@@ -115,7 +117,8 @@ def init_collective_group(world_size: int, rank: int,
                           group_name: str = "default",
                           timeout: float = 60.0,
                           transport: str = "auto",
-                          quantize=None):
+                          quantize=None,
+                          placement_plan: dict | None = None):
     """Initialize this process's membership in a collective group
     (reference: collective.py:93). Call from inside each participating
     actor/task with its rank. `transport` pins the HOST data plane to
@@ -123,10 +126,31 @@ def init_collective_group(world_size: int, rank: int,
     op. `quantize="int8"` makes this group's default allreduce wire
     format block-scaled int8 (EQuARX-style, lossy) on the tiers that
     have a wire (ring/device); per-op `allreduce(..., quantize=...)`
-    overrides it."""
+    overrides it. `placement_plan` (topology.transport_plan output)
+    pins the tier FROM the gang's placement record instead of the
+    probe round — see create_collective_group(placement_group=...)."""
     return _manager.create_group(group_name, world_size, rank,
                                  Backend(backend), timeout=timeout,
-                                 transport=transport, quantize=quantize)
+                                 transport=transport, quantize=quantize,
+                                 placement_plan=placement_plan)
+
+
+def placement_transport_plan(pg) -> dict | None:
+    """Resolve a PlacementGroup (or its id bytes) to the topology
+    transport plan its record carries, or None for ad-hoc/fallback
+    groups (which keep the probe round)."""
+    from ray_tpu._private import global_state
+    from ray_tpu._private import topology as _topo
+
+    cw = global_state.get_core_worker()
+    if pg is None or cw is None:
+        return None
+    pg_id = pg if isinstance(pg, bytes) else pg.id.binary()
+    try:
+        record = cw.get_placement_group(pg_id)
+    except Exception:
+        return None
+    return _topo.transport_plan(record)
 
 
 def create_collective_group(actors, world_size: int, ranks: list[int],
@@ -134,17 +158,28 @@ def create_collective_group(actors, world_size: int, ranks: list[int],
                             group_name: str = "default",
                             timeout: float = 60.0,
                             quantize=None,
-                            transport: str = "auto"):
+                            transport: str = "auto",
+                            placement_group=None):
     """Driver-side declarative setup (reference: collective.py:126): tells
-    every actor in `actors` to init the group with its rank."""
+    every actor in `actors` to init the group with its rank.
+
+    `placement_group`: the gang's reservation. When its record carries
+    an ICI_RING topology plan and `transport` is "auto", every rank's
+    tier is DERIVED from the placement (shm when the ring landed on one
+    host, device/ring/hub otherwise) and the per-op probe rounds are
+    skipped — counted by `collective.transport_derived_total`. Records
+    without a plan (PACK fallback, ad-hoc groups) keep probing."""
     import ray_tpu
 
     if len(actors) != len(ranks) or len(actors) != world_size:
         raise ValueError("actors/ranks/world_size mismatch")
+    plan = None
+    if placement_group is not None and transport == "auto":
+        plan = placement_transport_plan(placement_group)
     refs = [
         actor.__ray_collective_init__.remote(world_size, rank, backend,
                                              group_name, timeout, quantize,
-                                             transport)
+                                             transport, plan)
         for actor, rank in zip(actors, ranks)
     ]
     return ray_tpu.get(refs, timeout=120)
@@ -285,8 +320,9 @@ class CollectiveActorMixin:
 
     def __ray_collective_init__(self, world_size, rank, backend, group_name,
                                 timeout=60.0, quantize=None,
-                                transport="auto"):
+                                transport="auto", placement_plan=None):
         init_collective_group(world_size, rank, backend, group_name,
                               timeout=timeout, quantize=quantize,
-                              transport=transport)
+                              transport=transport,
+                              placement_plan=placement_plan)
         return rank

@@ -22,6 +22,13 @@ QUANT_SAVED = stats.Count(
     "wire bytes avoided by int8 block-scaled quantized collectives "
     "(exact-dtype bytes minus quantized payload+scale bytes)")
 
+TRANSPORT_DERIVED = stats.Count(
+    "collective.transport_derived_total",
+    "collective groups whose transport tier was derived from an "
+    "ICI_RING placement record (per rank) instead of the unanimous "
+    "probe round — the placement GUARANTEED the geometry the probe "
+    "used to discover")
+
 OP_S = stats.Histogram(
     "collective.op_s", stats.LATENCY_BOUNDARIES_S,
     "collective op wall time (allreduce/reduce/broadcast/allgather/"

@@ -15,8 +15,10 @@ Endpoints:
     /api/metrics/history per-source metric time series (?samples=N)
     /api/events  structured cluster events ring
     /api/state   live debug_state of every process (?component=serve|
-                 tasks|actors|objects|leases|transfers|collectives,
-                 ?workers=0; `serve` includes per-gang decode-batch
+                 placement|tasks|actors|objects|leases|transfers|
+                 collectives, ?workers=0; `placement` is the per-pg
+                 bundle→node table with topology coords + strategy/
+                 cost-model; `serve` includes per-gang decode-batch
                  occupancy, per-session KV page counts and stream
                  backlog for streaming backends)
     /api/doctor  stall-doctor findings (age vs max(floor, K*p99))
@@ -216,8 +218,10 @@ class Dashboard:
     async def state(self, component: str | None = None,
                     include_workers: bool = True):
         """Live cluster introspection (debug_state of every process);
-        ?component=tasks|actors|objects|leases|transfers|collectives
-        returns flat rows instead of the full tree."""
+        ?component=placement|tasks|actors|objects|leases|transfers|
+        collectives returns flat rows instead of the full tree
+        (placement: per-pg bundle→node rows with topology coords and
+        the chosen strategy/cost-model)."""
         from ray_tpu._private import debug_state
 
         conns: dict[str, object] = {}

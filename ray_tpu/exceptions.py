@@ -137,6 +137,27 @@ class SequenceAborted(RayTpuError):
         return (SequenceAborted, (self.seq_id, self.reason))
 
 
+class PlacementGroupInfeasibleError(RayTpuError):
+    """The GCS determined this placement group cannot be reserved on
+    the CURRENT fleet (e.g. STRICT_SPREAD wanting more distinct nodes
+    than exist). Unlike a PENDING group — which is merely waiting for
+    resources to free — an infeasible one needs the cluster to GROW;
+    ready()/wait() surface this typed instead of blocking forever.
+    The group stays registered: a joining node flips it back to
+    PENDING and retries."""
+
+    def __init__(self, pg_id_hex: str = "", detail: str = ""):
+        self.pg_id_hex = pg_id_hex
+        self.detail = detail
+        super().__init__(
+            f"placement group {pg_id_hex or '?'} is infeasible on the "
+            f"current fleet: {detail or 'needs more nodes'}")
+
+    def __reduce__(self):
+        return (PlacementGroupInfeasibleError,
+                (self.pg_id_hex, self.detail))
+
+
 class ReplicaGroupDied(RayTpuError):
     """A sharded Serve replica group lost a member (or its leader) while
     this request was in flight. The whole gang is being restarted by the

@@ -33,6 +33,7 @@ from ray_tpu._private import failpoints as _fp
 from ray_tpu._private import rpc
 from ray_tpu._private import sampling_profiler as _sprof
 from ray_tpu._private import stats as _stats
+from ray_tpu._private import topology as _topo
 from ray_tpu._private import tracing as _tracing
 from ray_tpu._private.common import InsufficientResources, ResourceSet
 from ray_tpu._private.config import Config, get_config, set_config
@@ -42,6 +43,15 @@ logger = logging.getLogger("ray_tpu.gcs")
 M_TRACE_APPLY_FAILURES = _stats.Count(
     "gcs.trace_apply_failures_total",
     "profile/trace batches dropped by a failed trace-table apply")
+M_TOPO_FALLBACKS = _stats.Count(
+    "gcs.placement_topology_fallbacks_total",
+    "ICI_RING placements that fell back to PACK (no candidate node had "
+    "registered topology coords, or the scoring seam failed)")
+M_PLACEMENT_SCORE_S = _stats.Histogram(
+    "gcs.placement_score_s", _stats.LATENCY_BOUNDARIES_S,
+    "one placement decision: strategy dispatch + candidate scoring in "
+    "_place_bundles (every strategy — the PACK-vs-ICI_RING latency A/B "
+    "reads this histogram per arm)")
 
 # Actor states (reference: src/ray/protobuf/gcs.proto ActorTableData.ActorState)
 DEPENDENCIES_UNREADY = "DEPENDENCIES_UNREADY"
@@ -83,6 +93,15 @@ class GcsServer:
         # feed multi-source striped pulls.
         self.object_locations: dict[bytes, dict] = {}
         self.placement_groups: dict[bytes, dict] = {}
+        # ICI_RING scoring leaves the winning candidate's plan here for
+        # _do_create_pg to stamp onto the CREATED record (single-threaded
+        # asyncio: set synchronously in _place_bundles, read immediately
+        # after it returns)
+        self._last_topology_plan: dict | None = None
+        # (coords, snake order) of coord-bearing nodes — rebuilt only
+        # when membership changes, so per-decision scoring cost stays in
+        # the PACK arm's latency bucket (the <=5% A/B gate)
+        self._topo_cache: tuple[dict, list] | None = None
         self.server = rpc.Server(self._handlers(), on_disconnect=self._on_disconnect,
                                  name="gcs")
         self._pending_actor_queue: list[bytes] = []
@@ -413,11 +432,16 @@ class GcsServer:
             # util/accelerators.TpuSliceDescriptor dict or None: this
             # host's ICI domain, consumed by _place_bundles
             "tpu_slice": d.get("tpu_slice"),
+            # _private/topology.TopologyCoord dict or None: the node's
+            # position in the torus (ICI_RING scoring, spillback
+            # ordering, locality tie-breaks all read it)
+            "topology": d.get("topology"),
             "state": "ALIVE",
             "start_time": time.time(),
         }
         rejoining = node_id in self.nodes  # redial after a GCS restart
         self.nodes[node_id] = info
+        self._topo_cache = None
         self.available[node_id] = ResourceSet.from_raw(
             d.get("available", d["resources"]))
         self.last_heartbeat[node_id] = time.monotonic()
@@ -509,6 +533,7 @@ class GcsServer:
     async def _remove_node(self, node_id: bytes, reason: str):
         info = self.nodes.pop(node_id, None)
         self.available.pop(node_id, None)
+        self._topo_cache = None
         self.last_heartbeat.pop(node_id, None)
         self.node_conns.pop(node_id, None)
         if info is None:
@@ -1081,7 +1106,11 @@ class GcsServer:
             "placement_groups": {
                 "total": len(self.placement_groups),
                 "pending": sum(1 for r in self.placement_groups.values()
-                               if r["state"] == "PENDING")},
+                               if r["state"] in ("PENDING", "INFEASIBLE"))},
+            # per-pg bundle->node rows with topology coords (`ray-tpu
+            # state placement`; the doctor's topology_mismatch check),
+            # bounded like the other introspection surfaces
+            "placement_table": self._placement_table(limit=200),
             "jobs": len(self.jobs),
             "kv_keys": len(self.kv),
             "object_locations": len(self.object_locations),
@@ -1108,6 +1137,43 @@ class GcsServer:
             snap["shards"] = list(await asyncio.gather(
                 *(one(i) for i in range(len(self.shard_addresses)))))
         return _debug.finish_snapshot(snap, t_start)
+
+    def _placement_table(self, limit: int = 200) -> list[dict]:
+        """Flat bundle->node rows for every placement group: strategy,
+        cost-model name, per-bundle node + topology coord + slice —
+        what `ray-tpu state placement` prints and the doctor's
+        topology_mismatch finding scans."""
+        rows = []
+        for rec in list(self.placement_groups.values())[:limit]:
+            plan = rec.get("topology_plan") or {}
+            base = {
+                "pg": rec["pg_id"].hex()[:12],
+                "name": rec.get("name", ""),
+                "strategy": rec["strategy"],
+                "cost_model": (plan.get("cost_model")
+                               or rec.get("cost_model") or ""),
+                "state": rec["state"],
+            }
+            if plan:
+                base["ring_circumference"] = plan.get("ring_circumference")
+            if rec.get("detail"):
+                base["detail"] = rec["detail"]
+            if rec["state"] != "CREATED":
+                rows.append(base)
+                continue
+            for b in rec["bundles"]:
+                topo = b.get("topology") or {}
+                nid = b.get("node_id")
+                rows.append({
+                    **base,
+                    "bundle": b.get("bundle_index"),
+                    "node": nid.hex()[:8] if isinstance(nid, bytes)
+                    else str(nid),
+                    "slice": topo.get("slice_id") or "",
+                    "coords": ",".join(str(c) for c in
+                                       topo.get("coords") or ()) or "",
+                })
+        return rows
 
     async def h_get_metrics(self, conn, d):
         """This process's metric registry + computed cluster gauges."""
@@ -1160,8 +1226,13 @@ class GcsServer:
     async def h_create_placement_group(self, conn, d):
         """2-phase bundle reservation across raylets (reference:
         gcs_placement_group_scheduler.h:49; strategies :133-160). Infeasible
-        groups stay PENDING and are retried as nodes join / resources free."""
+        groups stay PENDING and are retried as nodes join / resources free
+        (STRICT_SPREAD wanting more nodes than the fleet HAS goes
+        INFEASIBLE instead — typed at the client — until nodes join)."""
         pg_id = d["pg_id"]
+        # unknown cost-model specs fail HERE, typed at creation — never
+        # as a silently-heuristic placement
+        _topo.resolve_cost_model(d.get("cost_model"))
         # Idempotent: a call replayed across a GCS restart (lost reply)
         # must not reset a CREATED group to PENDING and double-reserve
         # its bundles.
@@ -1170,6 +1241,7 @@ class GcsServer:
                 "pg_id": pg_id, "bundles": [dict(b) for b in d["bundles"]],
                 "strategy": d.get("strategy", "PACK"), "state": "PENDING",
                 "name": d.get("name", ""),
+                "cost_model": d.get("cost_model") or "",
             }
             self._persist_pg(self.placement_groups[pg_id])
             await self._mirror("pgs", pg_id,
@@ -1178,7 +1250,9 @@ class GcsServer:
 
     async def _retry_pending_pgs(self):
         for pg_id, rec in list(self.placement_groups.items()):
-            if rec["state"] == "PENDING":
+            # INFEASIBLE retries too: a joining node can make a
+            # too-wide STRICT_SPREAD placeable again
+            if rec["state"] in ("PENDING", "INFEASIBLE"):
                 await self._try_create_pg(pg_id)
 
     async def _try_create_pg(self, pg_id) -> str:
@@ -1187,6 +1261,10 @@ class GcsServer:
             return "REMOVED"
         if rec["state"] == "CREATED":
             return "CREATED"
+        # INFEASIBLE records re-evaluate in place (the state only moves
+        # once the outcome actually changes — _do_create_pg flips it
+        # back to PENDING or on to CREATED; flipping it here would
+        # re-persist + republish an unchanged record every retry sweep)
         # In-flight guard: while one 2PC attempt awaits raylet RPCs, a
         # concurrent retry (heartbeat/node-join) must not start a second
         # one — double prepare_bundle would double-reserve node resources.
@@ -1201,9 +1279,55 @@ class GcsServer:
     async def _do_create_pg(self, pg_id, rec) -> str:
         bundles = rec["bundles"]
         strategy = rec["strategy"]
-        placement = self._place_bundles(bundles, strategy)
+        t_score = time.perf_counter()
+        try:
+            placement = self._place_bundles(bundles, strategy,
+                                            cost_model=rec.get("cost_model"))
+        finally:
+            M_PLACEMENT_SCORE_S.observe(time.perf_counter() - t_score)
+        plan = self._last_topology_plan
         if placement is None:
+            alive = sum(1 for n in self.node_conns.values()
+                        if n is not None and not n.closed)
+            if strategy == "STRICT_SPREAD" and len(bundles) > alive:
+                # the fleet CANNOT hold this group today: surface typed
+                # (PlacementGroupInfeasibleError at ready()) instead of
+                # an indistinguishable forever-PENDING; node joins flip
+                # it back to PENDING and retry
+                detail = (f"{len(bundles)} STRICT_SPREAD bundles "
+                          f"need distinct nodes; fleet has {alive}")
+                if (rec["state"] == "INFEASIBLE"
+                        and rec.get("detail") == detail):
+                    # unchanged verdict: no persist/mirror/publish churn
+                    # on every heartbeat-driven retry sweep
+                    return "INFEASIBLE"
+                rec["state"] = "INFEASIBLE"
+                rec["detail"] = detail
+                self._persist_pg(rec)
+                await self._mirror("pgs", pg_id, _pg_public(rec))
+                await self.publish(f"pg:{pg_id.hex()}", _pg_public(rec))
+                return "INFEASIBLE"
+            if rec["state"] == "INFEASIBLE":
+                # structurally placeable again (a node joined) but not
+                # yet reserved: back to PENDING so ready() stops raising
+                rec["state"] = "PENDING"
+                rec.pop("detail", None)
+                self._persist_pg(rec)
+                await self._mirror("pgs", pg_id, _pg_public(rec))
+                await self.publish(f"pg:{pg_id.hex()}", _pg_public(rec))
             return "PENDING"
+        if _fp.ARMED:
+            # reserve seam, BETWEEN scoring and the 2PC prepare: `delay`
+            # widens the window a scored node can die in (the chaos
+            # case); `raise` aborts this attempt — the group stays
+            # PENDING and the heartbeat-driven retry re-scores
+            try:
+                await _fp.fire_async_strict("placement.reserve")
+            except _fp.FailpointError:
+                logger.warning("placement.reserve failpoint aborted the "
+                               "2PC for pg %s; will retry",
+                               pg_id.hex()[:8])
+                return "PENDING"
         # prepare
         prepared = []
         ok = True
@@ -1269,11 +1393,21 @@ class GcsServer:
                         pass
             return "REMOVED"
         rec["state"] = "CREATED"
+        rec.pop("detail", None)
         rec["bundles"] = [
             {"bundle_index": i, "resources": bundles[i]["resources"],
-             "node_id": placement[i]}
+             "node_id": placement[i],
+             # the assigned node's torus coord rides each bundle row —
+             # `ray-tpu state placement`, the doctor's topology_mismatch
+             # check, and transport derivation all read it
+             "topology": self.nodes.get(placement[i], {}).get("topology")}
             for i in range(len(bundles))
         ]
+        if plan is not None:
+            # ICI_RING placed by topology: the plan gates client-side
+            # transport derivation (topology.transport_plan) — a PACK
+            # fallback carries none, so ad-hoc gangs keep probing
+            rec["topology_plan"] = plan
         self._persist_pg(rec)
         # mirror-then-publish (same ordering rule as actors), then wake
         # PlacementGroup.ready() waiters parked on the pg channel — the
@@ -1293,7 +1427,188 @@ class GcsServer:
                 slices.setdefault(desc["slice_id"], []).append(nid)
         return slices
 
-    def _place_bundles(self, bundles, strategy):
+    def _place_ici_ring(self, bundles, needs, avail, cost_model: str):
+        """ICI_RING core: enumerate candidate bundle->node assignments
+        over the snake order of coord-bearing nodes, score each with the
+        request's cost model, take the cheapest that fits.
+
+        Candidates per snake offset: a greedy FILL (consecutive ranks
+        pack onto each node while it fits, then advance — one free node
+        big enough yields the all-on-one-host/shm assignment) and a
+        STRIDED spread (ranks spaced across the torus). The fill family
+        contains the minimal rings the default model wants; the strided
+        family gives an inverted/learned model genuinely different
+        geometry to prefer. Returns placement dict or None (no located
+        candidates / nothing fits / scoring seam failed)."""
+        if self._topo_cache is None:
+            cached: dict[bytes, _topo.TopologyCoord] = {}
+            for nid, info in self.nodes.items():
+                c = _topo.TopologyCoord.from_dict(info.get("topology"))
+                if c is not None:
+                    cached[nid] = c
+            self._topo_cache = (cached, sorted(
+                cached, key=lambda n: (cached[n].slice_id,
+                                       _topo.snake_key(cached[n]))))
+        coords, snake = self._topo_cache
+        # liveness/availability filter is per-decision (conn state moves
+        # without a membership event); the snake sort is not
+        live = [nid for nid in snake
+                if nid in avail
+                and (cn := self.node_conns.get(nid)) is not None
+                and not cn.closed]
+        if not live:
+            return None
+        if _fp.ARMED:
+            # scoring seam: `raise` models a failed topology read —
+            # placement degrades to the counted PACK fallback; `delay`
+            # stretches the scoring window the latency gate watches
+            try:
+                _fp.fire_strict("placement.topology_score")
+            except _fp.FailpointError:
+                logger.warning("placement.topology_score failpoint: "
+                               "falling back to PACK")
+                return None
+        try:
+            model = _topo.resolve_cost_model(cost_model)
+        except ValueError:
+            # model vanished since creation (process restart without the
+            # registering import): heuristic fallback is counted, not
+            # silent
+            logger.warning("cost model %r unresolvable at scoring time; "
+                           "falling back to PACK", cost_model)
+            return None
+        bind = getattr(model, "bind_context", None)
+        if bind is not None:
+            bind({"metrics_history": self.metrics_history,
+                  # node-id prefix -> registered coord host_id, so a
+                  # model keying on metric sources (<node8>/raylet) can
+                  # reach coords whose host_id isn't the node-id hex
+                  "node_hosts": {nid.hex()[:8]: c.host_id
+                                 for nid, c in coords.items()}})
+        order = live
+        k = len(needs)
+        n = len(order)
+        # Fast path for the overwhelmingly common gang shape — every
+        # bundle identical: one integer pass over the raw fixed-point
+        # dicts computes how many bundle-slots each node fits, and
+        # candidate generation becomes index walking (no ResourceSet
+        # churn inside the offset loop). This is what keeps the scoring
+        # A/B within the PACK arm's latency bucket.
+        need_raw = needs[0].raw()
+        uniform = all(nd.raw() == need_raw for nd in needs[1:])
+        caps: dict[bytes, int] = {}
+        if uniform:
+            for nid in order:
+                araw = avail[nid].raw()
+                c = k
+                for res, q in need_raw.items():
+                    if q > 0:
+                        c = min(c, araw.get(res, 0) // q)
+                caps[nid] = c
+
+        def fits(assignment) -> bool:
+            if uniform:
+                used: dict[bytes, int] = {}
+                for nid in assignment:
+                    used[nid] = used.get(nid, 0) + 1
+                    if used[nid] > caps[nid]:
+                        return False
+                return True
+            trial: dict[bytes, ResourceSet] = {}
+            for i, nid in enumerate(assignment):
+                rs = trial.get(nid)
+                if rs is None:
+                    rs = trial[nid] = avail[nid].copy()
+                if not needs[i].is_subset_of(rs):
+                    return False
+                rs.subtract(needs[i])
+            return True
+
+        def fill_from(offset: int) -> list[bytes] | None:
+            """Greedy walk from snake position `offset`: consecutive
+            ranks pack onto each node while it fits, then advance."""
+            out: list[bytes] = []
+            if uniform:
+                pos = offset
+                while len(out) < k and pos < offset + n:
+                    nid = order[pos % n]
+                    take = min(caps[nid], k - len(out))
+                    out.extend([nid] * take)
+                    pos += 1
+                return out if len(out) == k else None
+            rs = None
+            pos = offset
+            for i in range(k):
+                while pos < offset + n:
+                    nid = order[pos % n]
+                    if rs is None:
+                        rs = avail[nid].copy()
+                    if needs[i].is_subset_of(rs):
+                        rs.subtract(needs[i])
+                        out.append(nid)
+                        break
+                    pos += 1
+                    rs = None
+                else:
+                    return None
+            return out
+
+        # Generate-and-score incrementally, fill candidates first: the
+        # default model's minimum for a distinct-node ring is k (every
+        # wire hop >= 1), so once a perfect ring scores <= k — and no
+        # node could host two ranks (caps <= 1 => no 0-hop same-host
+        # shortcuts exist) — stop scanning. Pluggable models see every
+        # candidate.
+        ring_default = isinstance(model, _topo.RingDistanceCostModel)
+        can_pack = (not uniform) or any(c > 1 for c in caps.values())
+        seen: set[tuple] = set()
+        best, best_cost = None, None
+        stride = max(1, n // k)
+
+        def consider(cand) -> bool:
+            """Score one candidate; True = stop scanning (provably
+            optimal for the default model)."""
+            nonlocal best, best_cost
+            key = tuple(cand)
+            if key in seen:
+                return False
+            seen.add(key)
+            cost = model.score(bundles, [coords[nid] for nid in cand])
+            if best_cost is None or cost < best_cost:
+                best, best_cost = cand, cost
+            return ring_default and not can_pack and best_cost <= k
+
+        done = False
+        for offset in range(n):
+            if uniform and caps[order[offset]] == 0:
+                continue  # identical fill to the next live offset
+            filled = fill_from(offset)
+            if filled is not None and consider(filled):
+                done = True
+                break
+        if not done and stride > 1:
+            for offset in range(n):
+                strided = [order[(offset + j * stride) % n]
+                           for j in range(k)]
+                if fits(strided) and consider(strided):
+                    break
+        if best is None:
+            return None
+        for i, nid in enumerate(best):
+            avail[nid].subtract(needs[i])
+        ring = [coords[nid] for nid in best]
+        self._last_topology_plan = {
+            "cost_model": getattr(model, "name", "") or cost_model or "ring",
+            "cost": float(best_cost),
+            "ring_circumference": _topo.ring_circumference(ring),
+            "candidates_scored": len(seen),
+            # the (data, fsdp) factorization FSDP-mode meshes derive
+            # from this gang (SNIPPETS [2] table; parallel/mesh.py)
+            "mesh_shape": list(_topo.mesh_shape_for(k)),
+        }
+        return {i: nid for i, nid in enumerate(best)}
+
+    def _place_bundles(self, bundles, strategy, cost_model: str = ""):
         """Map bundle_index -> node_id, or None if infeasible now.
 
         TPU topology (SURVEY §7 step 1; reference strategy analog:
@@ -1302,7 +1617,16 @@ class GcsServer:
         ONE slice (equal slice_id ⇔ ICI-connected; never spans slices).
         STRICT_SPREAD prefers distinct hosts of one slice before falling
         back to arbitrary distinct nodes, so a dp group's gradient
-        allreduce rides ICI when a big-enough slice exists."""
+        allreduce rides ICI when a big-enough slice exists.
+
+        ICI_RING orders candidate nodes so CONSECUTIVE bundle ranks are
+        ICI neighbors (minimal ring circumference over the torus),
+        scored by the request's pluggable cost model; with no
+        coord-bearing candidates it falls back to PACK, counted by
+        `gcs.placement_topology_fallbacks_total`. Sets
+        `self._last_topology_plan` (ICI_RING success only) so
+        _do_create_pg can stamp the record without re-deriving."""
+        self._last_topology_plan = None
         avail = {nid: r.copy() for nid, r in self.available.items()}
         placement: dict[int, bytes] = {}
         node_ids = list(avail.keys())
@@ -1317,6 +1641,21 @@ class GcsServer:
 
         needs = [ResourceSet.from_raw(b["resources"]) for b in bundles]
         wants_tpu = any(n.get("TPU") > 0 for n in needs)
+
+        if strategy == "ICI_RING":
+            local = self._place_ici_ring(bundles, needs, avail, cost_model)
+            if local is not None:
+                return local
+            # no topology to score (or the scoring seam failed): behave
+            # exactly like PACK, but count the downgrade only when the
+            # gang actually PLACES topology-blind — a merely
+            # capacity-starved fleet stays PENDING and re-enters
+            # ICI_RING scoring on the next availability change, which
+            # must not ring the fallback alarm once per retry heartbeat
+            placed = self._place_bundles(bundles, "PACK", cost_model)
+            if placed is not None:
+                M_TOPO_FALLBACKS.inc()
+            return placed
 
         def pack_within(cand_ids):
             """Fit all bundles onto `cand_ids`, placing the LARGEST need
@@ -1503,7 +1842,7 @@ def _node_public(info):
     return {k: info.get(k) for k in (
         "node_id", "address", "object_manager_address", "bulk_address",
         "resources", "hostname", "is_head", "state", "labels",
-        "tpu_slice")}
+        "tpu_slice", "topology")}
 
 
 def _pg_public(rec):

@@ -313,6 +313,7 @@ def main(seconds_per_case: float = 2.0) -> list[dict]:
 
     _cross_node_bench(results)
     _control_plane(results)
+    _placement_topology(results)
     return results
 
 
@@ -1359,6 +1360,43 @@ def _control_plane(results: list[dict], shards: int = 4):
                   f"(director {row['director_cpu_us_per_op']}us/op)")
 
 
+def _placement_topology(results: list[dict], windows: int = 3):
+    """Topology placement scale-sim row (scalesim/topology_sim.py): 16
+    spoofed raylets with seeded-shuffled 4x4-torus coords answer the
+    REAL 2PC against two live directors, paired-interleaved ICI_RING vs
+    PACK windows. Per arm: mean ring circumference (torus wire around
+    consecutive bundle ranks — ICI_RING's target is == world size, the
+    perfect ring), simulated spillback-chain hops, client placement
+    latency, and the director's own `gcs.placement_score_s` p99
+    (warmup-excluded bucket delta; the <=5% latency A/B)."""
+    from ray_tpu.scalesim.topology_sim import run_topology_sim
+
+    sim = run_topology_sim(raylets=16, windows=windows, bundles=4)
+    for arm in ("ici_ring", "pack"):
+        a = sim["arms"][arm]
+        lat_ms = a["placement_latency_ms"]["mean"]
+        row = {"name": f"placement_topology {arm}",
+               "per_second": round(1e3 / max(lat_ms, 1e-9), 2),
+               "sd": 0.0,
+               "gangs": a["gangs"],
+               "mean_ring_circumference": a["mean_ring_circumference"],
+               "mean_spillback_hops": a["mean_spillback_hops"],
+               "placement_latency_ms": lat_ms,
+               "score_p99_s": a["score_p99_s"],
+               "fallbacks": a["fallbacks"],
+               "leaked_holds": a["leaked_holds"]}
+        if arm == "ici_ring":
+            row["circumference_ratio_vs_pack"] = sim[
+                "circumference_ratio"]
+            row["spillback_hops_ratio_vs_pack"] = sim[
+                "spillback_hops_ratio"]
+            row["score_p99_ratio_vs_pack"] = sim["score_p99_ratio"]
+        results.append(row)
+        print(f"placement_topology {arm}: circumference "
+              f"{a['mean_ring_circumference']}, spillback hops "
+              f"{a['mean_spillback_hops']}, latency {lat_ms}ms")
+
+
 if __name__ == "__main__":
     from ray_tpu._private.bench_meta import run_metadata as _metadata
     import argparse
@@ -1382,7 +1420,8 @@ if __name__ == "__main__":
         groups = {"serve_mixed": _serve_mixed, "serve": _serve_qps,
                   "serve_stream": _serve_stream,
                   "tracing": _tracing_ab, "state": _state_ab,
-                  "collective": _collective_bench}
+                  "collective": _collective_bench,
+                  "placement_topology": _placement_topology}
         if args.only not in groups:
             parser.error(f"--only must be one of {sorted(groups)}")
         results: list = []

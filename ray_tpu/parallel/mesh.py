@@ -25,6 +25,19 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 AXES = ("dp", "pp", "sp", "tp", "ep")
 
+# Device-count -> (data, fsdp) 2D mesh shapes (SNIPPETS [2]: the
+# auto-sharder's predefined optimal shapes for TPU pod slices).
+# ROADMAP item 3's FSDP ('data','fsdp') mode consumes this, and the
+# ICI_RING placement strategy records it with each gang so rank
+# ordering and the derived mesh agree on the same factorization. The
+# implementation lives jax-free in _private/topology.py because the
+# GCS placement scorer (a control-plane process that never imports
+# jax) shares it; this is its public home.
+from ray_tpu._private.topology import (  # noqa: E402  (re-export)
+    MESH_SHAPES as _MESH_SHAPES,
+    mesh_shape_for,
+)
+
 
 def axis_size(axis_name: str) -> int:
     """Version-portable mapped-axis size (call INSIDE shard_map):

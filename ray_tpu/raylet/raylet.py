@@ -30,6 +30,7 @@ from ray_tpu._private import debug_state as _debug
 from ray_tpu._private import failpoints as _fp
 from ray_tpu._private import rpc
 from ray_tpu._private import sampling_profiler as _sprof
+from ray_tpu._private import topology as _topo
 from ray_tpu._private import tracing
 from ray_tpu._private.common import InsufficientResources, ResourceSet
 from ray_tpu._private.config import Config, get_config, set_config
@@ -58,13 +59,22 @@ class Raylet:
     def __init__(self, *, node_id: NodeID, session_dir: str, gcs_address: str,
                  resources: dict[str, float], store_root: str,
                  is_head: bool, labels: dict[str, str], config: Config,
-                 tpu_slice: dict | None = None):
+                 tpu_slice: dict | None = None,
+                 topology: dict | None = None):
         self.node_id = node_id
         self.session_dir = session_dir
         self.gcs_address = gcs_address
         self.config = config
         self.is_head = is_head
         self.labels = labels
+        # this node's position in the pod's physical shape (topology.py):
+        # explicit (--topology / cluster_utils), RAY_TPU_TOPOLOGY env, or
+        # derived from the slice descriptor — deterministic, so a raylet
+        # restart lands on the same coord. None = unlocated (ICI_RING
+        # counts the fallback; spillback ordering stays random).
+        self.topology = _topo.derive_coord(
+            node_id_hex=node_id.hex(), tpu_slice=tpu_slice,
+            labels=labels, explicit=topology)
         # TPU slice membership (util/accelerators.TpuSliceDescriptor as a
         # dict): declares this host's ICI domain. Implies TPU chips and
         # the accelerator_type:<gen> constraint resource if absent.
@@ -122,6 +132,11 @@ class Raylet:
         self.m_spillback_grants = stats.Count(
             "raylet.spillback_grants_total",
             "leases granted here for a forwarded (spillback-chain) request")
+        self.m_topo_reroutes = stats.Count(
+            "raylet.spillback_topo_reroutes_total",
+            "spillback/locality decisions where the topology distance "
+            "metric differentiated the candidates and picked a nearer "
+            "node than a blind choice could guarantee")
         self.m_lease_grant_s = stats.Histogram(
             "raylet.lease_grant_s", stats.LATENCY_BOUNDARIES_S,
             "lease request arrival -> grant (queue + worker startup)")
@@ -497,13 +512,39 @@ class Raylet:
             return True  # bundles are explicit placements; wait for them
         return need.is_subset_of(self.total)
 
-    def _pick_spillback(self, spec, exclude=()) -> str | None:
-        """Hybrid policy fallback: a random remote node whose *total*
-        resources fit (reference: cluster_resource_scheduler.cc:320).
-        `exclude`: addresses already visited by a forwarded request
-        (cycle guard)."""
+    def _coord_of_node(self, node_id: bytes):
+        info = self.cluster_nodes.get(node_id)
+        if info is None:
+            return None
+        return _topo.TopologyCoord.from_dict(info.get("topology"))
+
+    def _topo_prefer(self, node_ids: list[bytes]) -> tuple[bytes, bool]:
+        """Choose among candidate nodes: the topologically NEAREST one
+        when coords differentiate them (random among equals — the
+        PR 5/7 tie-breaker: same-slice ICI hops beat cross-slice/DCN),
+        plain random otherwise. Returns (node_id, rerouted); rerouted
+        is True only when the distance metric actually changed the
+        outcome class, which is what
+        `raylet.spillback_topo_reroutes_total` counts."""
         import random
 
+        if len(node_ids) <= 1:
+            return node_ids[0], False
+        if self.topology is None:
+            return random.choice(node_ids), False
+        dists = [(_topo.distance(self.topology, self._coord_of_node(n)), n)
+                 for n in node_ids]
+        dmin = min(d for d, _ in dists)
+        dmax = max(d for d, _ in dists)
+        best = [n for d, n in dists if d == dmin]
+        return random.choice(best), dmax > dmin
+
+    def _pick_spillback(self, spec, exclude=()) -> str | None:
+        """Hybrid policy fallback: a remote node whose *total* resources
+        fit (reference: cluster_resource_scheduler.cc:320) — the
+        topologically nearest such node when coords are registered,
+        random otherwise. `exclude`: addresses already visited by a
+        forwarded request (cycle guard)."""
         need = ResourceSet.from_raw(spec["resources"])
         cands = []
         for node_id, info in self.cluster_nodes.items():
@@ -512,8 +553,13 @@ class Raylet:
             if info["address"] in exclude:
                 continue
             if need.is_subset_of(ResourceSet.from_raw(info["resources"])):
-                cands.append(info["address"])
-        return random.choice(cands) if cands else None
+                cands.append(node_id)
+        if not cands:
+            return None
+        choice, rerouted = self._topo_prefer(cands)
+        if rerouted:
+            self.m_topo_reroutes.inc()
+        return self.cluster_nodes[choice]["address"]
 
     async def _pick_spillback_load_aware(self, spec, exclude=()) -> str | None:
         """Local node is feasible-by-totals but saturated: find a remote
@@ -534,9 +580,10 @@ class Raylet:
     def _pick_from_availability(self, spec, avail: dict,
                                 exclude=()) -> str | None:
         """Synchronous selection from a fetched availability view (callers
-        holding the view across multiple picks subtract as they assign)."""
-        import random
-
+        holding the view across multiple picks subtract as they assign).
+        Topology-nearest among feasible nodes when coords are known —
+        the spillback-chain next hop prefers an ICI neighbor over a
+        cross-slice node with identical headroom."""
         need = ResourceSet.from_raw(spec["resources"])
         me = self.node_id.binary()
         cands = []
@@ -549,7 +596,9 @@ class Raylet:
                 cands.append(node_id)
         if not cands:
             return None
-        node_id = random.choice(cands)
+        node_id, rerouted = self._topo_prefer(cands)
+        if rerouted:
+            self.m_topo_reroutes.inc()
         avail[node_id].subtract(need)  # so N picks don't dogpile one slot
         return self.cluster_nodes[node_id]["address"]
 
@@ -601,7 +650,8 @@ class Raylet:
             return None
         me = self.node_id.binary()
         need = ResourceSet.from_raw(spec["resources"])
-        best, best_bytes = None, by_node.get(me, 0)
+        my_bytes = by_node.get(me, 0)
+        feasible: list[tuple[int, bytes]] = []
         for node_id, nbytes in by_node.items():
             if node_id == me:
                 continue
@@ -609,16 +659,23 @@ class Raylet:
             if info is None or not need.is_subset_of(
                     ResourceSet.from_raw(info["resources"])):
                 continue
-            if nbytes > best_bytes:
-                best, best_bytes = node_id, nbytes
-        if (best is None or best_bytes - by_node.get(me, 0)
-                < cfg.locality_min_arg_bytes):
+            feasible.append((nbytes, node_id))
+        best_bytes = max((n for n, _ in feasible), default=0)
+        if (not feasible
+                or best_bytes - my_bytes < cfg.locality_min_arg_bytes):
             if len(self._locality_negcache) > 1024:
                 self._locality_negcache = {
                     k: v for k, v in self._locality_negcache.items()
                     if v > now}
             self._locality_negcache[key] = now + 2.0
             return None
+        # byte count decides; topology breaks the byte TIE (several
+        # nodes hold the same resident bytes — e.g. a broadcast arg) in
+        # favor of the ICI-nearest holder
+        ties = [nid for n, nid in feasible if n == best_bytes]
+        best, rerouted = self._topo_prefer(ties)
+        if rerouted:
+            self.m_topo_reroutes.inc()
         return self.cluster_nodes[best]["address"]
 
     def _warn_infeasible(self, spec):
@@ -1872,6 +1929,8 @@ class Raylet:
             "node_id": self.node_id.hex()[:8],
             "address": self.address,
             "is_head": self.is_head,
+            "topology": (self.topology.to_dict()
+                         if self.topology is not None else None),
             "resources": {"total": self.total.raw(),
                           "available": self.available.raw()},
             "worker_pool": pool,
@@ -2167,6 +2226,8 @@ class Raylet:
                 "is_head": self.is_head,
                 "labels": self.labels,
                 "tpu_slice": self.tpu_slice,
+                "topology": (self.topology.to_dict()
+                             if self.topology is not None else None),
             })
 
         def _gcs_gone():
@@ -2223,6 +2284,10 @@ def main():
     parser.add_argument("--resources", default="{}")
     parser.add_argument("--labels", default="{}")
     parser.add_argument("--tpu-slice", default="")
+    parser.add_argument("--topology", default="",
+                        help="explicit TopologyCoord JSON "
+                             '({"slice_id","coords","dims"}); empty = '
+                             "derive from RAY_TPU_TOPOLOGY / tpu-slice")
     parser.add_argument("--is-head", action="store_true")
     parser.add_argument("--ready-file", default=None)
     parser.add_argument("--log-file", default=None)
@@ -2256,6 +2321,7 @@ def main():
         labels=json.loads(args.labels),
         config=get_config(),
         tpu_slice=json.loads(args.tpu_slice) if args.tpu_slice else None,
+        topology=json.loads(args.topology) if args.topology else None,
     )
     asyncio.run(raylet.run(args.port, args.ready_file))
 

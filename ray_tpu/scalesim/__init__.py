@@ -1,3 +1,4 @@
 from ray_tpu.scalesim.harness import ControlPlane, run_scalesim
+from ray_tpu.scalesim.topology_sim import run_topology_sim
 
-__all__ = ["ControlPlane", "run_scalesim"]
+__all__ = ["ControlPlane", "run_scalesim", "run_topology_sim"]

@@ -1,0 +1,342 @@
+"""Scale-sim topology arm: ICI_RING vs PACK against a REAL GCS.
+
+16 spoofed raylets register synthetic 4x4-torus TopologyCoords (the
+node-index -> coord mapping is seeded-SHUFFLED, like real fleets where
+allocation order has nothing to do with rack adjacency) into two live
+directors — one per arm, so each arm's `gcs.placement_score_s`
+histogram is its own. Unlike harness.py's table-op raylets, these
+answer the 2PC (`prepare_bundle`/`commit_bundle`/...) over the duplex
+registration connection and heartbeat their availability, so the
+director runs the REAL placement path end to end.
+
+Paired interleaved windows (the MICROBENCH discipline): each window
+fills the fleet with `fleet // bundles` gangs in BOTH arms
+(alternating), records per-gang ring circumference, simulated
+spillback-chain hops, and client-observed placement latency, then
+releases everything and verifies no raylet kept a bundle hold.
+
+Measures (per arm):
+- mean_ring_circumference — torus wire distance around consecutive
+  bundle ranks incl. the wrap (ICI_RING target: == bundles, a perfect
+  ring; PACK: whatever first-fit scatter produced);
+- spillback_hops — greedy nearest-neighbor chain cost from a seeded
+  origin node across the gang (what a lease forwarded along the
+  PR 7 spillback chain pays in ICI hops);
+- placement latency — client create->CREATED wall time, plus the
+  director's own `gcs.placement_score_s` p99 (the <=5% A/B gate).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import random
+import time
+
+from ray_tpu._private import rpc
+from ray_tpu._private import stats as _stats
+from ray_tpu._private import topology as _topo
+from ray_tpu._private.common import ResourceSet
+from ray_tpu.scalesim.harness import ControlPlane
+
+def _torus_for(n: int) -> tuple[int, int]:
+    """Near-square 2D torus with exactly `n` positions, so every spoofed
+    raylet gets a DISTINCT coord whatever --raylets says (16 -> 4x4; a
+    prime count degenerates to a 1xN ring)."""
+    a = int(n ** 0.5)
+    while a > 1 and n % a:
+        a -= 1
+    return (a, n // a)
+
+
+class TopoSimRaylet:
+    """One spoofed raylet that really participates in placement: it
+    registers (with a TopologyCoord), heartbeats availability, and
+    serves the 2PC bundle handlers. Holds are tracked so the harness
+    can prove none leak."""
+
+    def __init__(self, idx: int, node_id: bytes, coord: _topo.TopologyCoord,
+                 cpus: float = 1.0):
+        self.idx = idx
+        self.node_id = node_id
+        self.coord = coord
+        self.total = ResourceSet({"CPU": cpus})
+        self.available = self.total.copy()
+        self.holds: dict[tuple[bytes, int], dict] = {}
+        self.conn: rpc.ReconnectingConnection | None = None
+        self._beat_task: asyncio.Task | None = None
+
+    def _handlers(self):
+        return {
+            "prepare_bundle": self.h_prepare,
+            "commit_bundle": self.h_commit,
+            "cancel_bundle": self.h_release,
+            "return_bundle": self.h_release,
+            "ping": lambda conn, d: "pong",
+        }
+
+    async def h_prepare(self, conn, d):
+        need = ResourceSet.from_raw(d["resources"])
+        if not need.is_subset_of(self.available):
+            return False
+        self.available.subtract(need)
+        self.holds[(d["pg_id"], d["bundle_index"])] = {
+            "need": need, "state": "PREPARED"}
+        return True
+
+    async def h_commit(self, conn, d):
+        hold = self.holds.get((d["pg_id"], d["bundle_index"]))
+        if hold is not None:
+            hold["state"] = "COMMITTED"
+        return True
+
+    async def h_release(self, conn, d):
+        hold = self.holds.pop((d["pg_id"], d["bundle_index"]), None)
+        if hold is not None:
+            self.available.add(hold["need"])
+        return True
+
+    async def connect(self, gcs_address: str):
+        self.conn = rpc.ReconnectingConnection(
+            gcs_address, handlers=self._handlers(),
+            name=f"toposim{self.idx}", retry_timeout=30.0)
+        conn = await self.conn.ensure_connected()
+        await conn.call("register_node", {
+            "node_id": self.node_id,
+            "address": f"sim://{self.idx}",
+            "resources": self.total.raw(),
+            "available": self.available.raw(),
+            "hostname": f"sim{self.idx}",
+            "topology": self.coord.to_dict(),
+        })
+        self._beat_task = asyncio.create_task(self._beat_loop())
+
+    async def _beat_loop(self):
+        # fast availability beats so the director's view tracks the
+        # 2PC holds within one create->create gap
+        while True:
+            await asyncio.sleep(0.05)
+            try:
+                await self.conn.call("heartbeat", {
+                    "node_id": self.node_id,
+                    "available": self.available.raw()})
+            except Exception:
+                await asyncio.sleep(0.2)
+
+    async def close(self):
+        if self._beat_task is not None:
+            self._beat_task.cancel()
+        if self.conn is not None:
+            await self.conn.close()
+
+
+def _sim_spillback_hops(members: list[_topo.TopologyCoord],
+                        origin: _topo.TopologyCoord) -> float:
+    """Greedy nearest-neighbor chain from `origin` visiting every gang
+    member — the ICI hop cost a lease forwarded along the spillback
+    chain pays when each raylet picks its topologically nearest next
+    holder (raylet._topo_prefer)."""
+    hops = 0.0
+    at = origin
+    left = list(members)
+    while left:
+        nxt = min(left, key=lambda c: _topo.torus_hops(
+            at.coords, c.coords, at.dims))
+        hops += _topo.torus_hops(at.coords, nxt.coords, at.dims)
+        left.remove(nxt)
+        at = nxt
+    return hops
+
+
+async def _run_arm_window(gcs, raylets, strategy: str, bundles: int,
+                          gangs: int, rng: random.Random) -> list[dict]:
+    """Fill the fleet with `gangs` gangs under `strategy`, measure each,
+    then release everything and wait for the availability view to
+    settle. Returns one record per gang."""
+    fleet_cpus = sum(r.total.get("CPU") for r in raylets)
+
+    async def wait_available(expect: float, timeout: float = 20.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            avail = await gcs.call("get_available_resources", {})
+            total = sum(ResourceSet.from_raw(raw).get("CPU")
+                        for raw in avail.values())
+            if abs(total - expect) < 1e-6:
+                return
+            await asyncio.sleep(0.02)
+        raise TimeoutError(
+            f"director availability never reached {expect} CPUs")
+
+    out = []
+    created: list[bytes] = []
+    coords_by_node = {r.node_id: r.coord for r in raylets}
+    try:
+        for g in range(gangs):
+            await wait_available(fleet_cpus - g * bundles)
+            pg_id = rng.randbytes(16)
+            spec = [{"resources": ResourceSet({"CPU": 1.0}).raw()}
+                    for _ in range(bundles)]
+            t0 = time.perf_counter()
+            reply = await gcs.call("create_placement_group", {
+                "pg_id": pg_id, "bundles": spec, "strategy": strategy})
+            state = reply["state"]
+            deadline = time.monotonic() + 20.0
+            while state != "CREATED":
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"{strategy} gang {g} stuck in {state}")
+                await asyncio.sleep(0.02)
+                rec = await gcs.call("get_placement_group",
+                                     {"pg_id": pg_id})
+                state = rec["state"] if rec else "REMOVED"
+            latency = time.perf_counter() - t0
+            created.append(pg_id)
+            rec = await gcs.call("get_placement_group", {"pg_id": pg_id})
+            members = [coords_by_node[b["node_id"]] for b in rec["bundles"]]
+            origin = coords_by_node[rng.choice(raylets).node_id]
+            out.append({
+                "strategy": strategy,
+                "ring_circumference": _topo.ring_circumference(members),
+                "spillback_hops": _sim_spillback_hops(members, origin),
+                "latency_s": latency,
+                # PACK-downgrade marker: only meaningful for ICI_RING
+                # requests (PACK never carries a plan by design)
+                "fallback": (strategy == "ICI_RING"
+                             and "topology_plan" not in rec),
+            })
+    finally:
+        for pg_id in created:
+            await gcs.call("remove_placement_group", {"pg_id": pg_id})
+        await wait_available(fleet_cpus)
+    return out
+
+
+async def _run(plane_by_arm: dict, raylets_by_arm: dict, windows: int,
+               bundles: int, seed: int) -> dict:
+    rng = random.Random(seed)
+    conns = {}
+    for arm, plane in plane_by_arm.items():
+        for r in raylets_by_arm[arm]:
+            await r.connect(plane.gcs_address)
+        conns[arm] = await rpc.connect(plane.gcs_address,
+                                       name=f"toposim-driver-{arm}")
+    records: dict[str, list[dict]] = {arm: [] for arm in plane_by_arm}
+    gangs = len(next(iter(raylets_by_arm.values()))) // bundles
+    try:
+        warm_counts = {}
+        for arm in plane_by_arm:
+            # warmup gang per arm (not recorded): absorbs first-call
+            # costs (import, cache build) so the p99 A/B compares
+            # steady-state scoring, not process cold-start; the
+            # director-side histogram delta below excludes it the same
+            # way
+            strategy = "ICI_RING" if arm == "ici_ring" else "PACK"
+            await _run_arm_window(conns[arm], raylets_by_arm[arm],
+                                  strategy, bundles, 1, rng)
+            snap = await conns[arm].call("get_metrics", {})
+            m = snap.get("gcs.placement_score_s") or {}
+            warm_counts[arm] = list(m.get("counts") or [])
+        for w in range(windows):
+            # paired interleaved: every window runs both arms once,
+            # alternating which goes first so box-load swings wash out
+            order = list(plane_by_arm)
+            if w % 2:
+                order.reverse()
+            for arm in order:
+                strategy = "ICI_RING" if arm == "ici_ring" else "PACK"
+                records[arm].extend(await _run_arm_window(
+                    conns[arm], raylets_by_arm[arm], strategy,
+                    bundles, gangs, rng))
+        # director-side scoring histogram, per arm — warmup excluded by
+        # per-bucket count delta (cumulative counts, so subtraction is
+        # exact)
+        score = {}
+        for arm, conn in conns.items():
+            snap = await conn.call("get_metrics", {})
+            m = snap.get("gcs.placement_score_s") or {}
+            counts = list(m.get("counts") or [])
+            warm = warm_counts.get(arm) or [0] * len(counts)
+            delta = [c - w for c, w in zip(counts, warm)]
+            dm = {"counts": delta, "count": sum(delta),
+                  "boundaries": m.get("boundaries") or []}
+            score[arm] = {
+                "count": dm["count"],
+                "p99_s": _stats.percentile(dm, 0.99),
+            }
+    finally:
+        for conn in conns.values():
+            await conn.close()
+        for rs in raylets_by_arm.values():
+            for r in rs:
+                await r.close()
+    leaked = {arm: sum(len(r.holds) for r in rs)
+              for arm, rs in raylets_by_arm.items()}
+    return {"records": records, "score": score, "leaked_holds": leaked}
+
+
+def run_topology_sim(raylets: int = 16, windows: int = 3,
+                     bundles: int = 4, seed: int = 0,
+                     out: str | None = None,
+                     keep_dirs: bool = False) -> dict:
+    """Run the topology arm. Returns per-arm medians/means plus the
+    counter-verified geometry: every ICI_RING gang's ring circumference
+    (target: == bundles, the perfect ring) vs the PACK control's, the
+    simulated spillback-chain hops, and placement latency (client wall
+    + director `gcs.placement_score_s` p99)."""
+    rng = random.Random(seed)
+    n = raylets
+    torus = _torus_for(n)
+    coords = [_topo.TopologyCoord(
+        slice_id="sim-slice", coords=_topo._coords_of_index(i, torus),
+        dims=torus, host_id=f"simhost{i:02d}")
+        for i in range(n)]
+    rng.shuffle(coords)  # allocation order != rack adjacency
+
+    planes = {"ici_ring": ControlPlane(1, label="topo-ici"),
+              "pack": ControlPlane(1, label="topo-pack")}
+    raylets_by_arm = {
+        arm: [TopoSimRaylet(i, bytes([arm_i, i]) * 8, coords[i])
+              for i in range(n)]
+        for arm_i, arm in enumerate(planes)
+    }
+    try:
+        raw = asyncio.run(_run(planes, raylets_by_arm, windows,
+                               bundles, seed))
+    finally:
+        for plane in planes.values():
+            plane.close(remove_dir=not keep_dirs)
+
+    def _mean(xs):
+        return round(sum(xs) / max(len(xs), 1), 3)
+
+    result: dict = {"raylets": n, "windows": windows, "bundles": bundles,
+                    "seed": seed, "torus": list(torus), "arms": {}}
+    for arm, recs in raw["records"].items():
+        circ = [r["ring_circumference"] for r in recs]
+        result["arms"][arm] = {
+            "gangs": len(recs),
+            "mean_ring_circumference": _mean(circ),
+            "max_ring_circumference": max(circ, default=0.0),
+            "mean_spillback_hops": _mean(
+                [r["spillback_hops"] for r in recs]),
+            "placement_latency_ms": {
+                "mean": _mean([r["latency_s"] * 1e3 for r in recs]),
+                "max": round(max((r["latency_s"] for r in recs),
+                                 default=0.0) * 1e3, 3)},
+            "score_p99_s": raw["score"][arm]["p99_s"],
+            "score_count": raw["score"][arm]["count"],
+            "fallbacks": sum(1 for r in recs if r["fallback"]),
+        }
+        result["arms"][arm]["leaked_holds"] = raw["leaked_holds"][arm]
+    a, b = result["arms"]["ici_ring"], result["arms"]["pack"]
+    result["circumference_ratio"] = round(
+        b["mean_ring_circumference"]
+        / max(a["mean_ring_circumference"], 1e-9), 2)
+    result["spillback_hops_ratio"] = round(
+        b["mean_spillback_hops"] / max(a["mean_spillback_hops"], 1e-9), 2)
+    result["score_p99_ratio"] = round(
+        a["score_p99_s"] / max(b["score_p99_s"], 1e-9), 3)
+    if out:
+        with open(out, "w") as f:
+            json.dump(result, f, indent=1)
+    return result

@@ -513,8 +513,8 @@ def cmd_state(args) -> int:
     """Live cluster introspection (`ray-tpu state [component]`): every
     process's debug_state() aggregated over the rpc plane — no driver
     runtime needed. Without a component: a per-process summary; with
-    one (serve|tasks|actors|objects|leases|transfers|collectives): flat
-    rows across the cluster, oldest first."""
+    one (serve|placement|tasks|actors|objects|leases|transfers|
+    collectives): flat rows across the cluster, oldest first."""
     addr = _gcs_address(args)
     if not addr:
         print("no cluster found", file=sys.stderr)
@@ -816,8 +816,30 @@ def cmd_scalesim(args) -> int:
     """Control-plane scale-sim: spoofed raylets against a real GCS
     (director + store shards) on this box — scheduler decisions/s and
     GCS op throughput, interleaved A/B vs the single-shard legacy arm
-    (ray_tpu/scalesim/harness.py)."""
+    (ray_tpu/scalesim/harness.py). --topology runs the placement arm
+    instead: ICI_RING vs PACK over spoofed 4x4-torus raylets
+    (ray_tpu/scalesim/topology_sim.py)."""
     from ray_tpu.scalesim import run_scalesim
+
+    if args.topology:
+        from ray_tpu.scalesim import run_topology_sim
+
+        result = run_topology_sim(raylets=args.raylets,
+                                  windows=args.windows, seed=args.seed,
+                                  out=args.out)
+        for label, arm in result["arms"].items():
+            print(f"{label}: circumference "
+                  f"{arm['mean_ring_circumference']}  spillback hops "
+                  f"{arm['mean_spillback_hops']}  latency "
+                  f"{arm['placement_latency_ms']['mean']}ms  "
+                  f"score p99 {arm['score_p99_s'] * 1e3:.2f}ms")
+        print(f"PACK/ICI_RING circumference ratio "
+              f"{result['circumference_ratio']}x, spillback hops "
+              f"{result['spillback_hops_ratio']}x, score p99 ratio "
+              f"{result['score_p99_ratio']}")
+        if args.out:
+            print(f"wrote {args.out}")
+        return 0
 
     result = run_scalesim(
         shards=args.shards, raylets=args.raylets, windows=args.windows,
@@ -929,14 +951,18 @@ def main(argv=None) -> int:
                        help="live cluster introspection (debug_state "
                             "of every process)")
     p.add_argument("component", nargs="?", default=None,
-                   choices=["serve", "tasks", "actors", "objects",
-                            "leases", "transfers", "collectives"],
+                   choices=["serve", "placement", "tasks", "actors",
+                            "objects", "leases", "transfers",
+                            "collectives"],
                    help="flat rows for one component class "
                         "(omit for a per-process summary; `serve` shows "
                         "per-router queue depth vs bound + shed/admitted "
                         "totals, replica-group state, and per-engine "
                         "decode-batch occupancy / per-session KV page "
-                        "counts / stream backlog for streaming backends)")
+                        "counts / stream backlog for streaming backends; "
+                        "`placement` shows per-pg bundle→node rows with "
+                        "topology coords and the chosen strategy / "
+                        "cost-model)")
     p.add_argument("--address", default=None)
     p.add_argument("--filter", default=None,
                    help="only rows containing this substring")
@@ -1038,6 +1064,11 @@ def main(argv=None) -> int:
                         "verify zero lost acked ops")
     p.add_argument("--no-legacy-arm", action="store_true",
                    help="skip the interleaved shards=1 control arm")
+    p.add_argument("--topology", action="store_true",
+                   help="run the topology placement arm instead: "
+                        "ICI_RING vs PACK over spoofed 4x4-torus "
+                        "raylets (circumference / spillback hops / "
+                        "placement latency)")
     p.add_argument("--out", default=None, help="write result JSON here")
     p.set_defaults(fn=cmd_scalesim)
 

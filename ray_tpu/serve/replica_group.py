@@ -21,9 +21,12 @@ the controller gang-restarts the WHOLE group (fresh pg-backed gang,
 fresh collective group name — a half-dead gang is never reused).
 
 Gang scheduling: members are placed via a placement group (the GCS's
-atomic 2PC bundle reservation = the gang lease acquisition), PACK
-strategy so co-residency gives the collective the shm tier when one
-host has room.
+atomic 2PC bundle reservation = the gang lease acquisition), ICI_RING
+strategy so consecutive ranks land on ICI-neighboring nodes and the
+collective transport tier is DERIVED from the placement record
+(topology.transport_plan — shm when the ring packed onto one host)
+instead of probed; on coordinate-less clusters the GCS degrades
+ICI_RING to PACK (counted) and the probe round is preserved.
 """
 
 from __future__ import annotations
@@ -343,10 +346,17 @@ def spawn_replica_group(backend: str, pickled_callable: bytes,
     timeout_s = float(config.get("shard_group_timeout_s") or 10.0)
     own_pg = pg is None
     if own_pg:
+        # ICI_RING: consecutive ranks land on ICI-neighboring nodes (the
+        # geometry the gang's allreduce ring wants) and the collective
+        # transport below derives from the record. On clusters without
+        # topology coords the GCS degrades it to PACK (counted) — the
+        # pre-topology behavior, bit-for-bit.
         pg = placement_group(
             [{"CPU": float(config.get("num_cpus_per_shard") or 0.001)}
              for _ in range(n)],
-            strategy="PACK", name=f"serve-gang-{backend}-{gang_id}")
+            strategy="ICI_RING",
+            cost_model=config.get("placement_cost_model") or "",
+            name=f"serve-gang-{backend}-{gang_id}")
     members: list = []
     try:
         if not pg.ready(timeout=30.0):
@@ -366,7 +376,11 @@ def spawn_replica_group(backend: str, pickled_callable: bytes,
         create_collective_group(
             members, n, list(range(n)), backend="host",
             group_name=group_name, timeout=timeout_s,
-            transport=config.get("shard_transport") or "auto")
+            transport=config.get("shard_transport") or "auto",
+            # ICI_RING-placed gangs derive their tier from the record
+            # (probe-free); PACK-fallback records carry no plan and the
+            # probe round is preserved
+            placement_group=pg)
         ray_tpu.get(members[0].set_peers.remote(members[1:]), timeout=60)
     except BaseException:
         for m in members:

@@ -112,17 +112,27 @@ class Trainer:
                  collective_timeout: float = 30.0,
                  setup_timeout: float = 600.0,
                  quantize: str | None = None,
-                 collective_transport: str = "auto"):
+                 collective_transport: str = "auto",
+                 placement_strategy: str | None = "ICI_RING"):
         """quantize="int8" makes the gradient-sync allreduce ride the
         block-scaled int8 wire format (EQuARX-style) on the tiers that
         have a wire — the collective DEVICE plane and the host TCP ring
         — cutting gradient bytes ~4x; state sync (broadcast) and
         node-local tiers stay exact. collective_transport pins the
-        group's data plane to one tier (tests / wire A/Bs)."""
+        group's data plane to one tier (tests / wire A/Bs).
+
+        placement_strategy (default "ICI_RING"): gang-reserve the
+        workers through a placement group per generation so consecutive
+        ranks land on ICI-neighboring nodes and the collective tier is
+        DERIVED from the reservation (probe-free); clusters without
+        topology coords degrade it to PACK at the GCS. None disables
+        the reservation entirely (pre-topology scheduling)."""
         self._operator_cls = training_operator_cls
         self._config = config or {}
         self._quantize = quantize
         self._collective_transport = collective_transport
+        self._placement_strategy = placement_strategy
+        self._pg = None
         self._num_workers = num_workers
         self._resources = dict(resources_per_worker or {"CPU": 1})
         if use_tpu:
@@ -144,6 +154,54 @@ class Trainer:
     # worker group lifecycle (reference: worker_group.py:107/:208)
     # ------------------------------------------------------------------
 
+    def _gang_reserve(self, num_workers: int):
+        """Reserve one bundle per worker under the trainer's placement
+        strategy. Best-effort: a reservation that cannot be placed
+        promptly (resources still draining from the previous
+        generation, single saturated node) is dropped and the workers
+        schedule exactly as before — the reservation is an
+        optimization, never a new failure mode."""
+        if self._placement_strategy is None or num_workers <= 1:
+            return None
+        from ray_tpu.util.placement_group import (placement_group,
+                                                  remove_placement_group)
+
+        try:
+            pg = placement_group(
+                [dict(self._resources) for _ in range(num_workers)],
+                strategy=self._placement_strategy,
+                name=f"sgd-{self._uid}-g{self._generation}")
+        except Exception:
+            return None
+        try:
+            # short bound: a placeable gang resolves in well under a
+            # second; anything longer means the fleet is saturated and
+            # the pre-topology queue-and-wait path is strictly better
+            # than stalling __init__ here
+            if pg.ready(timeout=3.0):
+                return pg
+        except Exception:
+            pass
+        # not placeable (or ready() errored): the registered group must
+        # not linger — a later GCS retry would reserve a full worker-set
+        # of resources nobody ever uses
+        try:
+            remove_placement_group(pg)
+        except Exception:
+            pass
+        return None
+
+    def _release_gang(self):
+        if self._pg is None:
+            return
+        from ray_tpu.util.placement_group import remove_placement_group
+
+        try:
+            remove_placement_group(self._pg)
+        except Exception:
+            pass
+        self._pg = None
+
     def _start_workers(self, num_workers: int):
         self._generation += 1
         group_name = f"sgd_{self._uid}_g{self._generation}"
@@ -151,9 +209,15 @@ class Trainer:
         # serialize by value (stdlib pickle would import-by-reference and
         # fail on the worker).
         pickled = cloudpickle.dumps(self._operator_cls)
+        self._pg = self._gang_reserve(num_workers)
         worker_cls = ray_tpu.remote(
             resources=dict(self._resources))(TrainWorker)
         self.workers = [
+            worker_cls.options(
+                placement_group=self._pg,
+                placement_group_bundle_index=rank,
+            ).remote(pickled, self._config, rank, num_workers, group_name)
+            if self._pg is not None else
             worker_cls.remote(pickled, self._config, rank, num_workers,
                               group_name)
             for rank in range(num_workers)
@@ -168,7 +232,9 @@ class Trainer:
                 backend=self._backend, group_name=group_name,
                 timeout=self._collective_timeout,
                 quantize=self._quantize,
-                transport=self._collective_transport)
+                transport=self._collective_transport,
+                # ICI_RING reservations carry the derived transport tier
+                placement_group=self._pg)
         ray_tpu.get([w.setup_operator.remote() for w in self.workers],
                     timeout=self._setup_timeout)
         self._active_workers = num_workers
@@ -196,6 +262,9 @@ class Trainer:
             except Exception:
                 pass
         self.workers = []
+        # release the gang's bundles BEFORE the next generation reserves
+        # its own — a lingering hold would starve the new reservation
+        self._release_gang()
 
     def _resize_worker_group(self):
         """Reference: torch_trainer.py:328 — shut the group down, restart
@@ -299,6 +368,7 @@ class Trainer:
             except Exception:
                 pass
         self.workers = []
+        self._release_gang()
 
 
 def _reduce(results: list[dict]) -> dict:

@@ -14,7 +14,8 @@ from __future__ import annotations
 from ray_tpu._private import global_state
 from ray_tpu._private.ids import PlacementGroupID
 
-VALID_STRATEGIES = ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD")
+VALID_STRATEGIES = ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD",
+                    "ICI_RING")
 
 
 class PlacementGroup:
@@ -32,6 +33,8 @@ class PlacementGroup:
         (woken by the CREATED/REMOVED publish, with a slow re-poll
         backstop) instead of the old 20ms client busy-poll; the reads it
         does issue are shard-routed like every pg-table lookup."""
+        from ray_tpu.exceptions import PlacementGroupInfeasibleError
+
         cw = global_state.require_core_worker()
         if timeout is not None and timeout <= 0:
             # non-blocking probe: one read, no subscription
@@ -39,6 +42,9 @@ class PlacementGroup:
             if info is None:
                 raise ValueError(
                     f"placement group {self.id.hex()} was removed")
+            if info["state"] == "INFEASIBLE":
+                raise PlacementGroupInfeasibleError(
+                    self.id.hex(), info.get("detail", ""))
             if info["state"] == "CREATED":
                 self._bundles = info["bundles"]
                 return True
@@ -46,6 +52,9 @@ class PlacementGroup:
         info = cw.wait_placement_group(self.id.binary(), timeout=timeout)
         if info is None:
             return False
+        if info.get("state") == "INFEASIBLE":
+            raise PlacementGroupInfeasibleError(
+                self.id.hex(), info.get("detail", ""))
         self._bundles = info["bundles"]
         return True
 
@@ -73,9 +82,20 @@ class PlacementGroup:
 
 def placement_group(bundles: list[dict] | None = None,
                     strategy: str = "PACK", name: str = "",
-                    tpu_slice: str | None = None) -> PlacementGroup:
+                    tpu_slice: str | None = None,
+                    cost_model: str = "") -> PlacementGroup:
     """Reserve `bundles` (list of resource dicts, e.g. [{"CPU": 1}]) across
     the cluster atomically (reference: util/placement_group.py:147).
+
+    strategy="ICI_RING" asks the GCS to order the bundles so CONSECUTIVE
+    ranks land on ICI-neighboring torus coords (minimal ring
+    circumference — the geometry the collective ring/shm tiers want);
+    nodes without registered topology coords degrade it to PACK, counted
+    by `gcs.placement_topology_fallbacks_total`. `cost_model` picks the
+    scoring object per request: "" / "ring" (default heuristic),
+    "metrics" (PR 6 history-scored), a name registered in the GCS
+    process via topology.register_cost_model, or a "module:attr" spec
+    the GCS imports (how a learned policy plugs in, per Placeto).
 
     tpu_slice="v5e-16" requests a whole ICI-connected slice instead of
     hand-written bundles: one bundle per slice host ({TPU: chips/host} +
@@ -111,9 +131,14 @@ def placement_group(bundles: list[dict] | None = None,
             raise ValueError(f"invalid bundle {b!r}")
         if any(v < 0 for v in b.values()):
             raise ValueError(f"negative resource in bundle {b!r}")
+    if cost_model and strategy != "ICI_RING":
+        raise ValueError(
+            f"cost_model={cost_model!r} only applies to the ICI_RING "
+            f"strategy (got strategy={strategy!r})")
     cw = global_state.require_core_worker()
     pg_id = PlacementGroupID.from_random()
-    cw.create_placement_group(pg_id.binary(), bundles, strategy, name)
+    cw.create_placement_group(pg_id.binary(), bundles, strategy, name,
+                              cost_model=cost_model)
     return PlacementGroup(pg_id)
 
 
@@ -152,6 +177,8 @@ def placement_group_table() -> dict:
             "state": rec["state"],
             "name": rec.get("name", ""),
             "strategy": rec["strategy"],
+            "cost_model": rec.get("cost_model", ""),
+            "topology_plan": rec.get("topology_plan"),
             "bundles": [_bundle(b) for b in rec["bundles"]],
         }
         for rec in cw.list_placement_groups()

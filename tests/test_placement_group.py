@@ -125,6 +125,39 @@ def test_strict_spread_across_nodes(ray_start_cluster):
     assert len(set(pids)) == 2
 
 
+def test_spread_distinct_nodes_and_strict_spread_typed_infeasible(
+        ray_start_cluster):
+    """Spread coverage (satellite), one fleet for both halves: SPREAD
+    lands every bundle on its own node even though one node could hold
+    them all (least-loaded round-robin); STRICT_SPREAD wanting more
+    distinct nodes than the fleet HAS surfaces typed
+    (PlacementGroupInfeasibleError) instead of an indistinguishable
+    forever-PENDING (the recovery-on-join path is covered in
+    test_topology_placement.py)."""
+    import pytest as _pytest
+
+    from ray_tpu._private.node import start_gcs
+    from ray_tpu.exceptions import PlacementGroupInfeasibleError
+
+    cluster = ray_start_cluster
+    cluster.gcs_svc, cluster.gcs_address = start_gcs(
+        cluster.session_dir, cluster.config)
+    cluster.add_node(num_cpus=3, is_head=True)
+    cluster.add_node(num_cpus=3)
+    cluster.add_node(num_cpus=3)
+    cluster.connect_driver()
+
+    pg = placement_group([{"CPU": 1}] * 3, strategy="SPREAD")
+    assert pg.ready(timeout=15)
+    bundles = placement_group_table()[pg.id.hex()]["bundles"]
+    assert len({b["node_id"] for b in bundles}) == 3
+    remove_placement_group(pg)
+
+    wide = placement_group([{"CPU": 1}] * 4, strategy="STRICT_SPREAD")
+    with _pytest.raises(PlacementGroupInfeasibleError):
+        wide.ready(timeout=5)
+
+
 def test_removed_pg_frees_resources(ray_start_regular):
     pg = placement_group([{"CPU": 4}])
     assert pg.ready(timeout=10)

@@ -1,0 +1,249 @@
+"""Topology plane unit tests (ISSUE 14): TopologyCoord derivation,
+graded distance, snake/ring geometry, the SNIPPETS [2] mesh-shape
+table, the pluggable placement cost model, and placement-derived
+transport plans. Pure functions — no cluster."""
+
+import json
+
+import pytest
+
+from ray_tpu._private import topology as topo
+from ray_tpu._private.common import ResourceSet
+
+
+def coord(slice_id="s0", coords=(0, 0), dims=(4, 4), host="h0"):
+    return topo.TopologyCoord(slice_id=slice_id, coords=tuple(coords),
+                              dims=tuple(dims), host_id=host)
+
+
+# ---------------------------------------------------------------------------
+# coords + derivation
+# ---------------------------------------------------------------------------
+
+
+def test_coord_roundtrip():
+    c = coord(coords=(1, 2), host="abc")
+    assert topo.TopologyCoord.from_dict(c.to_dict()) == c
+    assert topo.TopologyCoord.from_dict(None) is None
+    assert topo.TopologyCoord.from_dict({"coords": [1]}) is None  # no slice
+
+
+def test_derive_coord_priority_explicit_over_env_over_slice():
+    env = {topo.ENV_VAR: json.dumps(
+        {"slice_id": "env-slice", "coords": [3, 3], "dims": [4, 4]})}
+    tpu_slice = {"slice_id": "hw-slice", "topology": [2, 2, 2],
+                 "host_index": 1, "num_hosts": 2, "chips_per_host": 4}
+    explicit = {"slice_id": "exp", "coords": [1, 0], "dims": [2, 2]}
+    c = topo.derive_coord(node_id_hex="n1", tpu_slice=tpu_slice,
+                          explicit=explicit, env=env)
+    assert c.slice_id == "exp" and c.host_id == "n1"
+    c = topo.derive_coord(node_id_hex="n1", tpu_slice=tpu_slice, env=env)
+    assert c.slice_id == "env-slice"
+    c = topo.derive_coord(node_id_hex="n1", tpu_slice=tpu_slice, env={})
+    assert c.slice_id == "hw-slice"
+    assert topo.derive_coord(node_id_hex="n1", env={}) is None
+
+
+def test_derive_coord_from_slice_descriptor_is_deterministic():
+    desc = {"slice_id": "s", "topology": [4, 4], "host_index": 3,
+            "num_hosts": 4, "chips_per_host": 4}
+    a = topo.derive_coord(node_id_hex="n", tpu_slice=desc, env={})
+    b = topo.derive_coord(node_id_hex="n", tpu_slice=desc, env={})
+    assert a == b
+    # distinct hosts of one slice get distinct coords
+    seen = set()
+    for i in range(4):
+        d = dict(desc, host_index=i)
+        seen.add(topo.derive_coord(node_id_hex=f"n{i}", tpu_slice=d,
+                                   env={}).coords)
+    assert len(seen) == 4
+
+
+def test_host_grid_factors_num_hosts():
+    assert topo._host_grid(1, (4, 4)) == (1,)
+    grid = topo._host_grid(4, (4, 4))
+    assert len(grid) >= 1
+    import math
+
+    assert math.prod(grid) == 4
+    assert math.prod(topo._host_grid(6, (4, 4))) == 6
+
+
+# ---------------------------------------------------------------------------
+# distance grading
+# ---------------------------------------------------------------------------
+
+
+def test_torus_hops_wraparound():
+    assert topo.torus_hops((0, 0), (0, 3), (4, 4)) == 1  # wrap beats 3
+    assert topo.torus_hops((0, 0), (2, 2), (4, 4)) == 4
+    assert topo.torus_hops((0,), (3,), ()) == 3  # no dims: manhattan
+
+
+def test_distance_grading_bands():
+    same_host = topo.distance(coord(host="h"), coord(coords=(1, 1),
+                                                     host="h"))
+    near = topo.distance(coord(host="a"), coord(coords=(0, 1), host="b"))
+    far = topo.distance(coord(host="a"), coord(coords=(2, 2), host="b"))
+    cross = topo.distance(coord(host="a"),
+                          coord(slice_id="other", host="b"))
+    assert same_host < near < far < cross
+    assert topo.distance(coord(), None) == topo.D_CROSS_SLICE
+    assert topo.distance(coord(host="h"), coord(host="h")) \
+        == topo.D_SAME_PROCESS
+
+
+def test_nearest_first_orders_by_distance_and_preserves_unknown():
+    origin = coord(coords=(0, 0), host="o")
+    items = [coord(slice_id="other", host="x"),
+             coord(coords=(0, 1), host="a"),
+             coord(coords=(2, 2), host="b")]
+    out = topo.nearest_first(origin, items, lambda c: c)
+    assert [c.host_id for c in out] == ["a", "b", "x"]
+    assert topo.nearest_first(None, items, lambda c: c) == items
+
+
+# ---------------------------------------------------------------------------
+# snake / ring geometry
+# ---------------------------------------------------------------------------
+
+
+def test_snake_order_consecutive_positions_are_ici_neighbors():
+    cs = [coord(coords=topo._coords_of_index(i, (4, 4)), host=f"h{i}")
+          for i in range(16)]
+    cs.sort(key=topo.snake_key)
+    for a, b in zip(cs, cs[1:]):
+        assert topo.torus_hops(a.coords, b.coords, (4, 4)) == 1, \
+            (a.coords, b.coords)
+
+
+def test_ring_circumference():
+    ring = [coord(coords=(0, i), host=f"h{i}") for i in range(4)]
+    assert topo.ring_circumference(ring) == 4.0  # wrap hop included
+    # same-host consecutive ranks ride shm, not wire
+    packed = [coord(host="h")] * 3
+    assert topo.ring_circumference(packed) == 0.0
+    spanning = [coord(host="a"), coord(slice_id="z", host="b")]
+    assert topo.ring_circumference(spanning) >= topo.D_CROSS_SLICE
+    assert topo.ring_circumference([coord()]) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# mesh-shape table (SNIPPETS [2])
+# ---------------------------------------------------------------------------
+
+
+def test_mesh_shape_table_and_synthesis():
+    from ray_tpu.parallel.mesh import mesh_shape_for
+
+    assert mesh_shape_for(8) == (8, 1)       # v5p-8: pure DP
+    assert mesh_shape_for(16) == (8, 2)
+    assert mesh_shape_for(32) == (8, 4)
+    assert mesh_shape_for(64) == (16, 4)
+    assert mesh_shape_for(128) == (32, 4)
+    assert mesh_shape_for(256) == (64, 4)
+    assert mesh_shape_for(768) == (192, 4)
+    # non-table sizes synthesize with the fsdp<=4 rationale
+    for n in (12, 24, 40, 6, 7, 10):
+        data, fsdp = mesh_shape_for(n)
+        assert data * fsdp == n
+        assert fsdp <= 4
+    with pytest.raises(ValueError):
+        mesh_shape_for(0)
+
+
+# ---------------------------------------------------------------------------
+# pluggable cost model
+# ---------------------------------------------------------------------------
+
+
+def test_cost_model_resolution_and_registry():
+    default = topo.resolve_cost_model("")
+    assert isinstance(default, topo.RingDistanceCostModel)
+    assert topo.resolve_cost_model("ring") is default
+    assert isinstance(topo.resolve_cost_model("metrics"),
+                      topo.MetricsTrendCostModel)
+    with pytest.raises(ValueError):
+        topo.resolve_cost_model("no-such-model")
+    with pytest.raises(ValueError):
+        topo.resolve_cost_model("definitely.not.a.module:thing")
+
+    class Flat(topo.PlacementCostModel):
+        name = "flat-test"
+
+        def score(self, bundles, candidates):
+            return 0.0
+
+    topo.register_cost_model(Flat())
+    assert isinstance(topo.resolve_cost_model("flat-test"), Flat)
+
+
+def test_cost_model_module_attr_spec_imports():
+    model = topo.resolve_cost_model(
+        "tests.topology_cost_models:InvertedRing")
+    ring = [coord(coords=(0, i), host=f"h{i}") for i in range(4)]
+    assert model.score([], ring) == -topo.ring_circumference(ring)
+
+
+def test_metrics_trend_model_penalizes_hot_nodes():
+    m = topo.MetricsTrendCostModel(penalty=10.0)
+    hot = coord(host="aabbccdd0000")  # host_id[:8] = aabbccdd
+    cold = coord(coords=(0, 1), host="ffffffff0000")
+    base = m.score([], [hot, cold])
+    m.bind_context({"metrics_history": {
+        "aabbccdd/raylet": {"raylet.spillbacks_total":
+                            [[0.0, 1.0], [1.0, 5.0]]}}})
+    assert m.score([], [hot, cold]) == base + 10.0
+
+
+# ---------------------------------------------------------------------------
+# placement-derived transport
+# ---------------------------------------------------------------------------
+
+
+def _pg_record(nodes, coords, strategy="ICI_RING", tpu=0.0,
+               with_plan=True):
+    bundles = [{"bundle_index": i,
+                "resources": ResourceSet(
+                    {"CPU": 1.0, **({"TPU": tpu} if tpu else {})}).raw(),
+                "node_id": n, "topology": c.to_dict() if c else None}
+               for i, (n, c) in enumerate(zip(nodes, coords))]
+    rec = {"pg_id": b"x" * 16, "state": "CREATED", "strategy": strategy,
+           "bundles": bundles, "cost_model": "ring"}
+    if with_plan:
+        rec["topology_plan"] = {"ring_circumference": 0.0,
+                                "cost_model": "ring"}
+    return rec
+
+
+def test_transport_plan_shm_when_one_node():
+    c = coord()
+    rec = _pg_record([b"n1", b"n1"], [c, c])
+    plan = topo.transport_plan(rec)
+    assert plan["transport"] == "shm"
+    assert len(plan["ranks"]) == 2
+
+
+def test_transport_plan_ring_hub_and_none():
+    cs = [coord(coords=(0, i), host=f"h{i}") for i in range(3)]
+    rec = _pg_record([b"n1", b"n2", b"n3"], cs)
+    assert topo.transport_plan(rec)["transport"] == "ring"
+    # 2-rank ring degenerates: hub
+    rec2 = _pg_record([b"n1", b"n2"], cs[:2])
+    assert topo.transport_plan(rec2)["transport"] == "hub"
+    # no plan on the record (PACK fallback / ad-hoc): keep probing
+    assert topo.transport_plan(
+        _pg_record([b"n1", b"n2"], cs[:2], with_plan=False)) is None
+    assert topo.transport_plan(None) is None
+    assert topo.transport_plan({"state": "PENDING"}) is None
+
+
+def test_transport_plan_device_needs_live_tpu_backend():
+    # TPU bundles in one slice only derive "device" when the deriving
+    # process actually runs a TPU backend — on this CPU box they fall
+    # to ring/hub rather than promising a tier the group cannot build
+    cs = [coord(coords=(0, i), host=f"h{i}") for i in range(3)]
+    rec = _pg_record([b"n1", b"n2", b"n3"], cs, tpu=4.0)
+    assert topo.transport_plan(rec)["transport"] in ("ring", "device")
+    if not topo._tpu_backend_live():
+        assert topo.transport_plan(rec)["transport"] == "ring"

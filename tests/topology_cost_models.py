@@ -1,0 +1,17 @@
+"""Custom placement cost models for the ICI_RING end-to-end tests.
+
+The GCS resolves "module:attr" cost-model specs by importing them —
+this module is what a user-registered (e.g. learned, per Placeto)
+policy looks like from the scheduler's point of view. InvertedRing
+NEGATES the ring heuristic, so the scheduler provably consults the
+pluggable model: the observed assignment flips from ring-adjacent to
+maximally spread."""
+
+from ray_tpu._private import topology
+
+
+class InvertedRing(topology.PlacementCostModel):
+    name = "inverted-ring"
+
+    def score(self, bundles, candidates):
+        return -topology.ring_circumference(list(candidates))
