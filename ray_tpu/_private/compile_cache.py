@@ -1,0 +1,431 @@
+"""Persistent AOT compile cache: jitted executables serialized across
+process lifetimes (ROADMAP item 5, second half).
+
+PR 13's compile observability showed where gang restarts and elastic
+resizes stall: every new process re-traces the same jitted functions —
+the `_DeviceOps` collective bodies, the paged-KV donated update, the
+Trainer fused/grad/apply steps — for shape classes an identical process
+compiled minutes earlier. This module closes the loop: the FIRST process
+to compile a (seam, shape-class) pair exports the jitted function via
+`jax.export` (StableHLO + calling convention, the only serialization
+the runtime can rely on across jax minor versions) and stores the blob
+in an on-disk session cache; every later process — a restarted gang
+rank, an elastic-resize joiner, a fresh serve replica — deserializes
+and skips the trace+compile entirely.
+
+Key schema (sha256 over a JSON list, hex-truncated):
+
+    [seam, *parts, runtime_fingerprint()]
+
+* ``seam`` names the call site class ("collective", "serve.kv_update",
+  "train.step") — the same names the compile spans carry.
+* ``parts`` is the seam's own cache key: op kind, dtype, shape-class,
+  axis name, world size — every compile-relevant input, nothing else.
+* ``runtime_fingerprint()`` folds in jax/jaxlib/libtpu versions, the
+  backend, the device kinds, and the process count: any of these
+  changing invalidates EVERY entry (an executable compiled for another
+  runtime must never load — fingerprint mismatch means a different
+  key, which means a clean miss, never a wrong executable).
+
+Failure semantics: the cache can only make things faster, never break
+them. A load/deserialize failure counts `jax.compile_cache_errors_total`
+and falls back to the normal trace+compile path; a store failure counts
+the same and the op proceeds on the freshly-jitted function. The
+`compile_cache.load` / `compile_cache.store` failpoints inject exactly
+these faults in chaos tests. Writes are temp-file + os.replace so a
+crashed writer leaves either a whole blob or a ``.ctmp-*`` stray (which
+the test-suite leak check names), never a torn file.
+
+The local JSON index (entry key -> seam/parts/size/created/hits) is
+mirrored to the GCS KV under ``ray_tpu:compile_cache/index`` so the CLI
+(`ray-tpu compile-cache`) and the doctor can see cache state without
+touching the cache host's disk.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import tempfile
+import threading
+import time
+
+from ray_tpu._private import stats as _stats
+
+M_HITS = _stats.Count(
+    "jax.compile_cache_hits_total",
+    "persistent compile-cache hits: a jitted executable deserialized "
+    "from the on-disk AOT cache instead of re-tracing")
+M_MISSES = _stats.Count(
+    "jax.compile_cache_misses_total",
+    "persistent compile-cache misses: no entry for the (seam, "
+    "shape-class, runtime-fingerprint) key — the caller traced, "
+    "compiled, and (best-effort) populated the cache")
+M_ERRORS = _stats.Count(
+    "jax.compile_cache_errors_total",
+    "persistent compile-cache load/deserialize/store failures — every "
+    "one degraded to a normal re-trace, never a user-visible error")
+M_LOAD_S = _stats.Histogram(
+    "jax.compile_cache_load_s", _stats.LATENCY_BOUNDARIES_S,
+    "wall seconds to load + deserialize one cached executable (the "
+    "re-trace time this hit avoided is jax.compile_s)")
+
+# stray temp files carry this prefix so the conftest leak check can
+# name them (a crashed writer is the only way one survives)
+TMP_PREFIX = ".ctmp-"
+INDEX_NAME = "index.json"
+KV_INDEX_KEY = "ray_tpu:compile_cache/index"
+
+_lock = threading.Lock()
+
+
+def enabled() -> bool:
+    """RAY_TPU_COMPILE_CACHE=0 turns the plane off (every call is a
+    plain re-trace and nothing touches disk)."""
+    return os.environ.get("RAY_TPU_COMPILE_CACHE", "1") not in (
+        "0", "false", "no")
+
+
+def cache_dir() -> str:
+    d = os.environ.get("RAY_TPU_COMPILE_CACHE_DIR")
+    if not d:
+        d = os.path.join(tempfile.gettempdir(), "ray_tpu_compile_cache")
+    return d
+
+
+def runtime_fingerprint() -> str:
+    """Every runtime fact a serialized executable depends on. Computed
+    lazily (jax may not be imported in pure-host processes) and cached
+    per process — the facts it reads are process-constant."""
+    global _fingerprint
+    if _fingerprint is not None:
+        return _fingerprint
+    parts = []
+    try:
+        import jax
+
+        parts.append(jax.__version__)
+        try:
+            import jaxlib
+
+            parts.append(getattr(jaxlib, "__version__", "?"))
+        except Exception:
+            parts.append("?")
+        try:
+            parts.append(jax.default_backend())
+            parts.append(",".join(sorted(
+                {d.device_kind for d in jax.devices()})))
+            parts.append(str(jax.process_count()))
+        except Exception:
+            parts.append("uninit")
+        try:  # TPU boxes: the libtpu build changes lowering
+            import libtpu  # type: ignore
+
+            parts.append(getattr(libtpu, "__version__", "?"))
+        except Exception:
+            pass
+    except Exception:
+        parts.append("nojax")
+    _fingerprint = "|".join(parts)
+    return _fingerprint
+
+
+_fingerprint: str | None = None
+
+
+def make_key(seam: str, parts) -> str:
+    blob = json.dumps([seam, list(map(str, parts)),
+                       runtime_fingerprint()], sort_keys=True)
+    return hashlib.sha256(blob.encode()).hexdigest()[:32]
+
+
+# ---------------------------------------------------------------------------
+# blob + index storage
+# ---------------------------------------------------------------------------
+
+
+def _blob_path(key: str) -> str:
+    return os.path.join(cache_dir(), key + ".jaxexp")
+
+
+def _index_path() -> str:
+    return os.path.join(cache_dir(), INDEX_NAME)
+
+
+def _read_index() -> dict:
+    try:
+        with open(_index_path(), "r", encoding="utf-8") as f:
+            out = json.load(f)
+        return out if isinstance(out, dict) else {}
+    except Exception:
+        return {}
+
+
+def _write_index(index: dict) -> None:
+    """Atomic local write, then best-effort GCS KV mirror (the CLI and
+    doctor read the mirror; the cache itself only trusts the disk)."""
+    d = cache_dir()
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(prefix=TMP_PREFIX, dir=d)
+    try:
+        with os.fdopen(fd, "w", encoding="utf-8") as f:
+            json.dump(index, f)
+        os.replace(tmp, _index_path())
+    except Exception:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    try:
+        from ray_tpu.experimental import internal_kv
+
+        internal_kv._kv_put(KV_INDEX_KEY,
+                            json.dumps(index).encode())
+    except Exception:
+        pass  # no GCS (unit test / pure-local): disk is authoritative
+
+
+def _index_update(key: str, **fields) -> None:
+    with _lock:
+        index = _read_index()
+        entry = index.setdefault(key, {"hits": 0})
+        entry.update(fields)
+        _write_index(index)
+
+
+def read_index(prefer_kv: bool = False) -> dict:
+    """The CLI entry point: the KV mirror when reachable (cluster-wide
+    view), else the local disk index."""
+    if prefer_kv:
+        try:
+            from ray_tpu.experimental import internal_kv
+
+            raw = internal_kv._kv_get(KV_INDEX_KEY)
+            if raw:
+                out = json.loads(raw.decode())
+                if isinstance(out, dict):
+                    return out
+        except Exception:
+            pass
+    return _read_index()
+
+
+def lookup(key: str) -> bytes | None:
+    """The serialized executable for `key`, or None (absent OR load
+    failure — the caller re-traces either way; only the counter
+    differs)."""
+    if not enabled():
+        return None
+    from ray_tpu._private import failpoints as _fp
+
+    path = _blob_path(key)
+    try:
+        if _fp.ARMED:
+            _fp.fire_strict("compile_cache.load")
+        with open(path, "rb") as f:
+            return f.read()
+    except FileNotFoundError:
+        return None
+    except Exception:
+        M_ERRORS.inc()
+        return None
+
+
+def store(key: str, blob: bytes, seam: str = "", parts=()) -> bool:
+    """Best-effort atomic store + index update. False (and an error
+    count) on any failure — the caller's freshly-jitted function is
+    already the fallback."""
+    if not enabled():
+        return False
+    from ray_tpu._private import failpoints as _fp
+
+    d = cache_dir()
+    try:
+        if _fp.ARMED:
+            _fp.fire_strict("compile_cache.store")
+        os.makedirs(d, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(prefix=TMP_PREFIX, dir=d)
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(blob)
+            os.replace(tmp, _blob_path(key))
+        except Exception:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        _index_update(key, seam=seam,
+                      parts=[str(p) for p in parts],
+                      size=len(blob), created=time.time())
+        return True
+    except Exception:
+        M_ERRORS.inc()
+        return False
+
+
+def record_hit(key: str) -> None:
+    try:
+        with _lock:
+            index = _read_index()
+            if key in index:
+                index[key]["hits"] = int(index[key].get("hits", 0)) + 1
+                _write_index(index)
+    except Exception:
+        pass
+
+
+def clear() -> int:
+    """Remove every blob + the index (local and KV mirror); returns the
+    number of entries removed. The CLI's --clear."""
+    d = cache_dir()
+    n = 0
+    with _lock:
+        try:
+            for name in os.listdir(d):
+                if name.endswith(".jaxexp") or name == INDEX_NAME \
+                        or name.startswith(TMP_PREFIX):
+                    if name.endswith(".jaxexp"):
+                        n += 1
+                    try:
+                        os.unlink(os.path.join(d, name))
+                    except OSError:
+                        pass
+        except FileNotFoundError:
+            pass
+        try:
+            from ray_tpu.experimental import internal_kv
+
+            internal_kv._kv_del(KV_INDEX_KEY)
+        except Exception:
+            pass
+    return n
+
+
+def state() -> dict:
+    """Cache-plane summary for debug_state snapshots and the doctor's
+    cold-restart finding."""
+    index = _read_index()
+    return {
+        "enabled": enabled(),
+        "dir": cache_dir(),
+        "entries": len(index),
+        "hits": int(M_HITS.snapshot()["value"]),
+        "misses": int(M_MISSES.snapshot()["value"]),
+        "errors": int(M_ERRORS.snapshot()["value"]),
+    }
+
+
+# ---------------------------------------------------------------------------
+# the seam wrapper
+# ---------------------------------------------------------------------------
+
+
+class CachedFunction:
+    """One jitted callable behind the persistent cache.
+
+    Resolution happens on the FIRST call (the args fix the trace):
+
+    * hit  — deserialize the stored `jax.export` blob, re-wrap with
+      `jax.jit(exported.call, donate_argnums=...)` (donation is a
+      call-site property the serialized module does not carry), count a
+      hit + load seconds, and DO NOT record a compile — the whole point
+      is that `jax.compiles_total` stays flat on a warm restart.
+    * miss — export + store FIRST (executing a donated jit consumes its
+      input buffers; exporting only traces), then dispatch the normal
+      jitted function and record the compile exactly as the seam did
+      before this cache existed.
+
+    Either way later calls go through one resolved function attribute —
+    the wrapper adds a single `is None` check to the steady state."""
+
+    def __init__(self, seam: str, parts, jitted, donate_argnums=(),
+                 record_key: str | None = None,
+                 fingerprint_computation: bool = False):
+        self.seam = seam
+        self.parts = tuple(parts)
+        self.donate_argnums = tuple(donate_argnums)
+        self._jitted = jitted
+        self._record_key = record_key or (
+            seam + ":" + ":".join(map(str, parts)))
+        # seams whose computation is USER code (Trainer steps: loss_fn,
+        # optimizer) fold a jaxpr hash into the key — two models with
+        # identical shapes must never share an executable. One extra
+        # trace (no compile) per resolution; runtime-owned seams whose
+        # key already pins the computation (op kind) skip it.
+        self._fp_computation = fingerprint_computation
+        self._fn = None
+        self._lock = threading.Lock()
+        self.resolved: str | None = None  # "hit" | "miss" | "disabled"
+
+    def __call__(self, *args):
+        fn = self._fn
+        if fn is not None:
+            return fn(*args)
+        with self._lock:
+            if self._fn is not None:
+                return self._fn(*args)
+            return self._resolve(args)
+
+    def _resolve(self, args):
+        if not enabled():
+            self.resolved = "disabled"
+            return self._first_dispatch(args, record=True)
+        parts = self.parts
+        if self._fp_computation:
+            try:
+                import jax
+
+                jaxpr = jax.make_jaxpr(self._jitted)(*args)
+                parts = parts + (hashlib.sha256(
+                    str(jaxpr).encode()).hexdigest()[:16],)
+            except Exception:
+                # can't prove computation identity -> never share
+                M_ERRORS.inc()
+                self.resolved = "disabled"
+                return self._first_dispatch(args, record=True)
+        key = make_key(self.seam, parts)
+        blob = lookup(key)
+        if blob is not None:
+            t0 = time.time()
+            try:
+                import jax
+                from jax import export as _export
+
+                exported = _export.deserialize(bytearray(blob))
+                fn = jax.jit(exported.call,
+                             donate_argnums=self.donate_argnums)
+                out = fn(*args)
+            except Exception:
+                # a stale/corrupt/incompatible blob: typed error count,
+                # then the normal trace path — never user-visible
+                M_ERRORS.inc()
+            else:
+                self._fn = fn
+                self.resolved = "hit"
+                M_HITS.inc()
+                M_LOAD_S.observe(time.time() - t0)
+                record_hit(key)
+                return out
+        M_MISSES.inc()
+        self.resolved = "miss"
+        try:
+            from jax import export as _export
+
+            blob = _export.export(self._jitted)(*args).serialize()
+            store(key, blob, seam=self.seam, parts=parts)
+        except Exception:
+            M_ERRORS.inc()
+        return self._first_dispatch(args, record=True)
+
+    def _first_dispatch(self, args, record: bool):
+        from ray_tpu._private import profiling as _profiling
+
+        t0 = time.time()
+        out = self._jitted(*args)
+        if record:
+            _profiling.record_compile(self._record_key, t0, time.time())
+        self._fn = self._jitted
+        return out

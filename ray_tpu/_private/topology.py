@@ -439,7 +439,13 @@ def transport_plan(pg_record: dict | None) -> dict | None:
           and len({c.slice_id for c in coords}) == 1
           and all(_bundle_tpu(b) > 0 for b in bundles)
           and _tpu_backend_live()):
-        transport = "device"
+        # the same ICI geometry that admits the device tier admits the
+        # fused-kernel refinement; PALLAS stays opt-in
+        # (RAY_TPU_PALLAS_DERIVE=1) because a derived pin is still a
+        # pin — ops under pallas_max_bytes run the kernel tier, larger
+        # ones fall through to device — and the default AUTO route
+        # already prefers pallas for small device arrays
+        transport = ("pallas" if _pallas_derive_enabled() else "device")
     elif world > 2:
         transport = "ring"
     else:
@@ -448,6 +454,23 @@ def transport_plan(pg_record: dict | None) -> dict | None:
             "ring_circumference": ring_circumference(coords),
             "cost_model": pg_record.get("cost_model") or "ring",
             "strategy": pg_record.get("strategy")}
+
+
+def _pallas_derive_enabled() -> bool:
+    """Whether ICI_RING placement records derive the PALLAS tier
+    instead of DEVICE (both soft pins; pallas additionally needs the
+    kernel machinery importable in the deriving process)."""
+    import os
+
+    if os.environ.get("RAY_TPU_PALLAS_DERIVE", "0") in ("0", "false", ""):
+        return False
+    try:
+        from ray_tpu.collective.backends.pallas_backend import (
+            pallas_supported)
+
+        return pallas_supported()
+    except Exception:
+        return False
 
 
 def _tpu_backend_live() -> bool:

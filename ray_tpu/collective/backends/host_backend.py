@@ -51,6 +51,7 @@ own failure detection), so the SGD layer above can resize the group.
 from __future__ import annotations
 
 import functools
+import logging
 import os
 import socket
 import struct
@@ -63,6 +64,8 @@ import numpy as np
 from ray_tpu._private import failpoints as _fp
 from ray_tpu.collective.types import (_NUMPY_REDUCE, QUANT_BLOCK, ReduceOp,
                                       Transport, normalize_quantize)
+
+logger = logging.getLogger(__name__)
 
 _HDR = struct.Struct(">I")
 
@@ -254,7 +257,8 @@ class _CollectiveState:
                     "payload": out.tobytes(),
                     "dst": metas[ranks[0]].get("dst", -1)}
         if kind in ("allgather", "allgather_ctl_shm",
-                    "allgather_ctl_ring", "allgather_ctl_device"):
+                    "allgather_ctl_ring", "allgather_ctl_device",
+                    "allgather_ctl_pallas"):
             # ctl kinds: transport-plumbing exchanges (ring addresses,
             # shm ok flags), one kind EACH so a rank whose ROUTE diverged
             # (ragged sizes straddling RING_MIN_BYTES) pairs with a real
@@ -348,6 +352,12 @@ class HostGroup:
         self._device = None
         self._device_disabled = False
         self._device_shaped: bool = self._compute_device_shaped()
+        # PALLAS (fused-kernel) tier state: same construction-time shape
+        # gate as the device tier; _pallas_disabled is this rank's veto
+        # after a kernel failure (the device tier stays routable — the
+        # planes fail independently)
+        self._pallas = None
+        self._pallas_disabled = False
         self._shm = None
         self._shm_gen = 0
         self._shm_disabled = False
@@ -549,6 +559,13 @@ class HostGroup:
 
     RING_MIN_BYTES = 1 << 16
     _PIPE_BYTES = 1 << 18  # ring pipeline slice: reduce(k) overlaps recv(k+1)
+    # PALLAS tier size ceiling: only small latency-critical ops (the
+    # decode-step allreduce regime) take the fused kernel; larger
+    # payloads fall through to DEVICE, whose shard_map pipeline is the
+    # bandwidth shape. Group-uniform by the collective contract (same-
+    # geometry payloads; ragged allgather is caught by the meta round).
+    PALLAS_MAX_BYTES = int(os.environ.get(
+        "RAY_TPU_COLLECTIVE_PALLAS_MAX_KB", "64")) << 10
     # Segments grow by rebuild but never shrink, so one oversize op would
     # pin (w+2)*slot of tmpfs for the group's life; above the cap the
     # ring carries the op with no resident cost. Forced shm overrides.
@@ -686,10 +703,15 @@ class HostGroup:
         round; any host-array (or device-incapable) rank vetoes and
         every rank falls back together."""
         forced = self._forced()
-        if forced is not None and forced != Transport.DEVICE.value:
+        # a PALLAS pin is a refinement of the device plane: ops above
+        # pallas_max_bytes and op kinds the kernel tier does not carry
+        # fall through HERE, so the pin behaves like a device pin for
+        # them instead of raising
+        device_like = (Transport.DEVICE.value, Transport.PALLAS.value)
+        if forced is not None and forced not in device_like:
             return False
         if not self._device_group_shaped():
-            if forced == Transport.DEVICE.value:
+            if forced in device_like:
                 # the shape gate is decided once at construction and is
                 # group-uniform by contract, so a derived-pin demotion
                 # here happens on every rank together
@@ -705,7 +727,7 @@ class HostGroup:
             _fp.fire_strict("collective.device_dispatch")
         vote = 0
         if not self._device_disabled and (
-                forced == Transport.DEVICE.value
+                forced in device_like
                 or self._is_device_array(arr)):
             try:
                 dev = self._ensure_device()
@@ -738,6 +760,80 @@ class HostGroup:
             # collective state unknown: stop routing this group to the
             # device plane and surface abort-not-hang semantics
             self._device_disabled = True
+            self._abort_not_hang(e)
+
+    def _ensure_pallas(self):
+        if self._pallas is None:
+            from ray_tpu.collective.backends.pallas_backend import (
+                PallasTransport)
+
+            # raises when rank != process_index — surfaces as a 0 vote
+            self._pallas = PallasTransport(self.world_size, self.rank)
+        return self._pallas
+
+    def _pallas_route(self, arr) -> bool:
+        """Per-op PALLAS-tier agreement, mirroring _device_route: a
+        1-byte hub ctl round with its own kind tag decides whether
+        EVERY rank runs the fused kernel. Ops above PALLAS_MAX_BYTES
+        skip the round entirely and fall through to _device_route —
+        the threshold reads only the local payload size, which is
+        group-uniform for collectives by contract, so every rank skips
+        (or votes) together."""
+        forced = self._forced()
+        if forced is not None and forced != Transport.PALLAS.value:
+            return False
+        if not self._device_group_shaped():
+            if forced == Transport.PALLAS.value:
+                self._tier_unavailable(forced)
+            return False
+        if getattr(arr, "nbytes", 0) > self.PALLAS_MAX_BYTES:
+            # large ops fall through to the DEVICE tier (a forced
+            # pallas pin is device-like there), keeping the kernel
+            # tier on the latency-critical small-op path it was built
+            # for
+            return False
+        self._dbg["phase"] = "pallas_vote"
+        self._probe_rounds += 1
+        if _fp.ARMED:
+            # fires BEFORE the agreement round, like
+            # collective.device_dispatch: a rank hard-killed here
+            # leaves every survivor timing out in the hub exchange
+            # (abort-not-hang)
+            _fp.fire_strict("collective.pallas_dispatch")
+        vote = 0
+        if not self._pallas_disabled and (
+                forced == Transport.PALLAS.value
+                or self._is_device_array(arr)):
+            try:
+                pal = self._ensure_pallas()
+                vote = 1 if pal.dtype_ok(arr.dtype) else 0
+            except Exception:
+                self._pallas_disabled = True
+        flags = self._hub_allgather(np.array([vote], np.uint8),
+                                    kind="allgather_ctl_pallas")
+        agreed = all(int(f[0]) for f in flags)
+        if not agreed and forced == Transport.PALLAS.value:
+            if self._transport_derived:
+                # the vote result is an allgather — identical on every
+                # rank, so a derived pin demotes in unison here
+                self._demote_derived()
+                return False
+            raise RuntimeError(
+                f"forced collective transport 'pallas' is unavailable "
+                f"for group {self.group_name!r}: the placement/dtype "
+                f"vote was not unanimous")
+        return agreed
+
+    def _pallas_op(self, fn):
+        from ray_tpu.collective import metrics  # noqa: F401 (register)
+
+        self._dbg["phase"] = "pallas"
+        try:
+            return fn()
+        except Exception as e:
+            # the kernel tier fails independently of the device plane:
+            # disable only pallas so the next op can still vote device
+            self._pallas_disabled = True
             self._abort_not_hang(e)
 
     def _shm_op(self, fn):
@@ -1454,6 +1550,9 @@ class HostGroup:
                   quantize=None):
         op = ReduceOp(op)
         q = self._quantize_mode(quantize)
+        if self._pallas_route(arr):
+            return self._pallas_op(
+                lambda: self._pallas.allreduce(arr, op, quantize=q))
         if self._device_route(arr):
             return self._device_op(
                 lambda: self._device.allreduce(arr, op, quantize=q))
@@ -1526,8 +1625,10 @@ class HostGroup:
             return self._hub_allgather(self._to_host(arr))
         metas = self._hub_allgather_meta(arr)
         uniform = all(m == metas[0] for m in metas[1:])
-        # the device vote only happens on the uniform path, so every
-        # rank enters (or skips) the ctl round together
+        # the pallas/device votes only happen on the uniform path, so
+        # every rank enters (or skips) the ctl rounds together
+        if uniform and self._pallas_route(arr):
+            return self._pallas_op(lambda: self._pallas.allgather(arr))
         if uniform and self._device_route(arr):
             return self._device_op(lambda: self._device.allgather(arr))
         arr = self._to_host(arr)
@@ -1558,6 +1659,10 @@ class HostGroup:
     def reducescatter(self, arr: np.ndarray, op: ReduceOp = ReduceOp.SUM,
                       quantize=None):
         op = ReduceOp(op)
+        if self._pallas_route(arr):
+            return self._pallas_op(
+                lambda: self._pallas.reducescatter(
+                    arr, op, quantize=self._quantize_mode(quantize)))
         if self._device_route(arr):
             return self._device_op(
                 lambda: self._device.reducescatter(
@@ -1701,6 +1806,12 @@ class HostGroup:
             return
         self._destroyed = True
         self._ring_teardown()
+        if self._pallas is not None:
+            try:
+                self._pallas.destroy()  # drops the pallas jit cache
+            except Exception:
+                pass
+            self._pallas = None
         if self._device is not None:
             try:
                 self._device.destroy()  # drops the jit cache; the jax

@@ -17,6 +17,11 @@ DEVICE_OPS = stats.Count(
     "collective.device_ops_total",
     "collective ops dispatched on the DEVICE (ICI/XLA) transport tier")
 
+PALLAS_OPS = stats.Count(
+    "collective.pallas_ops_total",
+    "collective ops dispatched on the PALLAS fused-kernel tier (one "
+    "pallas_call per op: quantize/DMA/combine ring fused)")
+
 QUANT_SAVED = stats.Count(
     "collective.quantized_bytes_saved_total",
     "wire bytes avoided by int8 block-scaled quantized collectives "

@@ -43,9 +43,19 @@ class Transport(str, enum.Enum):
           jitted shard_map collectives (psum/all_gather/psum_scatter)
           so bytes ride ICI/XLA without touching host RAM
           (backends/xla_backend.DeviceTransport).
-    AUTO — device when every rank holds a device array and the runtime
-          spans the group, else shm when node-local, else ring, else
-          hub.
+    PALLAS — the fused-kernel refinement of the device plane for
+          SMALL latency-critical ops (decode-step allreduce, small grad
+          buckets): the whole quantized/exact ring schedule — chunk,
+          DMA to the ICI neighbor, combine, relay-gather — runs inside
+          ONE pallas_call (backends/pallas_backend.PallasTransport), so
+          an op is one kernel launch instead of a shard_map dispatch
+          graph. Ops above `pallas_max_bytes` fall through to DEVICE;
+          a pallas pin therefore behaves like a device pin for large
+          payloads and for the op kinds the kernel tier does not carry
+          (broadcast).
+    AUTO — pallas for small device arrays when the runtime spans the
+          group, else device, else shm when node-local, else ring,
+          else hub.
     """
 
     AUTO = "auto"
@@ -54,6 +64,7 @@ class Transport(str, enum.Enum):
     RING_UNPIPELINED = "ring_unpipelined"
     SHM = "shm"
     DEVICE = "device"
+    PALLAS = "pallas"
 
 
 class ReduceOp(str, enum.Enum):

@@ -309,6 +309,8 @@ def main(seconds_per_case: float = 2.0) -> list[dict]:
 
     _serve_stream(results)
 
+    _cold_gang_ttft(results)
+
     ray_tpu.shutdown()
 
     _cross_node_bench(results)
@@ -530,7 +532,7 @@ def _collective_bench(results: list[dict], nbytes: int = 16 * 1024 * 1024,
                 transport, quantize = "ring", "int8"
             group.barrier()  # hub-direct: lines ranks up, never routed
             group.force_transport = transport
-            if transport == "device":
+            if transport in ("device", "pallas"):
                 import jax
                 import jax.numpy as jnp
 
@@ -568,14 +570,29 @@ def _collective_bench(results: list[dict], nbytes: int = 16 * 1024 * 1024,
         ray_tpu.get(   # built, hub buffers grown, device bodies jitted —
             [r.timed_allreduce.remote(tr, nbytes // 4) for r in ranks],
             timeout=300)  # no setup in the windows
+    # small-message fused-kernel arm (round 15): decode-step-sized
+    # payloads — the latency class the PALLAS tier exists for — pallas
+    # vs the device (shard_map dispatch stack) control, interleaved in
+    # the same windows. 4096 f32 = 16KB, under pallas_max_bytes.
+    SMALL_ELEMS = 4096
+    small_cases = ["pallas", "device"]
+    for tr in small_cases:  # warm: kernels traced, vote round paid once
+        ray_tpu.get([r.timed_allreduce.remote(tr, SMALL_ELEMS)
+                     for r in ranks], timeout=300)
     samples: dict[str, list[float]] = {tr: [] for tr in cases}
     small: list[float] = []
+    small_samples: dict[str, list[float]] = {tr: [] for tr in small_cases}
     for _ in range(windows):
         for tr in cases:
             ts = ray_tpu.get(
                 [r.timed_allreduce.remote(tr, nbytes // 4) for r in ranks],
                 timeout=300)
             samples[tr].append(max(ts))  # slowest rank bounds the op
+        for tr in small_cases:
+            ts = ray_tpu.get(
+                [r.timed_allreduce.remote(tr, SMALL_ELEMS) for r in ranks],
+                timeout=120)
+            small_samples[tr].append(max(ts))
         ts = ray_tpu.get(
             [r.timed_allreduce.remote("hub", 256) for r in ranks],
             timeout=120)
@@ -595,6 +612,22 @@ def _collective_bench(results: list[dict], nbytes: int = 16 * 1024 * 1024,
     results.append({"name": "collective_allreduce_hub_small",
                     "per_second": 1.0 / med, "sd": float(np.std(small)),
                     "trials": [round(t, 5) for t in small]})
+    # counter-verify the fused-kernel arm actually ran on the PALLAS
+    # tier (ops counted per rank: warm + one per window)
+    pallas_ops = ray_tpu.get([r.read_counter.remote(
+        "collective.pallas_ops_total") for r in ranks], timeout=60)
+    for tr in small_cases:
+        med = float(np.median(small_samples[tr]))
+        row = {"name": f"collective_allreduce_{tr}_small",
+               "per_second": 1.0 / med,
+               "payload_bytes": SMALL_ELEMS * 4,
+               "sd": float(np.std(small_samples[tr])),
+               "trials": [round(t, 5) for t in small_samples[tr]]}
+        if tr == "pallas":
+            row["pallas_ops_per_rank"] = float(np.mean(pallas_ops))
+        results.append(row)
+        print(f"collective_allreduce_{tr}_small (16KB decode-step) "
+              f"per second {1 / med:.1f} (median of {windows})")
     # counter-verify the quantized wire reduction: saved bytes per op
     # per rank vs the exact f32 wire the same schedule would have sent
     saved = ray_tpu.get([r.read_counter.remote(
@@ -1095,6 +1128,130 @@ def _serve_stream(results: list[dict], windows: int = 3,
     serve.shutdown()
 
 
+def _cold_gang_ttft(results: list[dict], pairs: int = 3):
+    """Serve gang restart TTFT, compile cache cold vs warm, PAIRED
+    (round 15): each pair clears the persistent AOT compile cache,
+    deploys a fresh streaming replica and times create_backend -> first
+    SSE token (the restart path a gang pays end-to-end: replica actor
+    spawn, engine build, kv-arena alloc, first decode-step dispatch),
+    then tears it down and repeats WITHOUT clearing — the second
+    replica's jax seams resolve against the executables the first one
+    stored. The warm arm's hit delta is counter-verified from the
+    shared on-disk index (the replica records hits into it), so the row
+    proves the cache engaged rather than assuming it."""
+    import http.client
+
+    import numpy as _np
+
+    from ray_tpu import serve
+    from ray_tpu._private import compile_cache as _cc
+    from ray_tpu.serve.engine import ShardedTokenLM
+    from ray_tpu.serve.streaming import iter_sse_lines
+
+    model = ShardedTokenLM.make(11, vocab=512, hidden=32, inner=64)
+    margs = (model.embed.copy(), model.w_up.copy(), model.w_out.copy())
+    client = serve.start(http=True)
+    port = client.http_port
+    seq = [0]
+
+    def _index_hits() -> int:
+        return sum(int(e.get("hits", 0))
+                   for e in _cc.read_index().values())
+
+    def restart_ttft() -> float:
+        """create_backend -> first streamed token, one fresh replica.
+        kv_backend=jax so the decode path runs the donated-arena jitted
+        update — the seam the persistent compile cache hooks (the numpy
+        default never compiles anything and the A/B would measure
+        nothing)."""
+        seq[0] += 1
+        name = f"bench_cg{seq[0]}"
+        t0 = time.perf_counter()
+        client.create_backend(
+            name, ShardedTokenLM, *margs,
+            config={"streaming": True, "max_decode_batch": 2,
+                    "max_waiting_sequences": 8, "kv_pages_total": 256,
+                    "kv_backend": "jax",
+                    "num_replicas": 1, "large_payload_threshold": 0})
+        client.create_endpoint(name, backend=name, route=f"/{name}",
+                               methods=["POST"])
+        ttft = None
+        deadline = time.time() + 120
+        while ttft is None and time.time() < deadline:
+            try:  # route table syncs asynchronously: retry until live
+                conn = http.client.HTTPConnection("127.0.0.1", port,
+                                                  timeout=15)
+                body = json.dumps({"prompt": [1, 3, 5], "max_tokens": 4,
+                                   "stream": True})
+                conn.request("POST", f"/{name}", body=body, headers={
+                    "Content-Type": "application/json",
+                    "Accept": "text/event-stream"})
+                resp = conn.getresponse()
+                if resp.status != 200:  # route not synced yet: a 404
+                    resp.read()         # body is NOT an SSE stream —
+                    conn.close()        # iterating it would block on
+                    time.sleep(0.1)     # the kept-alive socket
+                    continue
+                # drain to done (4 tokens): abandoning the stream early
+                # can wedge the proxy-side handler on the half-closed
+                # socket and stall the NEXT trial's request behind it
+                for ev, data in iter_sse_lines(resp.fp):
+                    if ev == "error":
+                        break
+                    if ttft is None and data.get("tokens"):
+                        ttft = time.perf_counter() - t0
+                    if ev == "done" or data.get("done"):
+                        break
+                conn.close()
+            except (http.client.HTTPException, OSError):
+                time.sleep(0.2)
+        client.delete_endpoint(name)
+        client.delete_backend(name)
+        # wait out the route-teardown sync so trial N+1 never races a
+        # stale route to the now-dead replica
+        gone = time.time() + 30
+        while time.time() < gone:
+            try:
+                conn = http.client.HTTPConnection("127.0.0.1", port,
+                                                  timeout=5)
+                conn.request("POST", f"/{name}",
+                             body=json.dumps({"prompt": [1]}),
+                             headers={"Content-Type": "application/json"})
+                status = conn.getresponse().status
+                conn.close()
+                if status == 404:
+                    break
+            except (http.client.HTTPException, OSError):
+                pass
+            time.sleep(0.1)
+        return ttft if ttft is not None else time.perf_counter() - t0
+
+    cold, warm, hit_deltas = [], [], []
+    for _ in range(pairs):
+        _cc.clear()
+        cold.append(restart_ttft())
+        h0 = _index_hits()
+        warm.append(restart_ttft())
+        hit_deltas.append(_index_hits() - h0)
+    cold_ms = float(_np.median(cold)) * 1000
+    warm_ms = float(_np.median(warm)) * 1000
+    results.append({
+        "name": "cold_gang_ttft",
+        "cold_ttft_ms": round(cold_ms, 1),
+        "warm_ttft_ms": round(warm_ms, 1),
+        "speedup_x": round(cold_ms / warm_ms, 3) if warm_ms else 0.0,
+        "warm_cache_hits_per_restart": float(_np.mean(hit_deltas)),
+        "pairs": pairs,
+        "cold_trials_ms": [round(t * 1000, 1) for t in cold],
+        "warm_trials_ms": [round(t * 1000, 1) for t in warm],
+    })
+    print(f"cold_gang_ttft: cold {cold_ms:.0f}ms vs warm {warm_ms:.0f}ms "
+          f"(x{cold_ms / max(warm_ms, 1e-9):.2f}, "
+          f"{float(_np.mean(hit_deltas)):.1f} cache hits/restart, "
+          f"median of {pairs} pairs)")
+    serve.shutdown()
+
+
 def _tracing_ab(results: list[dict]):
     """Distributed-tracing overhead A/B (the tier-1 microbench gate in
     test_observability reads these rows): tracing at the DEFAULT head
@@ -1421,6 +1578,7 @@ if __name__ == "__main__":
                   "serve_stream": _serve_stream,
                   "tracing": _tracing_ab, "state": _state_ab,
                   "collective": _collective_bench,
+                  "cold_gang": _cold_gang_ttft,
                   "placement_topology": _placement_topology}
         if args.only not in groups:
             parser.error(f"--only must be one of {sorted(groups)}")

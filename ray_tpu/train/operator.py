@@ -153,6 +153,7 @@ class TrainingOperator:
                 return loss, new_mstate, ravel_pytree(grads)[0]
 
             self._fused_step = jax.jit(fused, donate_argnums=(0, 1, 2))
+            self._fused_donate = (0, 1, 2)
             self._grad_step = jax.jit(grad_step)
         else:
             def fused(params, mstate, opt_state, batch):
@@ -167,6 +168,7 @@ class TrainingOperator:
                 return loss, mstate, ravel_pytree(grads)[0]
 
             self._fused_step = jax.jit(fused, donate_argnums=(0, 2))
+            self._fused_donate = (0, 2)
             self._grad_step = jax.jit(grad_step)
 
         def apply_step(params, opt_state, flat_grads):
@@ -175,6 +177,14 @@ class TrainingOperator:
             return jax.tree.map(lambda p, u: p + u, params, updates), opt_state
 
         self._apply_step = jax.jit(apply_step, donate_argnums=(0, 1))
+        # persistent AOT compile cache over the step seams: one
+        # CachedFunction per (step name, batch shape class), keyed
+        # additionally by a jaxpr hash of the USER computation
+        # (loss_fn/optimizer) so two models with identical shapes never
+        # share an executable. A restarted/elastically-resized worker
+        # whose shapes an earlier generation compiled loads instead of
+        # re-tracing — and records NO compile event.
+        self._step_cache = {}
 
         if self._eval_fn is not None:
             self._jit_eval = jax.jit(self._eval_fn)
@@ -216,30 +226,47 @@ class TrainingOperator:
             return multihost.shard_host_batch(batch, self._batch_sharding)
         return jax.device_put(batch, self._batch_sharding)
 
+    def _cached_step(self, name: str, shape_key: str, jitted, donate=()):
+        """The per-(step, shape-class) CachedFunction — compile
+        observability moves inside it: a persistent-cache HIT records no
+        compile event (jax.compiles_total stays flat on a warm restart),
+        a miss records exactly what CompileProbe.watch did before."""
+        from ray_tpu._private import compile_cache as _cc
+
+        key = (name, shape_key)
+        fn = self._step_cache.get(key)
+        if fn is None:
+            fn = self._step_cache[key] = _cc.CachedFunction(
+                "train.step", key, jitted, donate_argnums=donate,
+                record_key=f"train.step:{name}:{shape_key}",
+                fingerprint_computation=True)
+        return fn
+
     def _dispatch_batch(self, batch):
         """Run one step, returning the (possibly device-resident) loss."""
         shape_key = _profiling.shape_class(batch)
         if self._mesh is not None:
             # SPMD over the (global) mesh — no HOST allreduce.
             batch = self._place_batch(batch)
-            with self._compile_probe.watch("fused-mesh", shape_key):
-                self.params, self.model_state, self.opt_state, loss = (
-                    self._fused_step(self.params, self.model_state,
-                                     self.opt_state, batch))
+            step = self._cached_step("fused-mesh", shape_key,
+                                     self._fused_step, self._fused_donate)
+            self.params, self.model_state, self.opt_state, loss = step(
+                self.params, self.model_state, self.opt_state, batch)
             return loss
         if self.world_size == 1:
-            with self._compile_probe.watch("fused", shape_key):
-                self.params, self.model_state, self.opt_state, loss = (
-                    self._fused_step(self.params, self.model_state,
-                                     self.opt_state, batch))
+            step = self._cached_step("fused", shape_key,
+                                     self._fused_step, self._fused_donate)
+            self.params, self.model_state, self.opt_state, loss = step(
+                self.params, self.model_state, self.opt_state, batch)
             return loss
-        with self._compile_probe.watch("grad", shape_key):
-            loss, self.model_state, flat_grads = self._grad_step(
-                self.params, self.model_state, batch)
+        grad = self._cached_step("grad", shape_key, self._grad_step)
+        loss, self.model_state, flat_grads = grad(
+            self.params, self.model_state, batch)
         flat_grads = self._allreduce_grads(flat_grads)
-        with self._compile_probe.watch("apply", "flat"):
-            self.params, self.opt_state = self._apply_step(
-                self.params, self.opt_state, flat_grads)
+        apply = self._cached_step("apply", "flat", self._apply_step,
+                                  (0, 1))
+        self.params, self.opt_state = apply(
+            self.params, self.opt_state, flat_grads)
         return loss
 
     def train_epoch(self, num_steps: int | None = None,

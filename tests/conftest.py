@@ -26,6 +26,16 @@ os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 # driver exits never pay the sweep.
 os.environ.setdefault("RAY_TPU_FINAL_SNAPSHOT", "1")
 
+# Hermetic persistent compile cache: a warm cache left by an earlier run
+# would flip compile-count assertions (cache hits record NO compile), so
+# every test session gets a fresh dir. Tests that exercise warm restarts
+# point RAY_TPU_COMPILE_CACHE_DIR at their own tmp_path instead.
+if "RAY_TPU_COMPILE_CACHE_DIR" not in os.environ:
+    import tempfile as _tempfile
+
+    os.environ["RAY_TPU_COMPILE_CACHE_DIR"] = _tempfile.mkdtemp(
+        prefix="ray_tpu_cc_test_")
+
 # The plugin may already be registered in THIS interpreter (sitecustomize
 # runs before conftest); forcing the config keeps jax from ever
 # initializing it.
@@ -300,6 +310,25 @@ def leak_check(request):
     assert not leaked_segs, (
         f"test leaked /dev/shm collective segment(s) (now removed): "
         f"{sorted(leaked_segs)}{notes}")
+    # compile-cache hygiene: a .ctmp-* file in the cache dir means a
+    # writer died between mkstemp and os.replace — name it so the
+    # failure reads as the torn cache write it is
+    from ray_tpu._private import compile_cache as _cc
+
+    try:
+        stray = [os.path.join(_cc.cache_dir(), f)
+                 for f in os.listdir(_cc.cache_dir())
+                 if f.startswith(_cc.TMP_PREFIX)]
+    except FileNotFoundError:
+        stray = []
+    for path in stray:
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+    assert not stray, (
+        f"test leaked compile-cache temp file(s) (now removed — a "
+        f"cache writer died mid-store): {sorted(stray)}")
     # continuous-profiler hygiene: with no cluster held, this process
     # must not keep a sampler thread alive (ray_tpu.shutdown stops it;
     # a test that armed one directly must stop it too). Named so the

@@ -118,6 +118,7 @@ class TransportWorker:
             arr = jnp.asarray(arr)
         out = group.allreduce(arr)
         return {"device_built": group._device is not None,
+                "pallas_built": group._pallas is not None,
                 "out_on_device": not isinstance(out, np.ndarray),
                 "val": float(np.asarray(out)[0]),
                 "shm": group._shm is not None}
@@ -164,6 +165,29 @@ class TransportWorker:
                         r.tobytes(), np.dtype(r.dtype).str, tuple(r.shape))
         group.force_transport = None
         return out
+
+    def pallas_vote_probe(self, veto, derived):
+        """Forced/derived PALLAS pin with an optional rank-local veto:
+        reports whether the routing layer raised (forced pin), demoted
+        (derived pin), or ran the op — every rank must call this
+        together (the vote is a collective ctl round)."""
+        group = self._group()
+        if veto:
+            group._pallas_disabled = True
+        group.force_transport = "pallas"
+        group._transport_derived = derived
+        arr = np.ones(1024, np.float32)
+        try:
+            out = group.allreduce(arr)
+            return {"raised": None, "val": float(np.asarray(out)[0]),
+                    "derived_after": group._transport_derived,
+                    "forced_after": group.force_transport}
+        except RuntimeError as e:
+            return {"raised": str(e)}
+        finally:
+            group._pallas_disabled = False
+            group.force_transport = None
+            group._transport_derived = False
 
     def read_counter(self, name):
         from ray_tpu._private import stats
@@ -256,8 +280,11 @@ def test_transport_exactness_matrix(device_workers):
     float64 result for integer inputs) across an odd world size and a
     non-divisible tensor length. (5-tier extension of the PR 2 matrix:
     the workers share one jax.distributed runtime, so 'device' is
-    forcible and runs the same payloads over the XLA plane.)"""
-    transports = ["hub", "shm", "ring", "ring_unpipelined", "device"]
+    forcible and runs the same payloads over the XLA plane; 'pallas'
+    runs the fused-kernel tier in interpret mode over the same
+    runtime — the 6th tier must agree bitwise with the other 5.)"""
+    transports = ["hub", "shm", "ring", "ring_unpipelined", "device",
+                  "pallas"]
     workers = device_workers
     outs = ray_tpu.get(
         [w.run_matrix.remote(transports, 10_007) for w in workers],
@@ -393,9 +420,20 @@ def test_device_tier_auto_routing_and_fallback(device_workers):
     falls back to the host tiers together (same result, no hang)."""
     workers = device_workers
     _extra_group(workers, "g_devroute")
-    # all ranks hold jax arrays -> device tier, result stays on device
+    # all ranks hold SMALL jax arrays -> the PALLAS fused-kernel tier
+    # (the refinement of the device plane for ops under
+    # pallas_max_bytes) engages on a unanimous vote; result stays on
+    # device
     probes = ray_tpu.get(
         [w.probe_device.remote(True) for w in workers],
+        timeout=scale_timeout(120))
+    for p in probes:
+        assert p["pallas_built"], probes
+        assert p["out_on_device"], probes
+        assert p["val"] == float(WORLD)
+    # LARGE jax arrays fall through the size gate to the DEVICE tier
+    probes = ray_tpu.get(
+        [w.probe_device.remote(True, n=1 << 18) for w in workers],
         timeout=scale_timeout(120))
     for p in probes:
         assert p["device_built"], probes
@@ -417,6 +455,98 @@ def test_device_tier_auto_routing_and_fallback(device_workers):
     ray_tpu.get([w.destroy_group.remote() for w in workers], timeout=60)
 
 
+def test_pallas_forced_unavailable_raises_derived_demotes(device_workers):
+    """The PALLAS vote's two non-unanimous outcomes: a USER-forced pin
+    raises the typed unavailability error on every rank (the vote
+    result is an allgather, so the decision is group-uniform); a
+    placement-DERIVED pin demotes to auto routing in unison and the op
+    still completes on the host tiers."""
+    workers = device_workers
+    _extra_group(workers, "g_pallas_vote")
+    # a clean forced pin first: unanimous vote, op runs on the kernel
+    # tier (numpy payload — forced short-circuits the placement check)
+    probes = ray_tpu.get(
+        [w.pallas_vote_probe.remote(False, False) for w in workers],
+        timeout=scale_timeout(120))
+    for p in probes:
+        assert p["raised"] is None, probes
+        assert p["val"] == float(WORLD)
+    # rank 0 vetoes (kernel tier disabled locally): forced pin -> every
+    # rank raises the same typed error instead of hanging or diverging
+    probes = ray_tpu.get(
+        [w.pallas_vote_probe.remote(i == 0, False)
+         for i, w in enumerate(workers)], timeout=scale_timeout(120))
+    for p in probes:
+        assert p["raised"] is not None, probes
+        assert "forced collective transport 'pallas' is unavailable" \
+            in p["raised"], p
+    # same veto under a DERIVED pin: no raise — all ranks demote to
+    # auto routing together and the allreduce completes host-side
+    probes = ray_tpu.get(
+        [w.pallas_vote_probe.remote(i == 0, True)
+         for i, w in enumerate(workers)], timeout=scale_timeout(120))
+    for p in probes:
+        assert p["raised"] is None, probes
+        assert p["val"] == float(WORLD)
+        assert p["derived_after"] is False, probes
+        assert p["forced_after"] is None, probes
+    ray_tpu.get([w.destroy_group.remote() for w in workers], timeout=60)
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("nth", [1, 2])
+def test_pallas_rank_death_aborts_not_hangs(ray_start_shared, nth):
+    """Seeded chaos (satellite): a rank hard-killed at the
+    collective.pallas_dispatch seam (mid-pallas-op, before the
+    agreement round) leaves every survivor with a typed TimeoutError
+    within the group timeout — abort-not-hang for the kernel tier."""
+    timeout = scale_timeout(8)
+    workers = _make_group(4, f"g_fault_pallas{nth}", timeout=timeout,
+                          multihost_name=f"pallasfault{nth}")
+    # small payloads route the kernel tier; warm it end to end first
+    assert all(ray_tpu.get(
+        [w.warm.remote("pallas", nbytes=1 << 14) for w in workers],
+        timeout=scale_timeout(240)))
+    # rank 0 hosts the jax.distributed coordinator (same failure-domain
+    # carve-out as the device-tier chaos case): kill a client rank
+    victim_idx = 2
+    ray_tpu.get(workers[victim_idx].arm_failpoint.remote(
+        "collective.pallas_dispatch", "exit", nth=nth), timeout=30)
+    t0 = time.monotonic()
+    outs = []
+    for _ in range(nth + 1):
+        refs = [w.timed_allreduce.remote("pallas", 1 << 14)
+                for w in workers]
+        outs = []
+        for r in refs:
+            try:
+                outs.append(ray_tpu.get(r, timeout=scale_timeout(120)))
+            except Exception:  # the victim dies mid-call
+                outs.append({"ok": False, "elapsed": 0.0, "died": True})
+        if not all(o["ok"] for o in outs):
+            break
+    wall = time.monotonic() - t0
+    survivors = [o for i, o in enumerate(outs) if i != victim_idx]
+    assert all(not o["ok"] for o in survivors), (nth, outs)
+    for out in survivors:
+        assert out["elapsed"] < timeout * 3 + 5, out
+    assert wall < timeout * 8 + 20
+    # host tiers still serve the survivors at the surviving size
+    keep = [w for i, w in enumerate(workers) if i != victim_idx]
+    ray_tpu.get([w.destroy_group.remote() for w in keep],
+                timeout=scale_timeout(60))
+    ray_tpu.get([w.init_group.remote(3, i, f"g_fault_pallas{nth}_r", 30.0)
+                 for i, w in enumerate(keep)],
+                timeout=scale_timeout(60))
+    res = ray_tpu.get(
+        [w.timed_allreduce.remote("ring", 1 << 20) for w in keep],
+        timeout=scale_timeout(90))
+    assert all(r["ok"] for r in res), res
+    ray_tpu.get([w.destroy_group.remote() for w in keep], timeout=60)
+    for w in keep:
+        ray_tpu.kill(w)
+
+
 def _quant_bound(w, amax, op, dtype):
     """Analytic block-scaling bound: every output element is touched by
     at most w quantization steps (w-1 reduce hops + 1 gather quantize),
@@ -434,12 +564,13 @@ def _quant_bound(w, amax, op, dtype):
     return bound * 1.001 + 1e-7
 
 
-@pytest.mark.parametrize("transport", ["ring", "device"])
+@pytest.mark.parametrize("transport", ["ring", "device", "pallas"])
 def test_quantized_error_bound_matrix(device_workers, transport):
-    """quantize="int8" on the pipelined ring and the device tier: the
-    lossy result stays within the analytic block-scaling bound for
-    every dtype x op, all ranks agree bitwise on the lossy result, and
-    quantize=None stays bit-exact vs the hub."""
+    """quantize="int8" on the pipelined ring, the device tier, and the
+    fused pallas kernel: the lossy result stays within the analytic
+    block-scaling bound for every dtype x op, all ranks agree bitwise
+    on the lossy result, and quantize=None stays bit-exact vs the
+    hub."""
     workers = device_workers
     _extra_group(workers, f"g_q_{transport}")
     w = WORLD
@@ -545,7 +676,8 @@ def test_mean_product_parity_across_tiers(device_workers):
     (float64 accumulate + float64 result for integer inputs)."""
     workers = device_workers
     _extra_group(workers, "g_parity")
-    transports = ["hub", "shm", "ring", "ring_unpipelined", "device"]
+    transports = ["hub", "shm", "ring", "ring_unpipelined", "device",
+                  "pallas"]
     outs = ray_tpu.get(
         [w.parity_matrix.remote(transports, 4_099) for w in workers],
         timeout=scale_timeout(300))

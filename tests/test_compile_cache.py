@@ -1,0 +1,265 @@
+"""Persistent AOT compile cache: executables survive process death.
+
+Covers the key schema (runtime fingerprint + seam parts; quantize mode
+never shares an executable — the satellite regression), blob/index
+storage round-trips, the CachedFunction resolution contract (hit:
+deserialized `jax.export` blob, jax.compiles_total stays FLAT; miss:
+export + store + normal compile recording), the gang-restart gate
+(warm restart records >=1 hit and strictly fewer compiles than the
+cold start), the `compile_cache.load` failpoint degrading to a
+re-trace (errors counter, op still serves), and the recorded
+MICROBENCH cold_gang_ttft row."""
+
+import json
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from tests.conftest import scale_timeout
+
+from ray_tpu._private import compile_cache as _cc
+
+WORLD = 3
+
+
+# ---------------------------------------------------------------------------
+# unit layer: keys, fingerprint, storage
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def cache_sandbox(monkeypatch):
+    """A private cache dir per test: the session-wide dir (conftest)
+    is shared by every spawned worker, so key-collision assertions
+    need their own floor."""
+    d = tempfile.mkdtemp(prefix="ray_tpu_cc_unit_")
+    monkeypatch.setenv("RAY_TPU_COMPILE_CACHE_DIR", d)
+    yield d
+
+
+def test_make_key_stable_and_fingerprint_sensitive(cache_sandbox,
+                                                   monkeypatch):
+    """Same (seam, parts) -> same key; any part, the seam, or the
+    runtime fingerprint changing -> a different key (a blob compiled
+    for another runtime must never load)."""
+    k1 = _cc.make_key("collective", ("ar", "exact", "sum", "f32", 1024))
+    assert k1 == _cc.make_key("collective",
+                              ("ar", "exact", "sum", "f32", 1024))
+    assert k1 != _cc.make_key("collective",
+                              ("ar", "exact", "max", "f32", 1024))
+    assert k1 != _cc.make_key("train.step",
+                              ("ar", "exact", "sum", "f32", 1024))
+    # fingerprint sensitivity: a different runtime is a clean miss
+    real = _cc.runtime_fingerprint()
+    monkeypatch.setattr(_cc, "_fingerprint", real + "|other-jaxlib")
+    assert k1 != _cc.make_key("collective",
+                              ("ar", "exact", "sum", "f32", 1024))
+
+
+def test_store_lookup_index_clear_round_trip(cache_sandbox):
+    key = _cc.make_key("unit", ("blob", 1))
+    assert _cc.lookup(key) is None  # absent: no error counted
+    assert _cc.store(key, b"\x01\x02\x03", seam="unit",
+                     parts=("blob", 1))
+    assert _cc.lookup(key) == b"\x01\x02\x03"
+    index = _cc.read_index()
+    assert key in index
+    assert index[key]["seam"] == "unit"
+    assert index[key]["parts"] == ["blob", "1"]
+    assert index[key]["size"] == 3
+    _cc.record_hit(key)
+    assert _cc.read_index()[key]["hits"] == 1
+    # no stray temp files after a clean writer
+    strays = [n for n in os.listdir(cache_sandbox)
+              if n.startswith(_cc.TMP_PREFIX)]
+    assert not strays, strays
+    assert _cc.clear() == 1
+    assert _cc.lookup(key) is None
+    assert _cc.read_index() == {}
+
+
+def test_disabled_cache_never_touches_disk(cache_sandbox, monkeypatch):
+    monkeypatch.setenv("RAY_TPU_COMPILE_CACHE", "0")
+    key = _cc.make_key("unit", ("off",))
+    assert not _cc.store(key, b"x")
+    assert _cc.lookup(key) is None
+    assert not os.path.exists(os.path.join(cache_sandbox,
+                                           key + ".jaxexp"))
+
+
+def test_quantize_modes_never_share_executable(cache_sandbox):
+    """Satellite regression: two collective ops differing ONLY in
+    quantize mode resolve to different in-process jit-cache keys AND
+    different persistent-cache entries (an int8-ring executable loaded
+    for an exact op would silently corrupt results)."""
+    import jax
+    from jax.sharding import Mesh
+
+    from ray_tpu.collective.backends.xla_backend import _DeviceOps
+    from ray_tpu.collective.types import QUANT_BLOCK, ReduceOp
+
+    mesh = Mesh(np.array(jax.devices("cpu")[:1]), ("hosts",))
+    ops = _DeviceOps(mesh, "hosts", 1)
+    n = QUANT_BLOCK * 2  # valid layout for both the exact + int8 rings
+    garr = jax.numpy.ones((1, n), jax.numpy.float32)
+    ops.allreduce(garr, ReduceOp.SUM)
+    ops.allreduce_quantized(garr, ReduceOp.SUM)
+    keys = list(ops._cache.keys())
+    assert len(keys) == 2
+    # the jit-cache keys differ in their op-kind/quantize prefix...
+    assert keys[0][0] != keys[1][0], keys
+    # ...and so do the PERSISTENT entries: one blob per mode on disk
+    index = _cc.read_index()
+    assert len(index) == 2, index
+    seams = {tuple(e["parts"]) for e in index.values()}
+    assert len(seams) == 2, index
+
+
+# ---------------------------------------------------------------------------
+# gang layer: restart round-trip + failpoint chaos
+# ---------------------------------------------------------------------------
+
+
+@ray_tpu.remote
+class CacheWorker:
+    def setup(self, world, rank, group_name, multihost_name,
+              failpoint=None):
+        if failpoint:  # armed BEFORE any cache access in this process
+            from ray_tpu._private import failpoints
+
+            failpoints.arm(failpoint, "raise")
+        from ray_tpu import collective as col
+        from ray_tpu.parallel import multihost
+
+        multihost.initialize(multihost_name, world, rank)
+        col.init_collective_group(world, rank, backend="host",
+                                  group_name=group_name, timeout=60.0)
+        self.group_name = group_name
+        return True
+
+    def warm_and_stats(self, n):
+        """One forced-DEVICE allreduce (the persistent-cached seam),
+        then this process's compile/cache counters."""
+        from ray_tpu._private import stats
+        from ray_tpu.collective import collective as C
+
+        group = C._manager.get_group(self.group_name)
+        group.force_transport = "device"
+        out = group.allreduce(np.ones(n, np.float32))
+        group.force_transport = None
+        snap = stats.snapshot()
+
+        def val(name):
+            s = snap.get(name)
+            return float(s["value"]) if s else 0.0
+
+        return {"val": float(np.asarray(out)[0]),
+                "compiles": val("jax.compiles_total"),
+                "hits": val("jax.compile_cache_hits_total"),
+                "misses": val("jax.compile_cache_misses_total"),
+                "errors": val("jax.compile_cache_errors_total")}
+
+    def destroy_group(self):
+        from ray_tpu import collective as col
+
+        col.destroy_collective_group(self.group_name)
+        return True
+
+
+def _gang(tag, failpoint=None):
+    workers = [CacheWorker.remote() for _ in range(WORLD)]
+    ray_tpu.get([w.setup.remote(WORLD, i, f"g_cc_{tag}", f"cc{tag}",
+                                failpoint)
+                 for i, w in enumerate(workers)],
+                timeout=scale_timeout(240))
+    return workers
+
+
+def _teardown(workers):
+    ray_tpu.get([w.destroy_group.remote() for w in workers], timeout=60)
+    for w in workers:
+        ray_tpu.kill(w)
+
+
+def test_gang_restart_hits_cache_and_skips_compiles(ray_start_shared,
+                                                    monkeypatch):
+    """THE acceptance gate: a cold gang populates the cache (misses +
+    compiles recorded); the gang is killed; a restarted gang running
+    the SAME shape-classes records >=1 cache hit per rank, ZERO new
+    `jax.compiles_total` for the cached seam, and strictly fewer
+    compiles than the cold start."""
+    monkeypatch.setenv("RAY_TPU_COMPILE_CACHE_DIR",
+                       tempfile.mkdtemp(prefix="ray_tpu_cc_gang_"))
+    n = 1 << 16  # 256KB: above pallas_max_bytes, squarely device-tier
+    cold = _gang("cold")
+    stats_a = ray_tpu.get([w.warm_and_stats.remote(n) for w in cold],
+                          timeout=scale_timeout(240))
+    for s in stats_a:
+        assert s["val"] == float(WORLD)
+        assert s["compiles"] >= 1, stats_a  # cold gang traced
+        assert s["misses"] >= 1, stats_a  # ...and populated the cache
+        assert s["hits"] == 0, stats_a
+    _teardown(cold)  # kill the gang: executables outlive the processes
+
+    warm = _gang("warm")
+    stats_b = ray_tpu.get([w.warm_and_stats.remote(n) for w in warm],
+                          timeout=scale_timeout(240))
+    for a, b in zip(stats_a, stats_b):
+        assert b["val"] == float(WORLD)
+        assert b["hits"] >= 1, stats_b  # restart deserialized the blob
+        # zero new compiles for the cached shape-class: the seam's
+        # record_compile never ran, so the counter stayed FLAT
+        assert b["compiles"] == 0, stats_b
+        assert b["compiles"] < a["compiles"], (stats_a, stats_b)
+        assert b["errors"] == 0, stats_b
+    _teardown(warm)
+
+
+def test_cache_load_failpoint_degrades_to_retrace(ray_start_shared,
+                                                  monkeypatch):
+    """Chaos satellite: `compile_cache.load` raising during a gang
+    restart must NOT fail the op — every rank re-traces (compiles
+    recorded), serves the collective, and counts the typed
+    `jax.compile_cache_errors_total`."""
+    monkeypatch.setenv("RAY_TPU_COMPILE_CACHE_DIR",
+                       tempfile.mkdtemp(prefix="ray_tpu_cc_fp_"))
+    n = 1 << 16
+    cold = _gang("fpcold")
+    ray_tpu.get([w.warm_and_stats.remote(n) for w in cold],
+                timeout=scale_timeout(240))
+    _teardown(cold)
+
+    broken = _gang("fpwarm", failpoint="compile_cache.load")
+    stats_c = ray_tpu.get([w.warm_and_stats.remote(n) for w in broken],
+                          timeout=scale_timeout(240))
+    for s in stats_c:
+        assert s["val"] == float(WORLD)  # the gang still serves
+        assert s["errors"] >= 1, stats_c  # typed counter moved
+        assert s["hits"] == 0, stats_c
+        assert s["compiles"] >= 1, stats_c  # degraded to a re-trace
+    _teardown(broken)
+
+
+# ---------------------------------------------------------------------------
+# recorded-benchmark gate
+# ---------------------------------------------------------------------------
+
+
+def test_microbench_cold_gang_ttft_row():
+    """Gate on the recorded cold/warm restart A/B (reads
+    MICROBENCH.json — deterministic, no benchmarking in CI): the row
+    must exist, the warm restart must have recorded cache hits, and
+    warm TTFT must not regress past the cold path."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    doc = json.load(open(os.path.join(root, "MICROBENCH.json")))
+    rows = {r["name"]: r for r in doc["results"]}
+    assert "cold_gang_ttft" in rows, "missing cold_gang_ttft row"
+    row = rows["cold_gang_ttft"]
+    assert row["warm_cache_hits_per_restart"] >= 1, row
+    assert row["warm_ttft_ms"] > 0 and row["cold_ttft_ms"] > 0, row
+    # the cache may not always buy a big win on a CPU rig, but a warm
+    # restart re-tracing MORE than cold means the plane regressed
+    assert row["warm_ttft_ms"] <= row["cold_ttft_ms"] * 1.25, row

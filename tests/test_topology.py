@@ -244,6 +244,22 @@ def test_transport_plan_device_needs_live_tpu_backend():
     # to ring/hub rather than promising a tier the group cannot build
     cs = [coord(coords=(0, i), host=f"h{i}") for i in range(3)]
     rec = _pg_record([b"n1", b"n2", b"n3"], cs, tpu=4.0)
-    assert topo.transport_plan(rec)["transport"] in ("ring", "device")
+    assert topo.transport_plan(rec)["transport"] in (
+        "ring", "device", "pallas")
     if not topo._tpu_backend_live():
         assert topo.transport_plan(rec)["transport"] == "ring"
+
+
+def test_transport_plan_pallas_derive_opt_in(monkeypatch):
+    # with the env opt-in AND a live TPU backend, the device branch of
+    # the ladder derives the fused-kernel tier instead; without the env
+    # it never does, whatever the backend
+    cs = [coord(coords=(0, i), host=f"h{i}") for i in range(3)]
+    rec = _pg_record([b"n1", b"n2", b"n3"], cs, tpu=4.0)
+    monkeypatch.delenv("RAY_TPU_PALLAS_DERIVE", raising=False)
+    assert topo.transport_plan(rec)["transport"] != "pallas"
+    monkeypatch.setenv("RAY_TPU_PALLAS_DERIVE", "1")
+    monkeypatch.setattr(topo, "_tpu_backend_live", lambda: True)
+    assert topo.transport_plan(rec)["transport"] == "pallas"
+    monkeypatch.setattr(topo, "_tpu_backend_live", lambda: False)
+    assert topo.transport_plan(rec)["transport"] == "ring"
