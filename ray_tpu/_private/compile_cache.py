@@ -44,6 +44,7 @@ touching the cache host's disk.
 
 from __future__ import annotations
 
+import contextlib
 import hashlib
 import json
 import os
@@ -52,6 +53,13 @@ import threading
 import time
 
 from ray_tpu._private import stats as _stats
+
+# entries created before this moment predate the process: the doctor's
+# compile_cache_cold finding keys off entries_preexisting, never off
+# blobs this very process stored on its own first-ever misses (store()
+# lives in this module, so any self-stored entry is created after this
+# import ran)
+_PROCESS_START = time.time()
 
 M_HITS = _stats.Count(
     "jax.compile_cache_hits_total",
@@ -97,11 +105,15 @@ def cache_dir() -> str:
 def runtime_fingerprint() -> str:
     """Every runtime fact a serialized executable depends on. Computed
     lazily (jax may not be imported in pure-host processes) and cached
-    per process — the facts it reads are process-constant."""
+    per process — but ONLY once the backend facts resolved: a key built
+    before jax initialization must not pin 'uninit'/'nojax' for the
+    process's whole life, or differently-topologized processes collide
+    on keys after their backends come up."""
     global _fingerprint
     if _fingerprint is not None:
         return _fingerprint
     parts = []
+    complete = True
     try:
         import jax
 
@@ -119,6 +131,7 @@ def runtime_fingerprint() -> str:
             parts.append(str(jax.process_count()))
         except Exception:
             parts.append("uninit")
+            complete = False
         try:  # TPU boxes: the libtpu build changes lowering
             import libtpu  # type: ignore
 
@@ -127,8 +140,11 @@ def runtime_fingerprint() -> str:
             pass
     except Exception:
         parts.append("nojax")
-    _fingerprint = "|".join(parts)
-    return _fingerprint
+        complete = False
+    fp = "|".join(parts)
+    if complete:
+        _fingerprint = fp
+    return fp
 
 
 _fingerprint: str | None = None
@@ -187,8 +203,41 @@ def _write_index(index: dict) -> None:
         pass  # no GCS (unit test / pure-local): disk is authoritative
 
 
-def _index_update(key: str, **fields) -> None:
+@contextlib.contextmanager
+def _index_lock():
+    """Thread lock + OS file lock around the index read-modify-write:
+    the cache dir is shared by every rank on the host (the normal
+    multi-rank-per-host case), so an in-process lock alone loses index
+    entries and hit counts to last-writer-wins races across processes.
+    Degrades to thread-only locking where flock is unavailable."""
     with _lock:
+        lockf = None
+        try:
+            import fcntl
+
+            d = cache_dir()
+            os.makedirs(d, exist_ok=True)
+            lockf = open(os.path.join(d, INDEX_NAME + ".lock"), "a")
+            fcntl.flock(lockf, fcntl.LOCK_EX)
+        except Exception:
+            if lockf is not None:
+                lockf.close()
+                lockf = None
+        try:
+            yield
+        finally:
+            if lockf is not None:
+                try:
+                    import fcntl
+
+                    fcntl.flock(lockf, fcntl.LOCK_UN)
+                except Exception:
+                    pass
+                lockf.close()
+
+
+def _index_update(key: str, **fields) -> None:
+    with _index_lock():
         index = _read_index()
         entry = index.setdefault(key, {"hits": 0})
         entry.update(fields)
@@ -268,7 +317,7 @@ def store(key: str, blob: bytes, seam: str = "", parts=()) -> bool:
 
 def record_hit(key: str) -> None:
     try:
-        with _lock:
+        with _index_lock():
             index = _read_index()
             if key in index:
                 index[key]["hits"] = int(index[key].get("hits", 0)) + 1
@@ -282,7 +331,7 @@ def clear() -> int:
     number of entries removed. The CLI's --clear."""
     d = cache_dir()
     n = 0
-    with _lock:
+    with _index_lock():
         try:
             for name in os.listdir(d):
                 if name.endswith(".jaxexp") or name == INDEX_NAME \
@@ -306,12 +355,22 @@ def clear() -> int:
 
 def state() -> dict:
     """Cache-plane summary for debug_state snapshots and the doctor's
-    cold-restart finding."""
+    cold-restart finding. `entries_preexisting` counts only entries
+    created BEFORE this process started — the index also holds blobs
+    this very process stored on its own misses, and a first-ever cold
+    process (misses>0, hits==0, entries>0) must not read as 'restart
+    re-traced despite a warm cache'."""
     index = _read_index()
+    preexisting = sum(
+        1 for e in index.values()
+        if isinstance(e, dict)
+        and float(e.get("created") or 0.0) > 0.0
+        and float(e["created"]) < _PROCESS_START)
     return {
         "enabled": enabled(),
         "dir": cache_dir(),
         "entries": len(index),
+        "entries_preexisting": preexisting,
         "hits": int(M_HITS.snapshot()["value"]),
         "misses": int(M_MISSES.snapshot()["value"]),
         "errors": int(M_ERRORS.snapshot()["value"]),
@@ -333,6 +392,10 @@ class CachedFunction:
       call-site property the serialized module does not carry), count a
       hit + load seconds, and DO NOT record a compile — the whole point
       is that `jax.compiles_total` stays flat on a warm restart.
+      Donating seams AOT-compile the deserialized module BEFORE the
+      first dispatch: executing a donated jit consumes its input
+      buffers, so a stale/incompatible blob must fail while re-trace
+      is still possible, not after the inputs are gone.
     * miss — export + store FIRST (executing a donated jit consumes its
       input buffers; exporting only traces), then dispatch the normal
       jitted function and record the compile exactly as the seam did
@@ -390,6 +453,7 @@ class CachedFunction:
         blob = lookup(key)
         if blob is not None:
             t0 = time.time()
+            fn = None
             try:
                 import jax
                 from jax import export as _export
@@ -397,18 +461,37 @@ class CachedFunction:
                 exported = _export.deserialize(bytearray(blob))
                 fn = jax.jit(exported.call,
                              donate_argnums=self.donate_argnums)
-                out = fn(*args)
+                if self.donate_argnums:
+                    # dispatching a donated jit consumes the input
+                    # buffers — AOT-compile the deserialized module
+                    # first so a stale/corrupt/incompatible blob fails
+                    # HERE, with the inputs intact and the re-trace
+                    # fallback below still possible
+                    fn = fn.lower(*args).compile()
             except Exception:
                 # a stale/corrupt/incompatible blob: typed error count,
                 # then the normal trace path — never user-visible
                 M_ERRORS.inc()
-            else:
-                self._fn = fn
-                self.resolved = "hit"
-                M_HITS.inc()
-                M_LOAD_S.observe(time.time() - t0)
-                record_hit(key)
-                return out
+                fn = None
+            if fn is not None:
+                try:
+                    out = fn(*args)
+                except Exception:
+                    M_ERRORS.inc()
+                    if self.donate_argnums:
+                        # the executable compiled but failed at RUN
+                        # time with the inputs already donated; the
+                        # fallback would dispatch on deleted buffers —
+                        # surface the real execution error instead
+                        raise
+                    fn = None
+                else:
+                    self._fn = fn
+                    self.resolved = "hit"
+                    M_HITS.inc()
+                    M_LOAD_S.observe(time.time() - t0)
+                    record_hit(key)
+                    return out
         M_MISSES.inc()
         self.resolved = "miss"
         try:

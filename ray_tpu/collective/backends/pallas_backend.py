@@ -14,14 +14,26 @@ Kernel schedule (w ranks, per-rank flat payload split into w chunks of
 C elements):
 
   reduce-scatter: acc := own chunk; for s in 1..w-1:
-      [quantize acc ->] DMA to right neighbor's comm slot (double
-      buffered) -> wait -> acc := combine(recv [dequantized], chunk
+      [quantize acc ->] stage in a write-once send slot -> DMA to the
+      right neighbor's recv slot for THIS hop -> wait on that slot's
+      recv semaphore -> acc := combine(recv [dequantized], chunk
       (rank - s) mod w).  After w-1 hops rank r holds the reduced
       chunk (r+1) mod w (delta=0 schedule, same as the DEVICE qring).
   relay-gather: [quantize acc ONCE ->] w-1 relay hops forwarding the
       SAME bytes, every rank writes the received chunk into its output
       row — so in the quantized arm all ranks dequantize identical
       data and outputs agree bitwise across ranks.
+
+Comm-slot discipline: every hop sends from one slot and receives into
+a DIFFERENT slot, and no slot is written twice within one kernel
+invocation (recv slot == hop index; staged sends are write-once).
+A single slot serving as both DMA src and dst — or a 2-slot double
+buffer reused across hops — races on real hardware: hop-lockstep is
+enforced only by each rank's own recv wait, so an upstream neighbor
+can run several hops ahead and its inbound DMA would overwrite bytes
+the local outbound send engine is still reading. Unique slots make
+that impossible by construction (the payloads here are small — this
+is the latency tier — so O(world) slots of chunk size are cheap).
 
 Neighbor ids ride scalar prefetch (`PrefetchScalarGridSpec`): the ring
 position comes from `jax.lax.axis_index` OUTSIDE the kernel — a traced
@@ -114,61 +126,77 @@ def _ring_ids(axis: str, world: int):
     return jnp.stack([me, (me + 1) % world])
 
 
-def _remote_copy(buf, slot, sem_s, sem_r, right):
+def _remote_copy(src_buf, src_slot, dst_buf, dst_slot, sem_s, sem_r,
+                 right):
+    """One ring hop: send src_buf[src_slot] into the right neighbor's
+    dst_buf[dst_slot]. Src and dst are ALWAYS distinct slots and the
+    semaphores are indexed by the dst slot, so `.wait()` waits on the
+    recv semaphore of the slot the inbound DMA actually wrote."""
     from jax.experimental.pallas import tpu as pltpu
 
     return pltpu.make_async_remote_copy(
-        src_ref=buf.at[slot], dst_ref=buf.at[slot],
-        send_sem=sem_s.at[slot], recv_sem=sem_r.at[slot],
+        src_ref=src_buf.at[src_slot], dst_ref=dst_buf.at[dst_slot],
+        send_sem=sem_s.at[dst_slot], recv_sem=sem_r.at[dst_slot],
         device_id=right, device_id_type=pltpu.DeviceIdType.LOGICAL)
 
 
 def _make_allreduce_kernel(world: int, chunk: int, combine: str):
     """Fused exact ring allreduce: reduce-scatter + relay-gather, w-1
-    hops each, double-buffered comm slots."""
+    hops each. Sends are staged in write-once slots (`stage`), every
+    hop receives into its own dedicated slot (`rbuf[hop]`) — no slot
+    is reused, so no inbound DMA can overwrite bytes an outbound send
+    is still reading."""
     import jax.experimental.pallas as pl
 
     cmb = _COMBINE_FNS[combine]
 
-    def kernel(ids_ref, x_ref, o_ref, comm, sem_s, sem_r):
+    def kernel(ids_ref, x_ref, o_ref, stage, rbuf, sem_s, sem_r):
         my, right = ids_ref[0], ids_ref[1]
         acc = x_ref[0, pl.ds(my * chunk, chunk)]
         for s in range(1, world):
-            slot = (s - 1) % 2
-            comm[slot] = acc
-            rdma = _remote_copy(comm, slot, sem_s, sem_r, right)
+            hop = s - 1
+            stage[hop] = acc
+            rdma = _remote_copy(stage, hop, rbuf, hop, sem_s, sem_r,
+                                right)
             rdma.start()
             rdma.wait()
-            acc = cmb(comm[slot],
+            acc = cmb(rbuf[hop],
                       x_ref[0, pl.ds(((my - s) % world) * chunk, chunk)])
         o_ref[0, pl.ds(((my + 1) % world) * chunk, chunk)] = acc
+        stage[world - 1] = acc
         for s in range(1, world):
-            slot = (s - 1) % 2
-            comm[slot] = acc if s == 1 else comm[(s - 2) % 2]
-            rdma = _remote_copy(comm, slot, sem_s, sem_r, right)
+            hop = world - 1 + (s - 1)
+            # hop 1 relays the staged reduced chunk; later hops relay
+            # the previous hop's recv slot (written once, final)
+            src_buf, src_slot = ((stage, world - 1) if s == 1
+                                 else (rbuf, hop - 1))
+            rdma = _remote_copy(src_buf, src_slot, rbuf, hop, sem_s,
+                                sem_r, right)
             rdma.start()
             rdma.wait()
             o_ref[0, pl.ds(((my - s + 1) % world) * chunk, chunk)] = \
-                comm[slot]
+                rbuf[hop]
 
     return kernel
 
 
 def _make_reducescatter_kernel(world: int, chunk: int):
     """Reduce-scatter phase only (SUM), delta=-1 schedule so rank r
-    finishes holding reduced chunk r (psum_scatter tiled semantics)."""
+    finishes holding reduced chunk r (psum_scatter tiled semantics).
+    Same write-once slot discipline as the allreduce kernel."""
     import jax.experimental.pallas as pl
 
-    def kernel(ids_ref, x_ref, o_ref, comm, sem_s, sem_r):
+    def kernel(ids_ref, x_ref, o_ref, stage, rbuf, sem_s, sem_r):
         my, right = ids_ref[0], ids_ref[1]
         acc = x_ref[0, pl.ds(((my - 1) % world) * chunk, chunk)]
         for s in range(1, world):
-            slot = (s - 1) % 2
-            comm[slot] = acc
-            rdma = _remote_copy(comm, slot, sem_s, sem_r, right)
+            hop = s - 1
+            stage[hop] = acc
+            rdma = _remote_copy(stage, hop, rbuf, hop, sem_s, sem_r,
+                                right)
             rdma.start()
             rdma.wait()
-            acc = comm[slot] + x_ref[
+            acc = rbuf[hop] + x_ref[
                 0, pl.ds(((my - 1 - s) % world) * chunk, chunk)]
         o_ref[0, :] = acc
 
@@ -176,8 +204,10 @@ def _make_reducescatter_kernel(world: int, chunk: int):
 
 
 def _make_allgather_kernel(world: int, width: int):
-    """Relay ring allgather: own row copied out, then w-1 relay hops of
-    the full per-rank buffer."""
+    """Relay ring allgather: own row copied out, then w-1 relay hops.
+    `comm` has one slot per ring position — slot 0 holds the local
+    row, hop s receives into slot s and forwards slot s-1 — so every
+    slot is written exactly once."""
     import jax.experimental.pallas as pl
 
     def kernel(ids_ref, x_ref, o_ref, comm, sem_s, sem_r):
@@ -185,13 +215,11 @@ def _make_allgather_kernel(world: int, width: int):
         o_ref[0, pl.ds(my * width, width)] = x_ref[0, :]
         comm[0] = x_ref[0, :]
         for s in range(1, world):
-            slot = (s - 1) % 2
-            if s > 1:
-                comm[slot] = comm[(s - 2) % 2]
-            rdma = _remote_copy(comm, slot, sem_s, sem_r, right)
+            rdma = _remote_copy(comm, s - 1, comm, s, sem_s, sem_r,
+                                right)
             rdma.start()
             rdma.wait()
-            o_ref[0, pl.ds(((my - s) % world) * width, width)] = comm[slot]
+            o_ref[0, pl.ds(((my - s) % world) * width, width)] = comm[s]
 
     return kernel
 
@@ -205,13 +233,15 @@ def _make_quantized_allreduce_kernel(world: int, chunk: int, combine: str):
     cmb = _COMBINE_FNS[combine]
     nblocks = chunk // QUANT_BLOCK
 
-    def kernel(ids_ref, x_ref, o_ref, qbuf, sbuf, qsem_s, qsem_r,
-               ssem_s, ssem_r):
+    def kernel(ids_ref, x_ref, o_ref, qstage, sstage, qrbuf, srbuf,
+               qsem_s, qsem_r, ssem_s, ssem_r):
         my, right = ids_ref[0], ids_ref[1]
 
-        def hop(slot):
-            r1 = _remote_copy(qbuf, slot, qsem_s, qsem_r, right)
-            r2 = _remote_copy(sbuf, slot, ssem_s, ssem_r, right)
+        def hop_dma(qsrc_buf, qsrc, ssrc_buf, ssrc, hop):
+            r1 = _remote_copy(qsrc_buf, qsrc, qrbuf, hop,
+                              qsem_s, qsem_r, right)
+            r2 = _remote_copy(ssrc_buf, ssrc, srbuf, hop,
+                              ssem_s, ssem_r, right)
             r1.start()
             r2.start()
             r1.wait()
@@ -219,24 +249,26 @@ def _make_quantized_allreduce_kernel(world: int, chunk: int, combine: str):
 
         acc = x_ref[0, pl.ds(my * chunk, chunk)]
         for s in range(1, world):
-            slot = (s - 1) % 2
+            hop = s - 1
             q, sc = quantize_blocks(acc)
-            qbuf[slot] = q
-            sbuf[slot] = sc
-            hop(slot)
-            acc = cmb(dequantize_blocks(qbuf[slot], sbuf[slot]),
+            qstage[hop] = q
+            sstage[hop] = sc
+            hop_dma(qstage, hop, sstage, hop, hop)
+            acc = cmb(dequantize_blocks(qrbuf[hop], srbuf[hop]),
                       x_ref[0, pl.ds(((my - s) % world) * chunk, chunk)])
         q, sc = quantize_blocks(acc)
+        qstage[world - 1] = q
+        sstage[world - 1] = sc
         o_ref[0, pl.ds(((my + 1) % world) * chunk, chunk)] = \
             dequantize_blocks(q, sc)
         for s in range(1, world):
-            slot = (s - 1) % 2
-            qbuf[slot] = q if s == 1 else qbuf[(s - 2) % 2]
-            sbuf[slot] = sc if s == 1 else sbuf[(s - 2) % 2]
-            hop(slot)
-            q, sc = qbuf[slot], sbuf[slot]
+            hop = world - 1 + (s - 1)
+            if s == 1:  # relay the staged quantized reduced chunk...
+                hop_dma(qstage, world - 1, sstage, world - 1, hop)
+            else:  # ...then forward the previous hop's recv slots
+                hop_dma(qrbuf, hop - 1, srbuf, hop - 1, hop)
             o_ref[0, pl.ds(((my - s + 1) % world) * chunk, chunk)] = \
-                dequantize_blocks(q, sc)
+                dequantize_blocks(qrbuf[hop], srbuf[hop])
 
     assert nblocks * QUANT_BLOCK == chunk
     return kernel
@@ -336,7 +368,8 @@ class _PallasOps:
             xp = jnp.pad(x, ((0, 0), (0, Bp - B))) if Bp > B else x
             out = self._pallas_call(
                 kernel, Bp, x.dtype,
-                self._scratch_exact(C, x.dtype), collective_id=1)(ids, xp)
+                self._scratch_allreduce(C, x.dtype),
+                collective_id=1)(ids, xp)
             return out[:, :B]
 
         return self._jit(key, wrapper)(garr)
@@ -353,7 +386,8 @@ class _PallasOps:
             ids = _ring_ids(axis, w)
             out = self._pallas_call(
                 kernel, w * B, x.dtype,
-                self._scratch_exact(B, x.dtype), collective_id=2)(ids, x)
+                self._scratch_allgather(B, x.dtype),
+                collective_id=2)(ids, x)
             return out.reshape(1, w, B)
 
         return self._jit(key, wrapper, P(axis, None, None))(garr)
@@ -371,7 +405,8 @@ class _PallasOps:
             ids = _ring_ids(axis, w)
             return self._pallas_call(
                 kernel, C, x.dtype,
-                self._scratch_exact(C, x.dtype), collective_id=3)(ids, x)
+                self._scratch_reducescatter(C, x.dtype),
+                collective_id=3)(ids, x)
 
         return self._jit(key, wrapper)(garr)
 
@@ -403,25 +438,50 @@ class _PallasOps:
         return self._fallback.shift_right(garr)
 
     # -- scratch shapes -------------------------------------------------
+    #
+    # Slot counts follow the write-once discipline: `stage` holds one
+    # slot per staged send (w-1 reduce-scatter sends + 1 gather stage),
+    # recv buffers one slot per hop, DMA semaphores one pair per recv
+    # slot. max(1, ...) keeps world==1 (no hops at all) allocatable.
 
-    @staticmethod
-    def _scratch_exact(chunk: int, dtype):
+    def _scratch_allreduce(self, chunk: int, dtype):
         from jax.experimental.pallas import tpu as pltpu
 
-        return [pltpu.VMEM((2, chunk), jnp.dtype(dtype)),
-                pltpu.SemaphoreType.DMA((2,)),
-                pltpu.SemaphoreType.DMA((2,))]
+        hops = max(1, 2 * (self.world - 1))
+        return [pltpu.VMEM((self.world, chunk), jnp.dtype(dtype)),
+                pltpu.VMEM((hops, chunk), jnp.dtype(dtype)),
+                pltpu.SemaphoreType.DMA((hops,)),
+                pltpu.SemaphoreType.DMA((hops,))]
 
-    @staticmethod
-    def _scratch_quantized(chunk: int):
+    def _scratch_reducescatter(self, chunk: int, dtype):
         from jax.experimental.pallas import tpu as pltpu
 
-        return [pltpu.VMEM((2, chunk), jnp.int8),
-                pltpu.VMEM((2, chunk // QUANT_BLOCK), jnp.float32),
-                pltpu.SemaphoreType.DMA((2,)),
-                pltpu.SemaphoreType.DMA((2,)),
-                pltpu.SemaphoreType.DMA((2,)),
-                pltpu.SemaphoreType.DMA((2,))]
+        hops = max(1, self.world - 1)
+        return [pltpu.VMEM((hops, chunk), jnp.dtype(dtype)),
+                pltpu.VMEM((hops, chunk), jnp.dtype(dtype)),
+                pltpu.SemaphoreType.DMA((hops,)),
+                pltpu.SemaphoreType.DMA((hops,))]
+
+    def _scratch_allgather(self, width: int, dtype):
+        from jax.experimental.pallas import tpu as pltpu
+
+        return [pltpu.VMEM((self.world, width), jnp.dtype(dtype)),
+                pltpu.SemaphoreType.DMA((self.world,)),
+                pltpu.SemaphoreType.DMA((self.world,))]
+
+    def _scratch_quantized(self, chunk: int):
+        from jax.experimental.pallas import tpu as pltpu
+
+        hops = max(1, 2 * (self.world - 1))
+        return [pltpu.VMEM((self.world, chunk), jnp.int8),
+                pltpu.VMEM((self.world, chunk // QUANT_BLOCK),
+                           jnp.float32),
+                pltpu.VMEM((hops, chunk), jnp.int8),
+                pltpu.VMEM((hops, chunk // QUANT_BLOCK), jnp.float32),
+                pltpu.SemaphoreType.DMA((hops,)),
+                pltpu.SemaphoreType.DMA((hops,)),
+                pltpu.SemaphoreType.DMA((hops,)),
+                pltpu.SemaphoreType.DMA((hops,))]
 
 
 class PallasTransport(DeviceTransport):

@@ -118,6 +118,118 @@ def test_quantize_modes_never_share_executable(cache_sandbox):
     assert len(seams) == 2, index
 
 
+def test_fingerprint_not_memoized_while_uninit(monkeypatch):
+    """A key built before jax backend init must not pin the degraded
+    'uninit' fingerprint for the process's whole life — once the
+    backend facts resolve, later keys carry the full fingerprint."""
+    import jax
+
+    monkeypatch.setattr(_cc, "_fingerprint", None)
+    with monkeypatch.context() as m:
+        m.setattr(jax, "default_backend",
+                  lambda: (_ for _ in ()).throw(
+                      RuntimeError("backend not ready")))
+        fp1 = _cc.runtime_fingerprint()
+        assert "uninit" in fp1
+        assert _cc._fingerprint is None  # degraded facts: no memo
+    fp2 = _cc.runtime_fingerprint()
+    assert "uninit" not in fp2
+    assert _cc._fingerprint == fp2  # complete facts memoize
+
+
+def test_state_preexisting_excludes_own_stores(cache_sandbox):
+    """entries_preexisting counts only entries created BEFORE this
+    process: blobs the process itself stored on its own cold misses
+    must never read as a warm cache (the doctor false-positive)."""
+    key = _cc.make_key("unit", ("pre",))
+    assert _cc.store(key, b"x", seam="unit", parts=("pre",))
+    st = _cc.state()
+    assert st["entries"] == 1
+    assert st["entries_preexisting"] == 0  # stored by THIS process
+    with _cc._index_lock():
+        index = _cc._read_index()
+        index[key]["created"] = _cc._PROCESS_START - 60.0
+        _cc._write_index(index)
+    assert _cc.state()["entries_preexisting"] == 1
+
+
+def test_doctor_cold_finding_needs_preexisting_entries():
+    """diagnose() fires compile_cache_cold only when stored executables
+    PREDATE the process — a first-ever cold gang (its own misses
+    populated the index) is not 'a restart that re-traced'."""
+    from ray_tpu._private import debug_state
+
+    def snap(pre):
+        return {"driver": {"pid": 1, "compile_cache": {
+            "enabled": True, "dir": "/tmp/x", "entries": 3,
+            "entries_preexisting": pre, "hits": 0, "misses": 3,
+            "errors": 0}}}
+
+    findings = debug_state.diagnose(snap(0), {})
+    assert not any(f["kind"] == "compile_cache_cold" for f in findings)
+    findings = debug_state.diagnose(snap(3), {})
+    cold = next(f for f in findings
+                if f["kind"] == "compile_cache_cold")
+    assert "3 stored executables predating" in cold["detail"]
+
+
+def test_index_update_cross_process_atomic(cache_sandbox):
+    """Ranks sharing the cache dir must not lose each other's index
+    entries: the read-modify-write holds an OS file lock, so N
+    concurrent writers land ALL their entries (an in-process lock
+    alone is last-writer-wins across processes)."""
+    import subprocess
+    import sys
+
+    code = (
+        "import sys\n"
+        "from ray_tpu._private import compile_cache as cc\n"
+        "tag = sys.argv[1]\n"
+        "for i in range(20):\n"
+        "    cc._index_update('k-%s-%d' % (tag, i), seam='unit',\n"
+        "                     size=1, created=1.0)\n")
+    env = dict(os.environ, RAY_TPU_COMPILE_CACHE_DIR=cache_sandbox)
+    procs = [subprocess.Popen([sys.executable, "-c", code, str(t)],
+                              env=env)
+             for t in range(4)]
+    for p in procs:
+        assert p.wait(timeout=scale_timeout(120)) == 0
+    keys = [k for k in _cc.read_index() if k.startswith("k-")]
+    assert len(keys) == 80, len(keys)
+
+
+def test_donated_hit_path_validates_before_consuming(cache_sandbox):
+    """Donated seams (the paged-KV update, Trainer steps): a corrupt
+    blob degrades to a re-trace with the inputs INTACT — the hit path
+    AOT-compiles the deserialized module before the first donated
+    dispatch, so a stale entry fails while fallback is still possible,
+    never on already-deleted buffers. A good blob then resolves to a
+    donated hit through the same AOT path."""
+    import jax
+    import jax.numpy as jnp
+
+    jitted = jax.jit(lambda a, b: a + b, donate_argnums=(0,))
+    parts = ("donate", "f32", 8)
+    key = _cc.make_key("unit.donate", parts)
+    assert _cc.store(key, b"not a jax.export blob")
+    e0 = _cc.M_ERRORS.snapshot()["value"]
+
+    cf = _cc.CachedFunction("unit.donate", parts, jitted,
+                            donate_argnums=(0,))
+    out = cf(jnp.ones((8,), jnp.float32), jnp.ones((8,), jnp.float32))
+    assert cf.resolved == "miss"  # degraded, never user-visible
+    assert _cc.M_ERRORS.snapshot()["value"] >= e0 + 1
+    np.testing.assert_array_equal(np.asarray(out), np.full(8, 2.0))
+
+    # the miss re-exported a VALID blob over the corrupt one: a fresh
+    # seam now hits, donation applied via the validated AOT executable
+    cf2 = _cc.CachedFunction("unit.donate", parts, jitted,
+                             donate_argnums=(0,))
+    out2 = cf2(jnp.ones((8,), jnp.float32), jnp.ones((8,), jnp.float32))
+    assert cf2.resolved == "hit"
+    np.testing.assert_array_equal(np.asarray(out2), np.full(8, 2.0))
+
+
 # ---------------------------------------------------------------------------
 # gang layer: restart round-trip + failpoint chaos
 # ---------------------------------------------------------------------------
