@@ -54,6 +54,10 @@ DOCTOR_P99_FACTOR = float(os.environ.get("RAY_TPU_DOCTOR_P99_K", "3.0"))
 # (with >= floor_s of wall time behind them) flags the process
 COMPILE_STORM_MIN = int(os.environ.get("RAY_TPU_DOCTOR_COMPILE_STORM_MIN",
                                        "4"))
+# prefix_cold finding: an engine whose prefix tree has nodes and at
+# least this many lookups but ZERO hits flags mis-aligned page hashing
+PREFIX_COLD_MIN_LOOKUPS = int(os.environ.get(
+    "RAY_TPU_DOCTOR_PREFIX_COLD_MIN", "32"))
 
 # stage -> latency histogram whose p99 scales the stall threshold (the
 # PR 6 per-hop histograms; stages with no histogram gate on the floor)
@@ -351,6 +355,19 @@ def flatten(snapshot: dict, component: str) -> list[dict]:
                         "kv_leaked": eng.get("kv_leaked") or "",
                         "engine_dead": eng.get("dead") or "",
                     })
+                    pref = (eng.get("kv") or {}).get("prefix") or {}
+                    if pref.get("enabled"):
+                        # prefix-tree occupancy: node fill, pages held
+                        # by >1 owner, and the adoption hit-rate — the
+                        # KV-economy health row
+                        kv = eng.get("kv") or {}
+                        row.update({
+                            "prefix_nodes": f"{pref.get('nodes')}"
+                                            f"/{pref.get('max_nodes')}",
+                            "kv_shared": kv.get("pages_shared"),
+                            "kv_cached": kv.get("pages_cached"),
+                            "prefix_hit_rate": pref.get("hit_rate"),
+                        })
                 rows.append(row)
     rows.sort(key=lambda r: -float(r.get("age_s") or 0.0))
     return rows
@@ -488,6 +505,35 @@ def diagnose(snapshot: dict, metrics: dict | None = None, *,
                  detail=f"batch={eng.get('decode_batch')} "
                         f"open_streams={eng.get('open_streams')} "
                         f"steps={eng.get('steps')}")
+        pref = ((eng.get("kv") or {}).get("prefix") or {}) \
+            if isinstance(eng, dict) else {}
+        if (pref.get("enabled") and pref.get("nodes", 0) > 0
+                and pref.get("lookups", 0) >= PREFIX_COLD_MIN_LOOKUPS
+                and pref.get("hits", 0) == 0):
+            # prefix_cold: the tree holds indexed pages and plenty of
+            # admissions walked it, yet NOTHING ever matched — the
+            # classic symptom of mis-aligned page hashing (router and
+            # engine disagree on kv_page_size, or prompts are tokenized
+            # differently per session so no page boundary ever lines
+            # up). A hot shared prefix is paying full prefill N times.
+            # Age-less (a property of the workload, not a stall).
+            findings.append({
+                "kind": "prefix_cold",
+                "process": label,
+                "stage": "kv_prefix",
+                "age_s": 0.0,
+                "threshold_s": 0.0,
+                "trace_id": "",
+                "trace_source": "",
+                "id": "",
+                "name": (eng.get("backend", "")
+                         if isinstance(eng, dict) else ""),
+                "detail": (f"{pref['lookups']} prefix lookups with 0 "
+                           f"hits despite {pref['nodes']} indexed "
+                           f"nodes: likely mis-aligned page hashing "
+                           f"(page-size mismatch or non-page-aligned "
+                           f"shared prefix)"),
+            })
         compiles = proc.get("jax_compiles")
         if (isinstance(compiles, dict)
                 and compiles.get("recent_60s", 0) >= COMPILE_STORM_MIN

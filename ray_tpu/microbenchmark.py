@@ -309,6 +309,8 @@ def main(seconds_per_case: float = 2.0) -> list[dict]:
 
     _serve_stream(results)
 
+    _serve_prefix(results)
+
     _cold_gang_ttft(results)
 
     ray_tpu.shutdown()
@@ -1128,6 +1130,177 @@ def _serve_stream(results: list[dict], windows: int = 3,
     serve.shutdown()
 
 
+def _serve_prefix(results: list[dict], windows: int = 3,
+                  prefix_tokens: int = 2048, gen_tokens: int = 16):
+    """Cross-session prefix-sharing bench (ROADMAP item 4 acceptance):
+    a multi-tenant workload where every request carries the same long
+    page-aligned system prefix (prefix_tokens, a whole-page multiple of
+    kv_page_size) plus a short per-session tail, paired-interleaved
+    against an identical backend with prefix_sharing=False — the
+    per-session baseline that re-prefills the shared prefix for every
+    admission.
+
+    Recorded per arm: tokens/s/replica, client-side TTFT p50/p99 (first
+    SSE data frame), full-generation p99; the shared arm additionally
+    records the replica's prefix counters (hits, tokens saved, hit
+    rate, shared pages) read from engine_state AFTER the drive. The
+    tier-1 gate (test_serve_streaming.py::
+    test_microbench_serve_prefix_gate) asserts a nonzero recorded
+    hit-rate and shared-arm TTFT p99 no worse than the baseline."""
+    import http.client
+    import threading as _threading
+
+    import numpy as _np
+
+    from ray_tpu import serve
+    from ray_tpu.serve.engine import ShardedTokenLM
+    from ray_tpu.serve.streaming import iter_sse_lines
+
+    # model sized so prefill embed (~10ms for the full prefix) is the
+    # dominant TTFT term — the thing prefix sharing actually removes
+    model = ShardedTokenLM.make(11, vocab=2048, hidden=256, inner=512)
+    margs = (model.embed.copy(), model.w_up.copy(), model.w_out.copy())
+    page = 16
+    assert prefix_tokens % page == 0
+    base_cfg = {"streaming": True, "max_decode_batch": 4,
+                "max_waiting_sequences": 64, "kv_page_size": page,
+                "kv_pages_total": 2560, "num_replicas": 1,
+                "prefix_index_max_nodes": 2 * prefix_tokens // page,
+                "large_payload_threshold": 0}
+    client = serve.start(http=True)
+    client.create_backend("bench_pfx_shared", ShardedTokenLM, *margs,
+                          config={**base_cfg, "prefix_sharing": True})
+    client.create_endpoint("bench_pfx_shared",
+                           backend="bench_pfx_shared",
+                           route="/bench_pfx_shared", methods=["POST"])
+    client.create_backend("bench_pfx_base", ShardedTokenLM, *margs,
+                          config={**base_cfg, "prefix_sharing": False})
+    client.create_endpoint("bench_pfx_base", backend="bench_pfx_base",
+                           route="/bench_pfx_base", methods=["POST"])
+    port = client.http_port
+    n_clients = 8
+    # the fleet-shared system prompt: page-aligned by construction
+    shared_prefix = [(7 * i + 3) % 2048 for i in range(prefix_tokens)]
+
+    def one(route):
+        def fn(i) -> tuple[float, float, int]:
+            conn = http.client.HTTPConnection("127.0.0.1", port,
+                                              timeout=120)
+            body = json.dumps({
+                "prompt": shared_prefix + [i % 7 + 1, 3],
+                "max_tokens": gen_tokens, "stream": True})
+            t0 = time.perf_counter()
+            conn.request("POST", route, body=body, headers={
+                "Content-Type": "application/json",
+                "Accept": "text/event-stream"})
+            resp = conn.getresponse()
+            ttft, n = None, 0
+            for ev, data in iter_sse_lines(resp.fp):
+                if ev == "error":
+                    break
+                if ttft is None and data.get("tokens"):
+                    ttft = time.perf_counter() - t0
+                n += len(data.get("tokens") or [])
+                if ev == "done" or data.get("done"):
+                    break
+            total = time.perf_counter() - t0
+            conn.close()
+            return ttft if ttft is not None else total, total, n
+        return fn
+
+    def drive(fn, reqs_per_client: int = 3):
+        ttfts: list[float] = []
+        totals: list[float] = []
+        counts = {"tokens": 0}
+        lock = _threading.Lock()
+
+        def worker(i):
+            time.sleep(i * 0.025)  # de-herd window starts
+            for _ in range(reqs_per_client):
+                try:
+                    ttft, total, n = fn(i)
+                except (http.client.HTTPException, OSError):
+                    continue
+                with lock:
+                    if n:
+                        ttfts.append(ttft)
+                        totals.append(total)
+                        counts["tokens"] += n
+
+        threads = [_threading.Thread(target=worker, args=(i,))
+                   for i in range(n_clients)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return ttfts, totals, counts["tokens"], time.perf_counter() - t0
+
+    arms = {"serve_prefix shared": one("/bench_pfx_shared"),
+            "serve_prefix per-session baseline": one("/bench_pfx_base")}
+    deadline = time.time() + 30
+    while time.time() < deadline:  # route-table warmup
+        try:
+            if all(fn(0)[2] for fn in arms.values()):
+                break
+        except Exception:
+            pass
+        time.sleep(0.3)
+
+    acc = {name: {"ttft": [], "total": [], "tokens": 0, "dt": 0.0}
+           for name in arms}
+    for _ in range(windows):  # paired: load swings hit both arms
+        for name, fn in arms.items():
+            ttfts, totals, tokens, dt = drive(fn)
+            a = acc[name]
+            a["ttft"].extend(ttfts)
+            a["total"].extend(totals)
+            a["tokens"] += tokens
+            a["dt"] += dt
+
+    # the shared replica's own books: hits / tokens saved / hit rate
+    import ray_tpu as _rt
+    state = _rt.get(client._controller.get_routing_state.remote(
+        "bench_pfx_shared"), timeout=30)
+    eng = _rt.get(state["backends"]["bench_pfx_shared"]["replicas"][0]
+                  .engine_state.remote(), timeout=30)
+    pref = (eng.get("kv") or {}).get("prefix") or {}
+
+    for name, a in acc.items():
+        tps = a["tokens"] / a["dt"] if a["dt"] else 0.0
+        row = {
+            "name": name,
+            "tokens_per_s_per_replica": round(tps, 1),
+            "ttft_p50_ms": round(float(_np.percentile(a["ttft"], 50))
+                                 * 1000, 1) if a["ttft"] else 0.0,
+            "ttft_p99_ms": round(float(_np.percentile(a["ttft"], 99))
+                                 * 1000, 1) if a["ttft"] else 0.0,
+            "gen_p99_ms": round(float(_np.percentile(a["total"], 99))
+                                * 1000, 1) if a["total"] else 0.0,
+            "generations": len(a["total"]),
+            "prefix_tokens": prefix_tokens,
+            "gen_tokens": gen_tokens,
+            "clients": n_clients,
+            "windows": windows,
+        }
+        if name == "serve_prefix shared":
+            row.update({
+                "prefix_hits": pref.get("hits", 0),
+                "prefix_hit_rate": pref.get("hit_rate", 0.0),
+                "prefix_tokens_saved": pref.get("tokens_saved", 0),
+                "kv_pages_shared": (eng.get("kv") or {}).get(
+                    "pages_shared", 0),
+            })
+        results.append(row)
+        print(f"{name}: {tps:.1f} tok/s/replica, ttft p50 "
+              f"{row['ttft_p50_ms']:.0f}ms p99 "
+              f"{row['ttft_p99_ms']:.0f}ms ({row['generations']} gens)")
+    print(f"serve_prefix shared counters: hits={pref.get('hits')} "
+          f"saved={pref.get('tokens_saved')} "
+          f"hit_rate={pref.get('hit_rate')}")
+    serve.shutdown()
+
+
 def _cold_gang_ttft(results: list[dict], pairs: int = 3):
     """Serve gang restart TTFT, compile cache cold vs warm, PAIRED
     (round 15): each pair clears the persistent AOT compile cache,
@@ -1576,6 +1749,7 @@ if __name__ == "__main__":
     if args.only:
         groups = {"serve_mixed": _serve_mixed, "serve": _serve_qps,
                   "serve_stream": _serve_stream,
+                  "serve_prefix": _serve_prefix,
                   "tracing": _tracing_ab, "state": _state_ab,
                   "collective": _collective_bench,
                   "cold_gang": _cold_gang_ttft,

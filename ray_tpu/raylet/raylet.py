@@ -216,6 +216,7 @@ class Raylet:
             "return_worker": self.h_return_worker,
             "notify_object_sealed": self.h_notify_object_sealed,
             "wait_object_local": self.h_wait_object_local,
+            "hint_pull_purpose": self.h_hint_pull_purpose,
             "free_objects": self.h_free_objects,
             "pin_object": self.h_pin_object,
             "spill_now": self.h_spill_now,
@@ -1221,6 +1222,14 @@ class Raylet:
                 return False
         return await fut
 
+    async def h_hint_pull_purpose(self, conn, d):
+        """Advisory label for an upcoming pull of `object_id` (e.g.
+        \"kv_warm\" before a prefix-page import): consumed by the next
+        streaming pull of that object so transfer introspection can
+        attribute the bytes. Best-effort — no pull ever depends on it."""
+        transfer.hint_pull(d["object_id"], d.get("purpose") or "")
+        return True
+
     @property
     def _pull_sem(self) -> asyncio.Semaphore:
         # Admission control (reference: pull_manager.h:26): bound the
@@ -1405,13 +1414,15 @@ class Raylet:
         # request so the SOURCE raylet's serve span joins this tree
         ctx = tracing.maybe_trace()
         t0 = time.time()
+        purpose = transfer.take_pull_hint(oid)
         size = await loop.run_in_executor(None, lambda: transfer.streaming_pull(
             oid, object_id, self.store, bulk_addresses,
             chunk=cfg.object_transfer_chunk_size,
             stripe=cfg.object_transfer_stripe_size,
             max_sources=cfg.max_pull_sources,
             io_timeout=cfg.bulk_transfer_io_timeout_s,
-            trace=tracing.to_wire(ctx) if ctx is not None else None))
+            trace=tracing.to_wire(ctx) if ctx is not None else None,
+            purpose=purpose))
         transfer.M_PULL_S.observe(time.time() - t0,
                                   exemplar=tracing.exemplar_of(ctx))
         if ctx is not None:

@@ -114,6 +114,27 @@ def _untrack(table: dict, token: int) -> None:
         table.pop(token, None)
 
 
+# Advisory purpose labels for upcoming pulls ("kv_warm", ...): a worker
+# that knows WHY it is about to resolve a ref registers the label with
+# its raylet (hint_pull_purpose rpc) before the get; the raylet's
+# streaming pull consumes it so `ray-tpu state transfers` attributes the
+# bytes instead of showing anonymous traffic. Bounded and best-effort —
+# a missed hint only costs the label.
+_pull_hints: dict[bytes, str] = {}
+
+
+def hint_pull(oid: bytes, purpose: str) -> None:
+    with _active_lock:
+        if len(_pull_hints) >= 256:
+            _pull_hints.clear()
+        _pull_hints[oid] = str(purpose)[:64]
+
+
+def take_pull_hint(oid: bytes) -> str:
+    with _active_lock:
+        return _pull_hints.pop(oid, "")
+
+
 def debug_transfers(pins: "TransferPins | None" = None) -> dict:
     """Msgpack-safe snapshot of this process's in-flight transfers."""
     now = time.monotonic()
@@ -132,6 +153,7 @@ def debug_transfers(pins: "TransferPins | None" = None) -> dict:
             "progress": f"{done}/{size}",
             "sources": e.get("sources", 1),
             "trace_id": e.get("trace_id", ""),
+            "purpose": e.get("purpose", ""),
         })
     if pins is not None:
         out["pins"] = pins.debug()
@@ -530,6 +552,7 @@ class BulkTransferServer:
         view = buf.view
         serve_entry = {"object_id": oid.hex()[:12], "t0": time.monotonic(),
                        "size": end - offset, "sent": 0,
+                       "purpose": str(data.get("purpose") or "")[:64],
                        "trace_id": (_trace_ctx.trace_id.hex()
                                     if _trace_ctx is not None else "")}
         serve_token = _track(_active_serves, serve_entry)
@@ -576,10 +599,11 @@ class _Source:
     """One dialed bulk connection (blocking; lives on its worker thread)."""
 
     def __init__(self, address: str, connect_timeout: float,
-                 io_timeout: float):
+                 io_timeout: float, purpose: str = ""):
         self.address = address
         self.sock = _dial(address, connect_timeout, io_timeout)
         self._msgid = 0
+        self.purpose = purpose  # echoed in requests -> source serve rows
 
     def close(self):
         try:
@@ -594,6 +618,8 @@ class _Source:
         self._msgid += 1
         req = {"object_id": oid, "offset": offset, "length": length,
                "chunk": chunk}
+        if self.purpose:
+            req["purpose"] = self.purpose
         if trace is not None:
             # puller's sampled trace context: the source raylet's serve
             # span joins the pull's trace tree (tracing.py wire format)
@@ -654,7 +680,8 @@ def streaming_pull(oid: bytes, object_id: ObjectID, store,
                    addresses: list[str], *, chunk: int, stripe: int,
                    max_sources: int = 4, connect_timeout: float = 5.0,
                    io_timeout: float = 30.0,
-                   trace: list | None = None) -> int:
+                   trace: list | None = None,
+                   purpose: str = "") -> int:
     """Pull one object over the bulk plane, striping across up to
     `max_sources` of `addresses`. Creates, fills and seals the store
     entry; aborts it on failure. Blocking — run on an executor thread.
@@ -668,7 +695,7 @@ def streaming_pull(oid: bytes, object_id: ObjectID, store,
             # stat probe: sizes the buffer AND registers the transfer
             # pin on this source before any byte flows
             try:
-                src = _Source(addr, connect_timeout, io_timeout)
+                src = _Source(addr, connect_timeout, io_timeout, purpose)
             except OSError as e:
                 errors.append((addr, e))
                 continue
@@ -724,7 +751,7 @@ def streaming_pull(oid: bytes, object_id: ObjectID, store,
         pull_token = _track(_active_pulls, {
             "object_id": oid.hex()[:12], "t0": time.monotonic(),
             "size": size, "remaining": remaining,
-            "sources": len(usable),
+            "sources": len(usable), "purpose": purpose,
             "trace_id": (bytes(trace[0]).hex() if trace else "")})
 
         nsources = max(1, len(usable))
@@ -734,7 +761,8 @@ def streaming_pull(oid: bytes, object_id: ObjectID, store,
             moved = 0
             try:
                 if conn is None:
-                    conn = _Source(addr, connect_timeout, io_timeout)
+                    conn = _Source(addr, connect_timeout, io_timeout,
+                                   purpose)
                 with lock:
                     conns.append(conn)
                 while True:

@@ -19,6 +19,14 @@ class AutoscalingConfig:
     max_replicas: int = 4
     target_queued: float = 2.0       # queued queries per replica
     downscale_delay_s: float = 5.0   # hold-down before shrinking
+    # -- KV-aware scaling (streaming backends) ---------------------------
+    # The tick also sizes the fleet by KV-page pressure: replicas polled
+    # for pages_in_use/pages_total, a short linear prediction over
+    # kv_horizon_s extrapolates prefill load, and the fleet grows so the
+    # predicted occupancy stays under kv_target_util per replica.
+    # desired = max(queue_desired, kv_desired). 0 disables.
+    kv_target_util: float = 0.8      # predicted pool occupancy ceiling
+    kv_horizon_s: float = 10.0       # prediction lookahead
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -68,6 +76,17 @@ class BackendConfig:
     kv_backend: str = "numpy"             # or "jax" (donated updates)
     session_cache_max: int = 32           # retained session KV tables
     stream_poll_s: float = 2.0            # router long-poll slice
+    # -- KV-cache economy (cross-session prefix sharing) ----------------
+    # prefix_sharing=True builds a radix tree over full KV pages:
+    # admissions adopt the longest indexed page-aligned prefix
+    # (refcounted, copy-on-write at divergence) and prefill only the
+    # tail. The router mirrors the same page hashes to route new
+    # sessions to the replica already holding their prefix.
+    prefix_sharing: bool = True
+    prefix_index_max_nodes: int = 256     # prefix-tree size per replica
+    kv_warm_pages: int = 64               # pages pulled at scale-up (0=off)
+    router_session_cap: int = 4096        # sticky-session LRU bound
+    router_prefix_cap: int = 8192         # prefix-index LRU bound
 
     def __post_init__(self):
         if self.num_replicas < 0:
@@ -95,6 +114,13 @@ class BackendConfig:
                 raise ValueError("kv_backend must be 'numpy' or 'jax'")
             if self.session_cache_max < 0:
                 raise ValueError("session_cache_max must be >= 0")
+            if self.prefix_index_max_nodes < 0:
+                raise ValueError("prefix_index_max_nodes must be >= 0")
+            if self.kv_warm_pages < 0:
+                raise ValueError("kv_warm_pages must be >= 0")
+            if self.router_session_cap < 1 or self.router_prefix_cap < 1:
+                raise ValueError(
+                    "router_session_cap and router_prefix_cap must be >= 1")
         if isinstance(self.autoscaling, AutoscalingConfig):
             self.autoscaling = self.autoscaling.to_dict()
 

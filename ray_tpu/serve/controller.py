@@ -32,6 +32,10 @@ class ServeController:
     # an endpoint whose traffic stopped reaching any router) must not
     # pin replicas up with its last non-zero report forever.
     QUEUE_REPORT_TTL_S = 10.0
+    # KV-pressure poll cadence for streaming autoscaled backends (the
+    # engine_state gets run OUTSIDE the autoscale lock on the tick
+    # thread; a stale sample past 3x this is ignored).
+    KV_POLL_TTL_S = 2.0
 
     def __init__(self):
         import threading
@@ -45,6 +49,9 @@ class ServeController:
         # endpoint -> (latest reported router queue length, monotonic ts)
         self._queue_lens: dict[str, tuple[float, float]] = {}
         self._gang_restarts = 0
+        # backend -> {"in_use", "pages_total", "replicas", "ts", "ring"}
+        # sampled KV-page pressure for KV-aware autoscaling
+        self._kv_stats: dict[str, dict] = {}
         self._last_downscale_ok: dict[str, float] = {}
         self._last_autoscale = 0.0
         # serializes tick-thread autoscaling against report-triggered
@@ -70,6 +77,12 @@ class ServeController:
         logger = logging.getLogger("ray_tpu.serve.controller")
         while not self._stopped:
             time.sleep(self.AUTOSCALE_TICK_S)
+            try:
+                # poll BEFORE taking the autoscale lock: a slow replica
+                # get must not freeze resizes / gang restarts
+                self._refresh_kv_stats()
+            except Exception:
+                logger.exception("kv-pressure poll failed")
             try:
                 self._maybe_autoscale()
             except Exception:
@@ -115,6 +128,11 @@ class ServeController:
             "queue_reports": {
                 ep: {"queued": q, "report_age_s": round(now - ts, 3)}
                 for ep, (q, ts) in list(self._queue_lens.items())},
+            "kv_pressure": {
+                name: {"pages_in_use": st["in_use"],
+                       "pages_total": st["pages_total"],
+                       "sample_age_s": round(now - st["ts"], 3)}
+                for name, st in list(self._kv_stats.items())},
         }
 
     def _notify_change(self):
@@ -591,9 +609,15 @@ class ServeController:
                 and now - ts < self.QUEUE_REPORT_TTL_S)
             cur = len(rec["replicas"])
             target = auto.get("target_queued", 2.0) or 2.0
+            # two pressure signals, take the max: queue depth (reactive,
+            # router-reported) and predicted KV-page occupancy
+            # (streaming backends: prefill load materializes as pages
+            # long before queues back up)
+            want = max(1, math.ceil(queued / target))
+            kv_want = self._kv_desired(name, auto)
             desired = max(auto.get("min_replicas", 1),
                           min(auto.get("max_replicas", 4),
-                              max(1, math.ceil(queued / target))))
+                              max(want, kv_want)))
             if desired > cur:
                 self._resize(name, desired)
                 self._last_downscale_ok[name] = (
@@ -604,9 +628,120 @@ class ServeController:
                 if now >= self._last_downscale_ok.get(name, 0.0):
                     self._resize(name, desired)
 
+    def _refresh_kv_stats(self):
+        """Sample KV-page pressure from streaming autoscaled backends
+        (engine_state gets, OUTSIDE the autoscale lock). Keeps a short
+        per-backend ring of (ts, pages_in_use) — the same series the
+        metrics history graphs as `serve.kv_pages_in_use`, sampled here
+        per backend because the history aggregates per process."""
+        now = time.monotonic()
+        for name, rec in list(self.backends.items()):
+            cfg = rec["config"]
+            if not (cfg.get("streaming") and cfg.get("autoscaling")):
+                continue
+            st = self._kv_stats.get(name)
+            if st is not None and now - st["ts"] < self.KV_POLL_TTL_S:
+                continue
+            replicas = list(rec["replicas"])
+            if not replicas:
+                continue
+            try:
+                states = ray_tpu.get(
+                    [r.engine_state.remote() for r in replicas],
+                    timeout=5)
+            except Exception:
+                continue
+            in_use = total = 0
+            for es in states:
+                kv = (es or {}).get("kv") or {}
+                in_use += int(kv.get("pages_in_use") or 0)
+                total += int(kv.get("pages_total") or 0)
+            now = time.monotonic()
+            ring = list(st["ring"]) if st is not None else []
+            ring.append((now, float(in_use)))
+            ring = [s for s in ring if now - s[0] < 60.0][-32:]
+            self._kv_stats[name] = {
+                "in_use": in_use, "pages_total": total,
+                "replicas": len(replicas), "ts": now, "ring": ring}
+
+    def _kv_desired(self, name: str, auto: dict) -> int:
+        """Replicas needed so PREDICTED KV occupancy stays under
+        kv_target_util per pool: linear extrapolation of the sampled
+        pages_in_use series kv_horizon_s ahead. 0 = no opinion (stale
+        sample, KV scaling disabled, or not a streaming backend)."""
+        util = float(auto.get("kv_target_util", 0.8) or 0.0)
+        if util <= 0:
+            return 0
+        st = self._kv_stats.get(name)
+        now = time.monotonic()
+        if (st is None or not st["pages_total"]
+                or now - st["ts"] > 3 * self.KV_POLL_TTL_S):
+            return 0
+        predicted = float(st["in_use"])
+        horizon = float(auto.get("kv_horizon_s", 10.0) or 0.0)
+        ring = st["ring"]
+        if horizon > 0 and len(ring) >= 2:
+            (t0, v0), (t1, v1) = ring[0], ring[-1]
+            if t1 > t0:
+                predicted = max(0.0, v1 + (v1 - v0) / (t1 - t0) * horizon)
+        per_replica = st["pages_total"] / max(1, st["replicas"])
+        return math.ceil(predicted / max(1.0, per_replica * util))
+
     def _resize(self, name: str, n: int):
         rec = self._backend(name)
+        before = list(rec["replicas"])
         rec["config"]["num_replicas"] = n
         self._reconcile(name)
+        fresh = [r for r in rec["replicas"] if r not in before]
+        cfg = rec["config"]
+        if (fresh and before and cfg.get("streaming")
+                and cfg.get("num_shards", 1) == 1
+                and int(cfg.get("kv_warm_pages") or 0) > 0):
+            # warm the newcomers' prefix caches from a sibling over the
+            # bulk channel — advisory, off the control path
+            import threading
+
+            threading.Thread(
+                target=self._warm_replicas, daemon=True,
+                name=f"serve-kv-warm-{name}",
+                args=(name, before, fresh,
+                      int(cfg.get("kv_warm_pages") or 0))).start()
         self.version += 1
         self._notify_change()
+
+    def _warm_replicas(self, name: str, donors: list, fresh: list,
+                       max_pages: int):
+        """Scale-up cache warming: one donor exports its hottest prefix
+        pages to plasma, each new replica imports them (pull rides the
+        bulk channel donor -> importer; the controller only relays the
+        ~100-byte ref marker). Gangs never warm — members must replay
+        the driver's op stream, so imports are refused replica-side."""
+        import logging
+
+        logger = logging.getLogger("ray_tpu.serve.controller")
+        try:
+            payload = None
+            for donor in donors:
+                try:
+                    payload = ray_tpu.get(
+                        donor.export_prefix_pages.remote(max_pages),
+                        timeout=15)
+                except Exception:
+                    continue
+                if payload and payload.get("pages"):
+                    break
+                payload = None
+            if payload is None:
+                return
+            for r in fresh:
+                try:
+                    # nested ref: rehydrates on the importer WITHOUT
+                    # resolution — import_prefix_pages pulls it there
+                    ray_tpu.get(r.import_prefix_pages.remote(
+                        {"ref": payload["ref"]}), timeout=30)
+                except Exception:
+                    logger.debug("kv warm import failed for %s", name,
+                                 exc_info=True)
+        except Exception:
+            logger.debug("kv warm pass failed for %s", name,
+                         exc_info=True)

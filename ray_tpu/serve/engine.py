@@ -39,12 +39,15 @@ from __future__ import annotations
 import threading
 import time
 import uuid
+from collections import OrderedDict
 
 import numpy as np
 
 from ray_tpu._private import failpoints as _fp
-from ray_tpu.serve.kv_cache import KVCacheExhausted, PagedKVCache
+from ray_tpu.serve.kv_cache import (KVCacheExhausted, PagedKVCache,
+                                    prefix_block_hashes)
 from ray_tpu.serve.metrics import (M_DECODE_BATCH, M_DECODE_STEP_S,
+                                   M_KV_WARM_PAGES,
                                    M_SESSIONS_EVICTED_TOTAL,
                                    M_TOKENS_TOTAL, M_TTFT_S)
 from ray_tpu.serve.streaming import TokenChannel
@@ -210,7 +213,7 @@ def parse_stream_request(data) -> tuple[list[int], int, str | None, bool]:
 class Sequence:
     __slots__ = ("seq_id", "prompt", "max_tokens", "session", "generated",
                  "channel", "submitted_at", "admitted_at", "cached_tokens",
-                 "kv_sum", "trace_id")
+                 "prefix_tokens", "kv_sum", "trace_id")
 
     def __init__(self, seq_id: str, prompt: list[int], max_tokens: int,
                  session: str | None, channel: TokenChannel | None,
@@ -224,6 +227,7 @@ class Sequence:
         self.submitted_at = time.time()
         self.admitted_at = None
         self.cached_tokens = 0  # session-cache prefix reused at admit
+        self.prefix_tokens = 0  # cross-session prefix adopted at admit
         # hex trace id of the submitting request (when sampled): the
         # decode-step histogram's exemplar link back to one stream
         self.trace_id = trace_id
@@ -272,11 +276,20 @@ class DecodeEngine:
                 f"streaming backend {backend!r} requires a model with "
                 f"the decode protocol (kv_width/embed_tokens/"
                 f"partial_logits); {type(model).__name__} lacks it")
+        # cross-session prefix sharing (ROADMAP item 4): page-aligned
+        # prompt prefixes index into the pool's radix tree; admission
+        # adopts the longest match and prefills only the tail. Every
+        # gang rank makes the same tree decisions from the same plan
+        # stream, so sharing stays deterministic across ranks.
+        self._prefix_sharing = bool(config.get("prefix_sharing", True))
         self._kv = PagedKVCache(
             int(config.get("kv_pages_total") or 512),
             int(config.get("kv_page_size") or 16),
             int(width), name=f"kv:{backend}",
-            backend=config.get("kv_backend") or "numpy")
+            backend=config.get("kv_backend") or "numpy",
+            prefix_max_nodes=(
+                int(config.get("prefix_index_max_nodes") or 256)
+                if self._prefix_sharing else 0))
         self._max_batch = int(config.get("max_decode_batch") or 8)
         self._max_waiting = int(config.get("max_waiting_sequences") or 32)
         self._session_max = int(config.get("session_cache_max") or 32)
@@ -287,8 +300,17 @@ class DecodeEngine:
         self._waiting: list[Sequence] = []
         self._pending_aborts: list[tuple[str, str]] = []
         self._channels: dict[str, TokenChannel] = {}
-        self._sessions: dict[str, float] = {}     # key -> last use (LRU)
+        # retained session caches in LRU order (least-recently-finished
+        # first): adoption pops the key, retire re-appends it, so
+        # eviction is popitem(last=False) — O(1) under churn instead of
+        # the old O(n) min()-scan per evicted entry
+        self._sessions: OrderedDict[str, float] = OrderedDict()
         self._sessions_evicted = 0
+        # engine-side LRU evictions the router hasn't heard about yet:
+        # drained into the next stream_open reply (stream meta) so the
+        # router prunes its sticky entry instead of pinning the session
+        # to a replica that no longer holds its pages
+        self._evicted_feedback: list[str] = []
         self._steps = 0
         self._tokens_emitted = 0
         self._last_step_at = time.time()
@@ -363,6 +385,38 @@ class DecodeEngine:
         key = _SESSION_PREFIX + session
         return {"cached": self._kv.has(key),
                 "tokens": self._kv.length(key)}
+
+    # -- prefix economy ---------------------------------------------------
+
+    def prefix_hashes(self, prompt: list[int]) -> list[str]:
+        """Chained page-aligned prefix hashes of `prompt` — reported in
+        the stream_open meta so the router can index which replica
+        holds which prefixes (same function both sides: a hash computed
+        here matches one computed from the same tokens anywhere)."""
+        if not self._prefix_sharing:
+            return []
+        return prefix_block_hashes(prompt, self._kv.page_size)
+
+    def drain_evicted_sessions(self) -> list[str]:
+        """Session names LRU-evicted since the last drain (bounded at
+        64) — piggybacked on stream_open replies so the router prunes
+        its sticky table instead of routing to a cold cache forever."""
+        with self._lock:
+            out, self._evicted_feedback = self._evicted_feedback, []
+        return out
+
+    def export_prefix(self, max_pages: int = 128) -> list[dict]:
+        """Hottest prefix-tree pages, parents-first (warm-start donor
+        side; see PagedKVCache.export_prefix)."""
+        return self._kv.export_prefix(max_pages)
+
+    def import_prefix(self, entries: list[dict]) -> int:
+        """Advisory warm import of a sibling's exported prefix pages;
+        returns pages actually adopted (0 on any mismatch)."""
+        n = self._kv.import_prefix(entries)
+        if n:
+            M_KV_WARM_PAGES.inc(n)
+        return n
 
     # -- decode loop -----------------------------------------------------
 
@@ -516,23 +570,33 @@ class DecodeEngine:
         for seq in admits:
             adopted_key = None
             try:
-                if seq.session:
+                if seq.session and self._kv.has(
+                        _SESSION_PREFIX + seq.session):
+                    # warm session: adopt the cached prefix — the
+                    # affinity hit skips re-prefilling prior turns
                     key = _SESSION_PREFIX + seq.session
-                    if self._kv.has(key):
-                        # warm session: adopt the cached prefix — the
-                        # affinity hit skips re-prefilling prior turns
-                        seq.cached_tokens = self._kv.adopt(
-                            key, seq.seq_id)
-                        adopted_key = key
-                        with self._lock:
-                            self._sessions.pop(key, None)
-                    else:
-                        self._kv.alloc_table(seq.seq_id)
+                    seq.cached_tokens = self._kv.adopt(key, seq.seq_id)
+                    adopted_key = key
+                    with self._lock:
+                        self._sessions.pop(key, None)
+                elif self._prefix_sharing and seq.prompt:
+                    # cold path: walk the prefix tree, adopt the
+                    # longest indexed page-aligned prefix (refcount
+                    # bumps, no prefill) — only the tail embeds below
+                    seq.prefix_tokens = self._kv.adopt_prefix(
+                        seq.seq_id, seq.prompt)
                 else:
                     self._kv.alloc_table(seq.seq_id)
-                if seq.prompt:
+                tail = seq.prompt[seq.prefix_tokens:] \
+                    if seq.prefix_tokens else seq.prompt
+                if tail:
                     self._kv.append(seq.seq_id,
-                                    self._model.embed_tokens(seq.prompt))
+                                    self._model.embed_tokens(tail))
+                if self._prefix_sharing and adopted_key is None \
+                        and seq.prompt:
+                    # index this prompt's full pages so later
+                    # admissions (any session) adopt them
+                    self._kv.register_prefix(seq.seq_id, seq.prompt)
             except KVCacheExhausted:
                 # admission-time exhaustion is a SHED: the sequence
                 # never ran; pages written for it go back — but an
@@ -633,13 +697,23 @@ class DecodeEngine:
             self._kv.free(key)  # stale same-key cache, if any
             self._kv.adopt(seq.seq_id, key)
             with self._lock:
+                # OrderedDict insertion order IS the LRU order (adoption
+                # pops the key, retirement re-appends): eviction is an
+                # O(1) popitem instead of a min() scan per victim
+                self._sessions.pop(key, None)
                 self._sessions[key] = time.time()
                 evict = []
                 while len(self._sessions) > self._session_max:
-                    oldest = min(self._sessions, key=self._sessions.get)
-                    self._sessions.pop(oldest)
+                    oldest, _ = self._sessions.popitem(last=False)
                     evict.append(oldest)
                 self._sessions_evicted += len(evict)
+                for victim in evict:
+                    # feedback for the router: drained into the next
+                    # stream_open reply so its sticky table prunes
+                    # entries whose cache no longer exists
+                    self._evicted_feedback.append(
+                        victim[len(_SESSION_PREFIX):])
+                del self._evicted_feedback[:-64]
             for victim in evict:
                 self._kv.free(victim)
                 M_SESSIONS_EVICTED_TOTAL.inc()
@@ -773,7 +847,12 @@ class StreamingEngineHost:
         eng = self._require_engine()
         cached = bool(session) and eng.session_info(session)["cached"]
         return {"seq": eng.submit(prompt, max_tokens, session),
-                "session_cached": cached}
+                "session_cached": cached,
+                # router-side prefix index feed: which page-aligned
+                # prefixes this replica now holds, and which sessions
+                # it LRU-evicted since the last report
+                "prefix_hashes": eng.prefix_hashes(prompt),
+                "evicted_sessions": eng.drain_evicted_sessions()}
 
     # once a stream is flowing, later chunks coalesce this long before
     # replying: one poll RPC then carries a step-burst of tokens instead
@@ -813,3 +892,59 @@ class StreamingEngineHost:
         """Sync introspection hook (tests, `ray-tpu state serve`)."""
         eng = self._engine
         return eng.debug_state() if eng is not None else {}
+
+    # -- scale-up warm start (controller-driven) --------------------------
+
+    def export_prefix_pages(self, max_pages: int = 128) -> dict:
+        """Warm-start DONOR: snapshot the hottest prefix-tree pages
+        into plasma and return `{"ref": ..., "pages": n}`. The ref is
+        relayed by the controller as a ~100-byte marker (nested refs
+        rehydrate unresolved); the importer's `get` then pulls the
+        bytes donor->importer over the PR 5 bulk channel — the
+        controller never touches the page data."""
+        eng = self._require_engine()
+        entries = eng.export_prefix(max_pages)
+        if not entries:
+            return {"ref": None, "pages": 0}
+        import ray_tpu
+
+        return {"ref": ray_tpu.put(entries), "pages": len(entries)}
+
+    def import_prefix_pages(self, payload) -> int:
+        """Warm-start IMPORTER (advisory): resolve a donor's export and
+        seed the local prefix index so the first admissions hit warm
+        pages instead of re-prefilling. Returns pages adopted; 0 on any
+        mismatch, a lost donor, or a gang member — gang ranks replay
+        the driver's admission stream and MUST NOT diverge in pool
+        state, so only single-shard engines accept a warm import."""
+        eng = self._require_engine()
+        if eng._peers or not eng._driver:
+            return 0
+        ref = payload.get("ref") if isinstance(payload, dict) else None
+        if ref is None:
+            return 0
+        self._hint_kv_warm(ref)
+        import ray_tpu
+
+        try:
+            entries = ray_tpu.get(ref, timeout=30.0)
+        except Exception:
+            return 0  # donor died with the only copy: warm is advisory
+        return eng.import_prefix(entries)
+
+    @staticmethod
+    def _hint_kv_warm(ref) -> None:
+        """Best-effort: label the upcoming bulk pull as `kv_warm` so
+        `ray-tpu state transfers` attributes the bytes to cache
+        warming, not anonymous traffic."""
+        try:
+            from ray_tpu._private import global_state
+
+            cw = global_state.get_core_worker()
+            if cw is None:
+                return
+            cw._io.run(cw.raylet.call("hint_pull_purpose", {
+                "object_id": ref.id().binary(),
+                "purpose": "kv_warm"}))
+        except Exception:
+            pass

@@ -67,3 +67,33 @@ M_SESSIONS_EVICTED_TOTAL = _stats.Count(
     "session KV-cache entries evicted (LRU past session_cache_max): the "
     "evicted session's next turn opens COLD — stream_open reports "
     "session_cached=false and the client must resend full history")
+
+# -- KV-cache economy (cross-session prefix sharing, ROADMAP item 4) ------
+
+M_PREFIX_HITS = _stats.Count(
+    "serve.prefix_hits_total",
+    "admissions that adopted a nonempty page-aligned prefix from the "
+    "per-replica PrefixIndex (the shared prefill was NOT recomputed)")
+
+M_PREFIX_SAVED = _stats.Count(
+    "serve.prefix_prefill_tokens_saved_total",
+    "prompt tokens whose prefill was skipped by prefix adoption (an "
+    "N-session shared prefix pays prefill once: this grows by "
+    "(N-1) x page-aligned prefix length)")
+
+M_KV_PAGES_SHARED = _stats.Gauge(
+    "serve.kv_pages_shared",
+    "KV pages with refcount > 1 (held by several sequences/sessions "
+    "and/or the prefix index at once): the HBM the economy is saving")
+
+M_ROUTER_SESSIONS_PRUNED = _stats.Count(
+    "serve.router_sessions_pruned_total",
+    "router sticky-session entries dropped: LRU past the bounded table "
+    "cap, or pruned by engine eviction feedback in the stream meta (a "
+    "pruned session re-routes by prefix index / least-loaded)")
+
+M_KV_WARM_PAGES = _stats.Count(
+    "serve.kv_warm_pages_total",
+    "prefix pages a fresh replica imported from a sibling over the bulk "
+    "channel at scale-up (prefill compute NOT recomputed on the new "
+    "replica)")

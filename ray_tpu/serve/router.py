@@ -16,11 +16,13 @@ from __future__ import annotations
 import threading
 import time
 import weakref
+from collections import OrderedDict
 
 from ray_tpu._private import stats as _stats
 from ray_tpu._private import tracing
+from ray_tpu.serve.kv_cache import prefix_block_hashes
 from ray_tpu.serve.metrics import (M_ADMITTED_TOTAL, M_ROUTER_QUEUED,
-                                   M_SHED_TOTAL)
+                                   M_ROUTER_SESSIONS_PRUNED, M_SHED_TOTAL)
 
 M_ROUTER_QUEUE_S = _stats.Histogram(
     "serve.router_queue_s", _stats.LATENCY_BOUNDARIES_S,
@@ -110,11 +112,21 @@ class Router:
         self._inflight: dict[bytes, int] = {}   # actor_id -> live batches
         # streaming tier: sticky session -> replica actor key, plus live
         # open-stream accounting (streams hold an _inflight slot for
-        # their whole life, not one batch)
-        self._sessions: dict[str, bytes] = {}
+        # their whole life, not one batch). Both tables are LRU-bounded
+        # OrderedDicts: insertion order is eviction order, hits refresh
+        # via move_to_end, caps come from the backend config
+        # (router_session_cap / router_prefix_cap).
+        self._sessions: OrderedDict[str, bytes] = OrderedDict()
+        # prefix-hash -> replica actor key, fed by the engine's
+        # stream_open meta: new sessions route to the replica already
+        # holding their longest page-aligned prefix
+        self._prefixes: OrderedDict[str, bytes] = OrderedDict()
         self._streams_open = 0
         self._affinity_hits = 0
         self._affinity_misses = 0
+        self._prefix_hits = 0
+        self._prefix_misses = 0
+        self._sessions_pruned = 0
         self._state = None
         self._state_time = 0.0
         self._shed_total = 0
@@ -148,6 +160,10 @@ class Router:
             "sessions": len(self._sessions),
             "affinity_hits": self._affinity_hits,
             "affinity_misses": self._affinity_misses,
+            "prefix_index": len(self._prefixes),
+            "prefix_hits": self._prefix_hits,
+            "prefix_misses": self._prefix_misses,
+            "sessions_pruned": self._sessions_pruned,
             "oldest_age_s": (round(max(now - q.t_enqueue
                                        for q in queue), 3)
                              if queue else 0.0),
@@ -311,33 +327,84 @@ class Router:
     # -- streaming (continuous-batching backends) ------------------------
 
     def _pick_stream_replica(self, state: dict, backend: str,
-                             session: str | None):
-        """Session-affinity pick: a sticky session key routes to the
-        replica already holding that session's KV pages; cold sessions
-        (and sessions whose replica vanished — gang restart, downscale)
-        fall back to least-loaded and re-stick there."""
+                             session: str | None,
+                             prefix_hashes: list[str] = (),
+                             cfg: dict | None = None):
+        """KV-aware pick, in order: (1) sticky session -> the replica
+        holding that session's KV pages; (2) prefix index -> the
+        replica holding the LONGEST page-aligned prefix of this prompt
+        (hashes checked longest-first, so a deep match beats a shallow
+        one); (3) least-loaded fallback. Sessions whose replica
+        vanished (gang restart, downscale) re-stick wherever they
+        land."""
         st = state["backends"].get(backend)
         if st is None or not st["replicas"]:
             return None
+        cfg = cfg or {}
+        session_cap = int(cfg.get("router_session_cap") or 4096)
+        live = {h._actor_id.binary(): h for h in st["replicas"]}
         with self._lock:
             if session:
                 want = self._sessions.get(session)
-                if want is not None:
-                    for handle in st["replicas"]:
-                        if handle._actor_id.binary() == want:
-                            self._affinity_hits += 1
-                            return handle
+                if want is not None and want in live:
+                    self._affinity_hits += 1
+                    self._sessions.move_to_end(session)
+                    return live[want]
+            for h in reversed(prefix_hashes):
+                want = self._prefixes.get(h)
+                if want is not None and want in live:
+                    self._prefix_hits += 1
+                    self._prefixes.move_to_end(h)
+                    if session:
+                        self._affinity_misses += 1
+                        self._stick(session, want, session_cap)
+                    return live[want]
+            if prefix_hashes:
+                self._prefix_misses += 1
             best, best_load = None, None
-            for handle in st["replicas"]:
-                load = self._inflight.get(handle._actor_id.binary(), 0)
+            for key, handle in live.items():
+                load = self._inflight.get(key, 0)
                 if best_load is None or load < best_load:
                     best, best_load = handle, load
             if session and best is not None:
                 self._affinity_misses += 1
-                self._sessions[session] = best._actor_id.binary()
-                while len(self._sessions) > 4096:  # bounded stick table
-                    self._sessions.pop(next(iter(self._sessions)))
+                self._stick(session, best._actor_id.binary(),
+                            session_cap)
         return best
+
+    def _stick(self, session: str, key: bytes, cap: int):
+        """Record session -> replica under self._lock, LRU-bounded."""
+        self._sessions.pop(session, None)
+        self._sessions[session] = key
+        while len(self._sessions) > cap:
+            self._sessions.popitem(last=False)
+            self._sessions_pruned += 1
+            M_ROUTER_SESSIONS_PRUNED.inc()
+
+    def _note_stream_meta(self, key: bytes, reply: dict,
+                          cfg: dict | None = None):
+        """Digest a stream_open reply's routing feedback: index the
+        prefix hashes this replica now holds (LRU-bounded), and prune
+        sticky entries for sessions the engine LRU-evicted — without
+        this the router pins a session to a replica whose cache is
+        long gone."""
+        cfg = cfg or {}
+        prefix_cap = int(cfg.get("router_prefix_cap") or 8192)
+        hashes = reply.get("prefix_hashes") or []
+        evicted = reply.get("evicted_sessions") or []
+        with self._lock:
+            for h in hashes:
+                self._prefixes.pop(h, None)
+                self._prefixes[h] = key
+            while len(self._prefixes) > prefix_cap:
+                self._prefixes.popitem(last=False)
+            for sess in evicted:
+                # only unpin if still pointing at the evicting replica
+                # (the session may have re-stuck elsewhere already)
+                if self._sessions.get(sess) == key:
+                    self._sessions.pop(sess, None)
+                    self._sessions_pruned += 1
+                    M_ROUTER_SESSIONS_PRUNED.inc()
 
     async def stream_async(self, data, timeout: float = 60.0):
         """Async generator of token chunks from a streaming backend:
@@ -366,11 +433,18 @@ class Router:
                 f"backend {backend!r} is not a streaming backend "
                 f"(deploy with BackendConfig(streaming=True))")
         poll_s = float(cfg.get("stream_poll_s") or 2.0)
-        _, _, session, _ = _parse_session(data)
+        prompt, _, session, _ = _parse_session(data)
+        # same chained page hashes the engine computes: a router-side
+        # hash matches a replica-side one iff the token pages match
+        phashes = []
+        if prompt and cfg.get("prefix_sharing", True):
+            phashes = prefix_block_hashes(
+                prompt, int(cfg.get("kv_page_size") or 16))
         deadline = time.monotonic() + timeout
         replica = None
         while replica is None:
-            replica = self._pick_stream_replica(state, backend, session)
+            replica = self._pick_stream_replica(state, backend, session,
+                                                phashes, cfg)
             if replica is None:
                 # gang restarting / replicas scaling: wait for cutover
                 if time.monotonic() > deadline:
@@ -397,6 +471,7 @@ class Router:
                     raise
                 raise self._map_group_error(e, cfg) from None
             seq_id = reply["seq"]
+            self._note_stream_meta(key, reply, cfg)
             M_ROUTER_QUEUED.add(-1)
             queued = False
             opened = True
@@ -407,10 +482,11 @@ class Router:
             # meta chunk first: session-cache hit/miss is part of the
             # stream contract (a delta-prompt client must resend full
             # history on a miss — see stream_open)
-            yield {"meta": {"seq": seq_id,
-                            "session_cached": reply.get(
-                                "session_cached", False)},
-                   "tokens": [], "cursor": 0, "done": False}
+            from ray_tpu.serve.streaming import meta_chunk
+            yield meta_chunk(
+                seq_id,
+                session_cached=reply.get("session_cached", False),
+                prefix_hashes=reply.get("prefix_hashes") or [])
             cursor = 0
             deadline = time.monotonic() + timeout
             while True:

@@ -140,6 +140,15 @@ class TokenChannel:
 SSE_CONTENT_TYPE = "text/event-stream"
 
 
+def meta_chunk(seq_id: str, **meta) -> dict:
+    """The stream's FIRST chunk: no tokens, just admission metadata
+    (session_cached, prefix_hashes, ...) the client contract needs
+    before any token arrives — shaped like a token chunk so SSE framing
+    and cursor handling are uniform."""
+    return {"meta": {"seq": seq_id, **meta},
+            "tokens": [], "cursor": 0, "done": False}
+
+
 def sse_event(data: dict, event: str | None = None) -> bytes:
     """One Server-Sent Event frame: optional `event:` line + one
     JSON-encoded `data:` line + blank-line terminator."""

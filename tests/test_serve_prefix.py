@@ -1,0 +1,553 @@
+"""KV-cache economy tier (ISSUE 17 / ROADMAP item 4): cross-session
+prefix sharing + KV-aware routing.
+
+Tier-1: pool refcount/CoW invariants (shared pages never mutate under
+another reader, frees are refcount decrements, index-only pages
+reclaim before exhaustion), shared-prefix decode bit-exact vs an
+unshared control across seeds with honest hit/saved counters, the
+export/import warm path (and its gang-member refusal), router
+prefix-aware picks with LRU bounds and eviction-feedback pruning, the
+KV-pressure autoscale signal (pure math + a live scale-up), and the
+doctor's prefix_cold finding.
+
+Chaos (`pytest -m chaos`): gang member killed mid-decode while shared
+prefix pages are live — typed stream errors, gang restart, zero leaked
+pages."""
+
+import threading
+import time
+import types
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import exceptions as exc
+from ray_tpu import serve
+from ray_tpu.serve.config import AutoscalingConfig, BackendConfig
+from ray_tpu.serve.engine import (DecodeEngine, ShardedTokenLM,
+                                  StreamingEngineHost)
+from ray_tpu.serve.kv_cache import (KVCacheExhausted, PagedKVCache,
+                                    prefix_block_hashes)
+from ray_tpu.serve.router import Router
+from tests.conftest import scale_timeout, state_dump_on_failure
+from tests.test_serve_streaming import _drain, _model_args
+
+
+# ---------------------------------------------------------------------------
+# pool unit tier: refcounts, CoW, index reclaim
+# ---------------------------------------------------------------------------
+
+
+def test_kv_pool_prefix_refcounts_and_cow():
+    """Register -> adopt shares pages by refcount bump (no copy);
+    divergence after truncating into a shared page copies-on-write so
+    the other reader's rows never change; frees are decrements and the
+    index alone keeps pages adoptable (cached, not leaked)."""
+    kv = PagedKVCache(16, 4, 8, prefix_max_nodes=8)
+    try:
+        tokens = list(range(1, 9))  # 2 full pages @ page_size 4
+        rows = np.arange(8 * 8, dtype=np.float32).reshape(8, 8)
+        assert kv.adopt_prefix("a", tokens) == 0  # cold tree
+        kv.append("a", rows)
+        assert kv.register_prefix("a", tokens) == 2  # nodes added
+        assert kv.pages_in_use() == 2
+
+        # adoption: same 2 pages, one refcount bump each, no prefill
+        assert kv.adopt_prefix("b", tokens + [99]) == 8
+        assert kv.pages_in_use() == 2  # SAME pages, not new ones
+        a_sum = kv.gather_sum("a").copy()
+        assert np.array_equal(kv.gather_sum("b"), a_sum)
+        st = kv.prefix_stats()
+        assert st["hits"] == 1 and st["tokens_saved"] == 8
+
+        # CoW: truncate into the shared 2nd page, then diverge
+        kv.truncate("b", 6)
+        divergent = np.full((1, 8), 500.0, dtype=np.float32)
+        kv.append("b", divergent)
+        assert np.array_equal(kv.gather_sum("a"), a_sum), \
+            "divergent append mutated a shared page"
+        expect_b = rows[:6].sum(axis=0) + divergent[0]
+        assert np.allclose(kv.gather_sum("b"), expect_b)
+        assert kv.pages_in_use() == 3  # a's 2 + b's CoW'd tail
+
+        # frees decrement; the index keeps the prefix adoptable
+        kv.free("b")
+        kv.free("a")
+        assert kv.pages_in_use() == 0
+        assert kv.leak_report(live_owners=[]) == []  # index != leak
+        dbg = kv.debug_state() if hasattr(kv, "debug_state") else {}
+        assert kv.adopt_prefix("c", tokens) == 8, dbg
+        kv.free("c")
+        assert kv.clear_prefix() == 2  # both indexed pages released
+        assert kv.prefix_stats()["nodes"] == 0
+    finally:
+        kv.close()
+
+
+def test_kv_pool_pressure_reclaims_index_pages():
+    """A full pool evicts index-only pages (leaf-first) before raising
+    KVCacheExhausted — the prefix cache must never make allocation fail
+    where a cold pool would have succeeded."""
+    kv = PagedKVCache(4, 4, 8, prefix_max_nodes=8)
+    try:
+        tokens = list(range(1, 9))
+        kv.adopt_prefix("a", tokens)
+        kv.append("a", np.ones((8, 8), dtype=np.float32))
+        kv.register_prefix("a", tokens)
+        kv.free("a")  # 2 pages live only in the index now
+        assert kv.prefix_stats()["nodes"] == 2
+        kv.alloc_table("big")
+        kv.append("big", np.zeros((16, 8), dtype=np.float32))  # all 4
+        assert kv.pages_in_use() == 4
+        assert kv.prefix_stats()["nodes"] == 0, "index not reclaimed"
+        with pytest.raises(KVCacheExhausted):
+            kv.append("big", np.zeros((1, 8), dtype=np.float32))
+        kv.free("big")
+    finally:
+        kv.close()
+
+
+def test_prefix_block_hashes_chained_and_page_aligned():
+    """Hashes chain (block i's digest depends on blocks 0..i), cover
+    only FULL pages, and match between distinct prompts exactly up to
+    their divergence page."""
+    a = prefix_block_hashes([1, 2, 3, 4, 5, 6, 7, 8, 9], 4)
+    b = prefix_block_hashes([1, 2, 3, 4, 9, 9, 9, 9], 4)
+    assert len(a) == 2 and len(b) == 2  # partial tail page excluded
+    assert a[0] == b[0] and a[1] != b[1]
+    assert all(len(h) == 16 for h in a)
+    # chaining: same 2nd block under a different 1st block != a[1]
+    c = prefix_block_hashes([9, 9, 9, 9, 5, 6, 7, 8], 4)
+    assert c[1] != a[1]
+    assert prefix_block_hashes([1, 2, 3], 4) == []
+
+
+# ---------------------------------------------------------------------------
+# engine tier: bit-exact sharing, counters, warm export/import
+# ---------------------------------------------------------------------------
+
+_PREFIX = [3, 5, 9, 1, 2, 4, 6, 8]  # 2 full pages @ kv_page_size 4
+
+
+def _engine_cfg(**kw):
+    cfg = {"max_decode_batch": 4, "kv_page_size": 4,
+           "kv_pages_total": 64, "prefix_index_max_nodes": 16}
+    cfg.update(kw)
+    return cfg
+
+
+@pytest.mark.parametrize("seed", [5, 11, 23])
+def test_engine_shared_prefix_bit_exact_vs_unshared_control(seed):
+    """N sequences sharing a page-aligned prefix decode EXACTLY the
+    reference model's tokens and exactly what a prefix_sharing=False
+    control engine produces; the shared engine's books show N-1 hits
+    and (N-1)*prefix_len tokens saved, and every page frees on retire."""
+    ref = ShardedTokenLM.make(seed)
+    shared = DecodeEngine(ShardedTokenLM.make(seed), _engine_cfg(),
+                          "shared")
+    control = DecodeEngine(ShardedTokenLM.make(seed),
+                           _engine_cfg(prefix_sharing=False), "control")
+    try:
+        prompts = [_PREFIX + [i + 1] for i in range(4)]
+        for prompt in prompts:  # sequential: deterministic hit counts
+            want = ref.generate(prompt, 12)
+            got = _drain(shared.channel(shared.submit(prompt, 12)),
+                         scale_timeout(20))
+            ctl = _drain(control.channel(control.submit(prompt, 12)),
+                         scale_timeout(20))
+            assert got == want == ctl
+        st = shared.debug_state()
+        pref = st["kv"]["prefix"]
+        assert pref["enabled"] and pref["hits"] == 3
+        assert pref["tokens_saved"] == 3 * len(_PREFIX)
+        assert st["kv"]["pages_in_use"] == 0
+        assert st["kv_leaked"] == []
+        ctl_pref = control.debug_state()["kv"]["prefix"]
+        assert not ctl_pref.get("enabled")
+    finally:
+        shared.close()
+        control.close()
+
+
+def test_engine_export_import_prefix_warm():
+    """Warm start at the engine layer: a fresh engine seeded with a
+    donor's exported prefix pages serves its FIRST admission from the
+    warm pages (hit, tokens saved) and still decodes bit-exact."""
+    seed = 7
+    ref = ShardedTokenLM.make(seed)
+    donor = DecodeEngine(ShardedTokenLM.make(seed), _engine_cfg(),
+                         "donor")
+    fresh = DecodeEngine(ShardedTokenLM.make(seed), _engine_cfg(),
+                         "fresh")
+    try:
+        prompt = _PREFIX + [9]
+        want = ref.generate(prompt, 10)
+        assert _drain(donor.channel(donor.submit(prompt, 10)),
+                      scale_timeout(20)) == want
+        entries = donor.export_prefix()
+        assert len(entries) == 2  # both full prefix pages
+        assert all(e["rows"].dtype == np.float32 for e in entries)
+        assert fresh.import_prefix(entries) == 2
+        assert _drain(fresh.channel(fresh.submit(prompt, 10)),
+                      scale_timeout(20)) == want
+        pref = fresh.debug_state()["kv"]["prefix"]
+        assert pref["hits"] == 1, "first admission missed warm pages"
+        assert pref["tokens_saved"] == len(_PREFIX)
+    finally:
+        donor.close()
+        fresh.close()
+
+
+def test_host_import_refuses_gang_members():
+    """Gang ranks replay the driver's admission stream and must not
+    diverge in pool state: only a single-shard driver engine accepts a
+    warm import; peers/followers return 0 without touching the ref."""
+    host = StreamingEngineHost()
+    host._engine = DecodeEngine(ShardedTokenLM.make(3), _engine_cfg(),
+                                "solo")
+    try:
+        assert host.import_prefix_pages({"ref": None}) == 0
+        assert host.import_prefix_pages("junk") == 0
+        host._engine._peers = [object()]  # now "a gang leader"
+        assert host.import_prefix_pages({"ref": object()}) == 0
+        host._engine._peers = []
+        host._engine._driver = False  # now "a follower rank"
+        assert host.import_prefix_pages({"ref": object()}) == 0
+    finally:
+        host._engine._driver = True
+        host._engine.close()
+
+
+def test_engine_session_lru_eviction_feedback():
+    """The session cache is a bounded LRU: exceeding session_cache_max
+    evicts oldest-first and the evicted names surface exactly once via
+    drain_evicted_sessions (the router unpins them from this replica)."""
+    eng = DecodeEngine(ShardedTokenLM.make(3),
+                       _engine_cfg(session_cache_max=1), "lru")
+    try:
+        for sess in ("s1", "s2"):
+            _drain(eng.channel(eng.submit([3, 5], 4, session=sess)),
+                   scale_timeout(20))
+        deadline = time.monotonic() + scale_timeout(10)
+        evicted: list = []
+        while not evicted and time.monotonic() < deadline:
+            evicted = eng.drain_evicted_sessions()
+            time.sleep(0.02)
+        assert evicted == ["s1"]
+        assert eng.drain_evicted_sessions() == []  # drained once
+    finally:
+        eng.close()
+
+
+# ---------------------------------------------------------------------------
+# router tier: prefix-aware pick, LRU bounds, eviction feedback
+# ---------------------------------------------------------------------------
+
+
+class _Handle:
+    def __init__(self, key: bytes):
+        self._actor_id = types.SimpleNamespace(binary=lambda: key)
+
+
+def _bare_router() -> Router:
+    r = Router.__new__(Router)
+    r._lock = threading.Lock()
+    from collections import OrderedDict
+    r._sessions = OrderedDict()
+    r._prefixes = OrderedDict()
+    r._inflight = {}
+    r._affinity_hits = r._affinity_misses = 0
+    r._prefix_hits = r._prefix_misses = 0
+    r._sessions_pruned = 0
+    return r
+
+
+def test_router_prefix_pick_longest_first_and_feedback():
+    """Pick order: sticky session beats prefix index beats least
+    loaded; prefix probes run LONGEST hash first so a deep match on one
+    replica beats a shallow match on another; stream_open feedback
+    populates the index and prunes evicted sessions only while they
+    still point at the evicting replica."""
+    r = _bare_router()
+    h1, h2 = _Handle(b"r1"), _Handle(b"r2")
+    state = {"backends": {"be": {"replicas": [h1, h2]}}}
+    cfg = {"router_session_cap": 64, "router_prefix_cap": 64}
+
+    # cold: least-loaded fallback sticks the session
+    r._inflight = {b"r1": 3, b"r2": 1}
+    assert r._pick_stream_replica(state, "be", "sess",
+                                  ["ha", "hb"], cfg) is h2
+    assert r._sessions["sess"] == b"r2" and r._prefix_misses == 1
+
+    # feedback: r1 now holds [ha, hb], r2 holds only [ha]
+    r._note_stream_meta(b"r1", {"prefix_hashes": ["ha", "hb"]}, cfg)
+    r._note_stream_meta(b"r2", {"prefix_hashes": ["ha"]}, cfg)
+    # wait: ha now maps to r2 (last writer) but hb -> r1; longest-first
+    # means the 2-page prompt goes to r1, the deeper match
+    assert r._pick_stream_replica(state, "be", None,
+                                  ["ha", "hb"], cfg) is h1
+    assert r._prefix_hits == 1
+    # a 1-page prompt matches ha -> r2
+    assert r._pick_stream_replica(state, "be", None, ["ha"], cfg) is h2
+
+    # sticky session still wins over the prefix index
+    assert r._pick_stream_replica(state, "be", "sess",
+                                  ["ha", "hb"], cfg) is h2
+
+    # eviction feedback: r1 reporting "sess" evicted must NOT unpin it
+    # (it points at r2); r2 reporting it does
+    r._note_stream_meta(b"r1", {"evicted_sessions": ["sess"]}, cfg)
+    assert "sess" in r._sessions
+    r._note_stream_meta(b"r2", {"evicted_sessions": ["sess"]}, cfg)
+    assert "sess" not in r._sessions and r._sessions_pruned == 1
+
+    # a dead replica's index entry is skipped, not returned
+    state = {"backends": {"be": {"replicas": [h2]}}}
+    assert r._pick_stream_replica(state, "be", None,
+                                  ["hb"], cfg) is h2  # hb->r1 is gone
+
+
+def test_router_bounds_sessions_and_prefixes_lru():
+    """Both router tables are LRU-bounded by config: overflowing the
+    session cap prunes oldest-first (counted), overflowing the prefix
+    cap drops the oldest hash."""
+    r = _bare_router()
+    for i in range(5):
+        r._stick(f"s{i}", b"r1", cap=3)
+    assert list(r._sessions) == ["s2", "s3", "s4"]
+    assert r._sessions_pruned == 2
+    r._note_stream_meta(b"r1", {"prefix_hashes":
+                                [f"h{i}" for i in range(6)]},
+                        {"router_prefix_cap": 4})
+    assert list(r._prefixes) == ["h2", "h3", "h4", "h5"]
+
+
+# ---------------------------------------------------------------------------
+# controller tier: the KV-pressure autoscale signal
+# ---------------------------------------------------------------------------
+
+
+def test_controller_kv_desired_math():
+    """_kv_desired is pure over (_kv_stats, auto): no/stale/disabled
+    signal -> 0 (no opinion); flat occupancy -> current need; a growing
+    ring extrapolates kv_horizon_s ahead."""
+    from ray_tpu.serve.controller import ServeController
+
+    fake = types.SimpleNamespace(_kv_stats={}, KV_POLL_TTL_S=2.0)
+    auto = {"kv_target_util": 0.8, "kv_horizon_s": 0.0}
+    call = ServeController._kv_desired
+    assert call(fake, "be", auto) == 0  # no samples yet
+    assert call(fake, "be", {**auto, "kv_target_util": 0}) == 0
+
+    now = time.monotonic()
+    fake._kv_stats["be"] = {"in_use": 700, "pages_total": 1000,
+                            "replicas": 1, "ts": now,
+                            "ring": [(now, 700.0)]}
+    assert call(fake, "be", auto) == 1  # 700 < 800 target
+    fake._kv_stats["be"]["in_use"] = 900
+    assert call(fake, "be", auto) == 2  # 900 / (1000*0.8) -> 2
+
+    # growth: 100 -> 500 pages over 1s, 10s horizon -> 4500 predicted
+    fake._kv_stats["be"] = {"in_use": 500, "pages_total": 1000,
+                            "replicas": 1, "ts": now,
+                            "ring": [(now - 1.0, 100.0), (now, 500.0)]}
+    assert call(fake, "be",
+                {"kv_target_util": 0.8, "kv_horizon_s": 10.0}) == 6
+
+    # stale sample: older than 3x the poll TTL -> no opinion
+    fake._kv_stats["be"]["ts"] = now - 100.0
+    assert call(fake, "be", auto) == 0
+
+
+@pytest.fixture
+def serve_client(ray_start_regular):
+    client = serve.start()
+    try:
+        yield client
+    finally:
+        serve.shutdown()
+
+
+def test_kv_pressure_scales_up_without_queue_signal(serve_client):
+    """Session-held KV pages scale the fleet even with an EMPTY queue:
+    fill the pool past kv_target_util with retained session tables
+    (target_queued set unreachably high so queue depth never asks for
+    more) and the autoscale tick must still add a replica."""
+    client = serve_client
+    margs = _model_args(3)
+    client.create_backend("kvp", ShardedTokenLM, *margs, config={
+        "streaming": True, "num_replicas": 1, "max_decode_batch": 4,
+        "kv_page_size": 4, "kv_pages_total": 32,
+        "session_cache_max": 16,
+        "autoscaling": AutoscalingConfig(
+            min_replicas=1, max_replicas=3, target_queued=1000.0,
+            downscale_delay_s=60.0, kv_target_util=0.5,
+            kv_horizon_s=0.0).to_dict()})
+    client.create_endpoint("kvp", backend="kvp")
+    handle = client.get_handle("kvp")
+    with state_dump_on_failure("kv-pressure-scaleup"):
+        # 6 sessions x ~5 pages (16-token prompt + 4 generated, page 4)
+        # ~= 30/32 pages held; 30 > 32 * 0.5 -> kv_want 2
+        for i in range(6):
+            toks = list(handle.stream(
+                {"prompt": [(i % 7) + 1] * 16, "max_tokens": 4,
+                 "session": f"s{i}"}, timeout=scale_timeout(30)))
+            assert toks
+        deadline = time.monotonic() + scale_timeout(30)
+        while time.monotonic() < deadline:
+            if client.get_backend_config("kvp").num_replicas >= 2:
+                break
+            time.sleep(0.3)
+        assert client.get_backend_config("kvp").num_replicas >= 2, (
+            "KV pressure never scaled the fleet (queue was idle by "
+            "construction, so only the KV signal could)")
+
+
+# ---------------------------------------------------------------------------
+# doctor tier: the prefix_cold finding (pure diagnose)
+# ---------------------------------------------------------------------------
+
+
+def test_doctor_prefix_cold_finding_unit():
+    """A hot-but-never-hitting prefix tree (lookups >= threshold, 0
+    hits, nodes indexed) is the mis-aligned-page-hashing signature; a
+    single hit or a quiet tree must NOT fire."""
+    from ray_tpu._private import debug_state
+
+    def snap(lookups, hits, nodes=4):
+        return {"driver": {"pid": 1, "component": {"engine": {
+            "backend": "chatbe", "kv": {"prefix": {
+                "enabled": True, "nodes": nodes, "lookups": lookups,
+                "hits": hits}}}}}}
+
+    findings = debug_state.diagnose(snap(64, 0), {})
+    cold = [f for f in findings if f["kind"] == "prefix_cold"]
+    assert len(cold) == 1
+    assert cold[0]["stage"] == "kv_prefix"
+    assert cold[0]["name"] == "chatbe"
+    assert "mis-aligned" in cold[0]["detail"]
+    for quiet in (snap(64, 1), snap(3, 0), snap(64, 0, nodes=0)):
+        assert not any(f["kind"] == "prefix_cold"
+                       for f in debug_state.diagnose(quiet, {}))
+
+
+# ---------------------------------------------------------------------------
+# chaos: gang killed mid-decode with shared prefix pages live
+# ---------------------------------------------------------------------------
+
+_CHAOS_SEEDS = [411, 412]
+
+_CHAOS_TYPED = (exc.ReplicaGroupDied, exc.ActorDiedError,
+                exc.ActorUnavailableError, exc.SequenceAborted)
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+@pytest.mark.parametrize("seed", _CHAOS_SEEDS)
+def test_chaos_gang_kill_with_shared_prefix_pages(seed):
+    """Kill a gang member mid-decode WHILE the prefix tree holds live
+    shared pages (multiple streams adopted the same prefix): every open
+    stream dies typed, the gang restarts, post-restart decode is
+    bit-exact, and the fresh engines hold zero pages with an empty leak
+    report — refcounted sharing must not turn a crash into a leak."""
+    import random
+
+    rng = random.Random(seed)
+    num_shards = 2
+    victim_rank = rng.randrange(num_shards)
+    nth = rng.randint(3, 9)
+    budget = scale_timeout(90)
+    margs = _model_args(seed)
+    prefix = [(seed + i) % 31 + 1 for i in range(8)]  # 2 pages @ 4
+    ref = ShardedTokenLM.make(seed).generate(prefix + [1], 6)
+    ray_tpu.init(num_cpus=8)
+    client = None
+    try:
+        client = serve.start()
+        client.create_backend(
+            "chpfx", ShardedTokenLM, *margs,
+            config=BackendConfig(
+                streaming=True, num_shards=num_shards,
+                max_decode_batch=4, kv_page_size=4, kv_pages_total=64,
+                prefix_index_max_nodes=16,
+                shard_group_timeout_s=scale_timeout(5)))
+        client.create_endpoint("chpfx_ep", backend="chpfx")
+        handle = client.get_handle("chpfx_ep")
+        with state_dump_on_failure(f"prefix-chaos-seed{seed}"):
+            # seed the tree, then prove pages are SHARED before the kill
+            assert list(handle.stream({"prompt": prefix + [1],
+                                       "max_tokens": 6},
+                                      timeout=budget)) == ref
+            assert list(handle.stream({"prompt": prefix + [2],
+                                       "max_tokens": 6},
+                                      timeout=budget))
+            gangs = ray_tpu.get(
+                client._controller.get_gang_members.remote("chpfx"),
+                timeout=scale_timeout(30))
+            leader_kv = ray_tpu.get(gangs[0][0].engine_state.remote(),
+                                    timeout=scale_timeout(30))["kv"]
+            assert leader_kv["prefix"]["hits"] >= 1, leader_kv
+
+            victim = gangs[0][victim_rank]
+            ray_tpu.get(victim.arm_failpoint.remote(
+                "serve.decode_step", "exit", nth=nth),
+                timeout=scale_timeout(30))
+            outcomes: list = [None] * 3
+
+            def one(i):
+                try:
+                    toks = list(handle.stream(
+                        {"prompt": prefix + [i + 3],
+                         "max_tokens": 100000}, timeout=budget))
+                    outcomes[i] = ("finished?", len(toks))
+                except _CHAOS_TYPED as e:
+                    outcomes[i] = ("typed", e)
+                except TimeoutError as e:
+                    outcomes[i] = ("timeout", e)
+                except RuntimeError as e:
+                    outcomes[i] = ("typed", e)
+
+            threads = [threading.Thread(target=one, args=(i,))
+                       for i in range(3)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=budget + scale_timeout(30))
+            assert not any(t.is_alive() for t in threads), outcomes
+            kinds = [o[0] for o in outcomes if o]
+            assert "timeout" not in kinds, outcomes
+            assert "typed" in kinds, (
+                f"[seed={seed}] the armed kill never surfaced")
+
+            deadline = time.monotonic() + budget
+            while True:
+                try:
+                    out = list(handle.stream(
+                        {"prompt": prefix + [1], "max_tokens": 6},
+                        timeout=scale_timeout(20)))
+                    break
+                except (_CHAOS_TYPED + (TimeoutError, RuntimeError)):
+                    assert time.monotonic() < deadline, (
+                        f"[seed={seed}] gang never came back")
+                    time.sleep(0.5)
+            assert out == ref
+            fresh = ray_tpu.get(
+                client._controller.get_gang_members.remote("chpfx"),
+                timeout=scale_timeout(30))
+            deadline = time.monotonic() + scale_timeout(30)
+            while True:
+                states = ray_tpu.get(
+                    [m.engine_state.remote() for m in fresh[0]],
+                    timeout=scale_timeout(30))
+                if all(s["kv"]["pages_in_use"] == 0 for s in states):
+                    break
+                assert time.monotonic() < deadline, (
+                    f"[seed={seed}] leaked KV pages: "
+                    f"{[s['kv'] for s in states]}")
+                time.sleep(0.3)
+            assert all(s["kv_leaked"] == [] for s in states)
+    finally:
+        if client is not None:
+            client.shutdown()
+        ray_tpu.shutdown()

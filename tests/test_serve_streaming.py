@@ -668,6 +668,40 @@ def test_microbench_serve_stream_gate():
         f"below request-level {reqlvl['tokens_per_s_per_replica']}")
 
 
+def test_microbench_serve_prefix_gate():
+    """The recorded prefix-sharing rows must show the KV economy doing
+    its job on the shared workload: a real hit rate (nearly every
+    admission after the first adopts), tokens saved ~= hits x prefix
+    length, and TTFT p99 (and throughput) no worse than the per-session
+    baseline that re-prefills the prefix every time."""
+    import os
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    doc = json.load(open(os.path.join(root, "MICROBENCH.json")))
+    rows = {r["name"]: r for r in doc["results"]}
+    for name in ("serve_prefix shared",
+                 "serve_prefix per-session baseline"):
+        assert name in rows, f"missing {name!r} row in MICROBENCH.json"
+    shared = rows["serve_prefix shared"]
+    base = rows["serve_prefix per-session baseline"]
+    assert shared["generations"] > 0 and base["generations"] > 0
+    assert shared["prefix_hits"] > 0
+    assert shared["prefix_hit_rate"] > 0.5, (
+        f"shared workload barely hit the prefix index: "
+        f"{shared['prefix_hit_rate']}")
+    assert shared["prefix_tokens_saved"] >= \
+        shared["prefix_hits"] * shared["prefix_tokens"], (
+        "tokens saved fell below hits x prefix length — partial "
+        "adoptions on a fully shared prefix")
+    assert shared["ttft_p99_ms"] <= base["ttft_p99_ms"], (
+        f"prefix sharing made tail TTFT WORSE: shared p99 "
+        f"{shared['ttft_p99_ms']}ms vs baseline {base['ttft_p99_ms']}ms")
+    assert shared["tokens_per_s_per_replica"] >= \
+        base["tokens_per_s_per_replica"], (
+        f"shared arm throughput {shared['tokens_per_s_per_replica']} "
+        f"below baseline {base['tokens_per_s_per_replica']}")
+
+
 # ---------------------------------------------------------------------------
 # seeded chaos: member killed mid-decode under open streams (slow tier)
 # ---------------------------------------------------------------------------
