@@ -144,6 +144,25 @@ class Config:
     tpu_slice_hosts: int = 1
     tpu_chips_per_host: int = 4
 
+    # --- elastic membership ---
+    # Graceful drain budget: a DRAINING raylet keeps serving its
+    # in-flight leases and migrating plasma objects to survivors for at
+    # most this long; whatever is still running at the deadline is
+    # reclaimed through the normal typed lease machinery (exactly the
+    # crash path, but scoped to the leftovers).
+    drain_deadline_s: float = 30.0
+    # Compressed-drain budget on a preemption notice (TPU spot gives
+    # seconds, not minutes): actor/gang checkpoints run first, object
+    # migration is best-effort inside whatever remains of this window.
+    preempt_drain_deadline_s: float = 5.0
+    # Cap on concurrent object migrations pushed off a draining node
+    # (each is a striped pull on the survivor; bounding it keeps the
+    # bulk channel from thundering-herding the survivors).
+    drain_migrate_concurrency: int = 4
+    # Grace past the drain deadline before the GCS heartbeat checker may
+    # declare a DRAINING node DEAD (covers the final migrate/ack RTT).
+    drain_grace_s: float = 5.0
+
     # --- rpc ---
     rpc_connect_timeout_s: float = 10.0
     rpc_call_timeout_s: float = 0.0  # 0 = no timeout

@@ -339,6 +339,7 @@ class CoreWorker:
             "add_borrow": self.h_add_borrow,
             "remove_borrow": self.h_remove_borrow,
             "exit": self.h_exit,
+            "checkpoint_actor": self.h_checkpoint_actor,
             "cancel_task": self.h_cancel_task,
             "get_stats": self.h_get_stats,
             "debug_state": self.h_debug_state,
@@ -2943,6 +2944,12 @@ class CoreWorker:
                                           kind="cls")
                 self._actor_instance = cls(*args, **kwargs)
                 self._actor_id = ActorID(spec["actor_id"])
+                if spec.get("restore"):
+                    # relocated/restarted incarnation: a drained-away
+                    # checkpoint may be waiting in the GCS KV (written by
+                    # the departing raylet) — restore it before the
+                    # actor takes traffic
+                    self._maybe_restore_actor(spec)
                 creation = spec.get("actor_creation") or {}
                 if creation.get("max_concurrency", 1) > 1:
                     self._exec_pool = concurrent.futures.ThreadPoolExecutor(
@@ -2978,6 +2985,26 @@ class CoreWorker:
             return self._pack_error(spec, error)
         finally:
             self._task_ctx.task_id = None
+
+    def _maybe_restore_actor(self, spec):
+        """Restore drained-away actor state: fetch actor_ckpt:<id> from
+        the GCS KV and feed it to the actor's __ray_restore__ hook.
+        Missing checkpoint or missing hook -> stateless restart (the
+        pre-drain behavior); a failing hook is surfaced as a creation
+        error so the GCS records a real death cause."""
+        hook = getattr(self._actor_instance, "__ray_restore__", None)
+        if not callable(hook):
+            return
+        key = f"actor_ckpt:{ActorID(spec['actor_id']).hex()}"
+        try:
+            data = self._io.run(self.gcs.call("kv_get", {"key": key}),
+                                timeout=10)
+        except Exception:
+            logger.warning("checkpoint lookup for %s failed; restarting "
+                           "stateless", key)
+            return
+        if data is not None:
+            hook(serialization.loads(data))
 
     def _run_callable(self, fn, args, kwargs):
         import inspect
@@ -3035,6 +3062,22 @@ class CoreWorker:
             {"kind": "inline", "data": payload, "err": True}
             for _ in range(max(spec["num_returns"], 1))
         ], "error_repr": str(error)}
+
+    async def h_checkpoint_actor(self, conn, d):
+        """Drain-time state snapshot (raylet-driven): run the actor's
+        __ray_checkpoint__() hook and hand the pickled result back —
+        the raylet lands it in the GCS KV and the relocated incarnation
+        restores it via __ray_restore__. Actors without the hook return
+        None and relocate stateless. In a normal drain the raylet has
+        already waited out in-flight leases, so the hook runs on a
+        quiet actor; under a compressed preemption drain it may race a
+        running method — that's the documented best-effort tradeoff."""
+        actor = self._actor_instance
+        hook = getattr(actor, "__ray_checkpoint__", None)
+        if actor is None or not callable(hook):
+            return {"state": None}
+        state = await asyncio.get_running_loop().run_in_executor(None, hook)
+        return {"state": serialization.dumps(state)}
 
     async def h_exit(self, conn, d):
         self._exiting = True

@@ -3,9 +3,14 @@ python/ray/autoscaler/_private/autoscaler.py:51 StandardAutoscaler.update:
 read load metrics, launch when demand outstrips capacity, reap idle
 nodes after idle_timeout).
 
-Demand signal: each raylet's `raylet.pending_leases` gauge (work queued
-because the node can't place it now) via the control-plane RPC layer —
-the same numbers `ray-tpu metrics` shows."""
+Demand signal: the director's metrics-history rings (one
+`get_metrics_history` call per reconcile — the raylets already push
+their gauges on the heartbeat piggyback, so the autoscaler fans out to
+ZERO nodes). Scale-down goes through the elastic-membership drain:
+an idle node is asked to DRAIN (migrate objects, finish leases,
+checkpoint actors) and the provider terminates the machine only after
+the GCS finalized it as DRAINED — never a non-drained node.
+"""
 
 from __future__ import annotations
 
@@ -21,7 +26,9 @@ class StandardAutoscaler:
                  min_workers: int = 0, max_workers: int = 4,
                  idle_timeout_s: float = 30.0,
                  upscaling_speed: float = 1.0,
-                 worker_node_config: dict | None = None):
+                 worker_node_config: dict | None = None,
+                 metrics_window: int = 5,
+                 drain_grace_s: float | None = None):
         self.provider = provider
         self.gcs_address = gcs_address
         self.min_workers = min_workers
@@ -29,18 +36,39 @@ class StandardAutoscaler:
         self.idle_timeout_s = idle_timeout_s
         self.upscaling_speed = max(0.1, upscaling_speed)
         self.worker_node_config = dict(worker_node_config or {})
+        # how many history samples (one per ~2s raylet push) the busy/
+        # idle predicate looks back over
+        self.metrics_window = max(1, metrics_window)
+        if drain_grace_s is None:
+            from ray_tpu._private.config import get_config
+
+            cfg = get_config()
+            drain_grace_s = cfg.drain_deadline_s + 2 * cfg.drain_grace_s
+        # give-up window for a wedged drain: past it the GCS heartbeat
+        # checker has long since declared the node dead, so terminating
+        # the machine is reaping a corpse, not killing a live node
+        self.drain_give_up_s = drain_grace_s
         self._idle_since: dict[str, float] = {}
         self._provider_started: set[str] = set()
+        # provider id -> drain start (monotonic); a draining node is
+        # neither capacity nor a reap candidate until it finalizes
+        self._draining: dict[str, float] = {}
 
     # -- cluster introspection -------------------------------------------
 
-    def _rpc(self, address: str, method: str, data=None):
+    def _rpc_many(self, address: str, calls: list[tuple[str, dict]]):
+        """One connection, N calls — the reconcile loop must not redial
+        the director per question (the old per-node fan-out opened a
+        fresh conn per metric read)."""
         from ray_tpu._private import rpc
 
         async def _go():
             conn = await rpc.connect(address, name="autoscaler", timeout=5)
             try:
-                return await conn.call(method, data or {}, timeout=10)
+                out = []
+                for method, data in calls:
+                    out.append(await conn.call(method, data, timeout=10))
+                return out
             finally:
                 await conn.close()
 
@@ -48,48 +76,82 @@ class StandardAutoscaler:
 
     def load(self) -> dict:
         """-> {"pending": total queued leases, "idle_nodes": [...],
-        "nodes": [...]} from live cluster state."""
-        nodes = self._rpc(self.gcs_address, "get_all_nodes")
+        "nodes": [...]} from ONE director round trip: the node table
+        plus the metrics-history rings the raylets feed via their
+        heartbeat piggyback (no per-node RPC fan-out)."""
+        nodes, history = self._rpc_many(self.gcs_address, [
+            ("get_all_nodes", {}),
+            ("get_metrics_history", {"samples": self.metrics_window}),
+        ])
         pending = 0
         idle_nodes = []
         for n in nodes:
-            try:
-                snap = self._rpc(n["address"], "get_metrics")
-            except Exception:
-                continue
-            pending += int(snap.get("raylet.pending_leases",
-                                    {}).get("value", 0))
-            busy = (snap.get("raylet.pending_leases", {}).get("value", 0)
-                    or self._node_busy(snap))
-            if not n.get("is_head") and not busy:
+            series = history.get(f"{n['node_id'].hex()[:8]}/raylet")
+            if not series:
+                continue  # no samples yet: too young to judge
+            ring = series.get("raylet.pending_leases") or []
+            if ring:
+                pending += int(ring[-1][1])
+            if (not n.get("is_head") and n.get("state") == "ALIVE"
+                    and not self._node_busy(series)):
                 idle_nodes.append(n)
         return {"pending": pending, "idle_nodes": idle_nodes,
                 "nodes": nodes}
 
     @staticmethod
-    def _node_busy(snap: dict) -> bool:
-        total = snap.get("raylet.num_workers", {}).get("value", 0)
-        # Leased (busy) workers aren't in the idle pools; approximation:
-        # any outstanding lease keeps the node non-idle via pending check
-        # above, so here only object residency pins a node.
-        return snap.get("raylet.local_objects", {}).get("value", 0) > 0
+    def _node_busy(series: dict) -> bool:
+        """A node is busy iff, anywhere in the lookback window, it had
+        queued leases, granted leases still out (tasks running / actors
+        resident), or live transfer pins (it is actively serving object
+        bytes to a puller). Resident plasma objects deliberately do NOT
+        pin a node anymore: the drain path migrates them to survivors,
+        so object residency is a drain cost, not a reap blocker."""
+        for name in ("raylet.pending_leases", "raylet.active_leases",
+                     "raylet.transfer_pins"):
+            if any(v > 0 for _, v in series.get(name) or ()):
+                return True
+        return False
 
     # -- the reconciliation step (reference: autoscaler.py update) -------
 
     def update(self) -> dict:
-        """One reconcile step; returns {"launched": n, "terminated": n}."""
+        """One reconcile step; returns {"launched", "draining",
+        "terminated"}."""
         now = time.monotonic()
         launched = terminated = 0
         load = self.load()
         workers = self.provider.non_terminated_nodes()
+        by_node8 = {n["node_id"].hex()[:8]: n for n in load["nodes"]}
+
+        # Finalize in-flight drains: once the node left the GCS table
+        # (DRAINED — or DEAD if the drain wedged and the heartbeat
+        # checker reaped it) the machine is a corpse and the provider
+        # may terminate it. Never before.
+        for pid, started in list(self._draining.items()):
+            node8 = self._node8_of(pid)
+            if node8 is not None and node8 in by_node8:
+                if now - started <= self.drain_give_up_s:
+                    continue  # still draining, inside its budget
+                # wedged past deadline+grace: the GCS is about to (or
+                # already did) declare it dead; fall through and reap
+                logger.warning("drain of %s wedged for %.0fs; reaping",
+                               pid, now - started)
+            self._draining.pop(pid, None)
+            if pid in workers:
+                self.provider.terminate_node(pid)
+                workers.remove(pid)
+                terminated += 1
+                logger.info("autoscaler terminated drained node %s", pid)
+
+        active_workers = [p for p in workers if p not in self._draining]
 
         # Scale up: queued-but-unplaceable work means capacity is short.
         deficit = 0
         if load["pending"] > 0:
             deficit = max(1, int(load["pending"] * self.upscaling_speed))
-        if len(workers) < self.min_workers:
-            deficit = max(deficit, self.min_workers - len(workers))
-        room = self.max_workers - len(workers)
+        if len(active_workers) < self.min_workers:
+            deficit = max(deficit, self.min_workers - len(active_workers))
+        room = self.max_workers - len(active_workers)
         to_launch = min(deficit, room)
         if to_launch > 0:
             ids = self.provider.create_node(self.worker_node_config,
@@ -98,33 +160,54 @@ class StandardAutoscaler:
             launched = len(ids)
             logger.info("autoscaler launched %d node(s): %s", launched, ids)
 
-        # Scale down: provider-managed nodes idle past the timeout.
+        # Scale down THROUGH DRAIN: provider-managed nodes idle past the
+        # timeout start a graceful drain; termination happens on a later
+        # reconcile, after the GCS finalized the departure.
         idle_addrs = {n["address"] for n in load["idle_nodes"]}
-        for pid in list(workers):
-            # A provider node is idle if every cluster node it maps to is
-            # idle; LocalNodeProvider ids embed the raylet node id.
-            node = self._match(pid, load["nodes"])
+        for pid in list(active_workers):
+            node = self._node_for(pid, by_node8)
             if node is None:
                 continue
             if node["address"] in idle_addrs:
                 first = self._idle_since.setdefault(pid, now)
                 if (now - first >= self.idle_timeout_s
-                        and len(workers) > self.min_workers):
-                    self.provider.terminate_node(pid)
-                    workers.remove(pid)
-                    self._idle_since.pop(pid, None)
-                    terminated += 1
-                    logger.info("autoscaler reaped idle node %s", pid)
+                        and len(active_workers) > self.min_workers):
+                    if self._start_drain(pid, node):
+                        active_workers.remove(pid)
+                        self._idle_since.pop(pid, None)
             else:
                 self._idle_since.pop(pid, None)
-        return {"launched": launched, "terminated": terminated}
+        return {"launched": launched, "draining": len(self._draining),
+                "terminated": terminated}
 
-    @staticmethod
-    def _match(provider_id: str, nodes: list[dict]):
-        for n in nodes:
-            if n["node_id"].hex()[:8] in provider_id:
-                return n
-        return None
+    def _start_drain(self, pid: str, node: dict) -> bool:
+        try:
+            reply, = self._rpc_many(self.gcs_address, [
+                ("drain_node", {"node_id": node["node_id"]})])
+        except Exception:
+            logger.warning("drain request for %s failed; retrying next "
+                           "reconcile", pid)
+            return False
+        if reply.get("state") not in ("DRAINING", "DRAINED"):
+            return False
+        self._draining[pid] = time.monotonic()
+        logger.info("autoscaler draining idle node %s (deadline %.0fs)",
+                    pid, reply.get("deadline_s") or 0.0)
+        return True
+
+    # -- provider id <-> raylet node id ----------------------------------
+    # The provider records the raylet node id at create time (and
+    # `record_node_id` covers externally-registered nodes), replacing
+    # the old `node_id.hex()[:8] in provider_id` substring sniffing —
+    # which broke for any provider whose ids don't embed the node id.
+
+    def _node8_of(self, pid: str) -> str | None:
+        node_id = self.provider.node_id_of(pid)
+        return node_id.hex()[:8] if node_id is not None else None
+
+    def _node_for(self, pid: str, by_node8: dict):
+        node8 = self._node8_of(pid)
+        return by_node8.get(node8) if node8 is not None else None
 
     def run(self, interval_s: float = 5.0, stop_event=None):
         """Loop update() until stop_event is set (reference: the monitor

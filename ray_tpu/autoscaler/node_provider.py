@@ -15,6 +15,15 @@ class NodeProvider:
     """reference: node_provider.py:12 — minimal surface the autoscaler
     drives."""
 
+    def __init__(self):
+        # provider id -> raylet node id, recorded when the raylet
+        # identity becomes known (at create_node for providers that
+        # start the process themselves, via record_node_id for ones
+        # whose machines register on their own). The autoscaler keys
+        # every provider<->cluster correlation off this map — provider
+        # ids are opaque and need not embed the node id.
+        self._node_ids: dict[str, bytes] = {}
+
     def non_terminated_nodes(self) -> list[str]:
         raise NotImplementedError
 
@@ -23,6 +32,12 @@ class NodeProvider:
 
     def terminate_node(self, node_id: str) -> None:
         raise NotImplementedError
+
+    def record_node_id(self, provider_id: str, node_id: bytes) -> None:
+        self._node_ids[provider_id] = node_id
+
+    def node_id_of(self, provider_id: str) -> bytes | None:
+        return self._node_ids.get(provider_id)
 
     def node_tags(self, node_id: str) -> dict:
         return {}
@@ -37,6 +52,7 @@ class LocalNodeProvider(NodeProvider):
     drive (real process lifecycle, no cloud)."""
 
     def __init__(self, gcs_address: str, session_dir: str):
+        super().__init__()
         self.gcs_address = gcs_address
         self.session_dir = session_dir
         self._nodes: dict[str, object] = {}  # provider id -> ServiceProcess
@@ -57,6 +73,7 @@ class LocalNodeProvider(NodeProvider):
                 resources=node_config.get("resources"))
             pid = f"local-{node_id.hex()[:8]}"
             self._nodes[pid] = svc
+            self.record_node_id(pid, node_id)
             out.append(pid)
         return out
 
@@ -77,6 +94,7 @@ class TPUPodProvider(NodeProvider):
     "zone": ..., "project": ...}."""
 
     def __init__(self, client=None):
+        super().__init__()
         self._client = client
         self._requests: dict[str, dict] = {}
 

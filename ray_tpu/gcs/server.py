@@ -52,6 +52,21 @@ M_PLACEMENT_SCORE_S = _stats.Histogram(
     "one placement decision: strategy dispatch + candidate scoring in "
     "_place_bundles (every strategy — the PACK-vs-ICI_RING latency A/B "
     "reads this histogram per arm)")
+M_PREEMPT_NOTICES = _stats.Count(
+    "gcs.preemption_notices_total",
+    "preemption notices received (node.preempt_notice failpoint or "
+    "drain --preempt) — each starts a compressed drain; a notice on an "
+    "already-draining node is counted but idempotent")
+M_RING_REPLACEMENTS = _stats.Count(
+    "gcs.ring_replacements_total",
+    "ICI_RING placements scored around a torus hole (>=1 masked "
+    "DRAINING or recently-departed coord) — gang re-placements after "
+    "a drain/preemption")
+
+# How long a departed node's torus coords stay visible as masked_coords
+# in new ICI_RING plans (re-placements around the hole are recorded and
+# counted within this window; a re-registration clears the hole early).
+_DEPARTED_COORD_TTL_S = 300.0
 
 # Actor states (reference: src/ray/protobuf/gcs.proto ActorTableData.ActorState)
 DEPENDENCIES_UNREADY = "DEPENDENCIES_UNREADY"
@@ -102,6 +117,11 @@ class GcsServer:
         # when membership changes, so per-decision scoring cost stays in
         # the PACK arm's latency bucket (the <=5% A/B gate)
         self._topo_cache: tuple[dict, list] | None = None
+        # node8 -> (departed_ts, topology dict) for coord-bearing nodes
+        # that drained or died: ICI_RING plans stamp these as
+        # masked_coords so re-placement around the torus hole stays
+        # visible in the placement record after the node is gone
+        self._departed_coords: dict[str, tuple[float, dict]] = {}
         self.server = rpc.Server(self._handlers(), on_disconnect=self._on_disconnect,
                                  name="gcs")
         self._pending_actor_queue: list[bytes] = []
@@ -228,6 +248,7 @@ class GcsServer:
             "get_all_nodes": self.h_get_all_nodes,
             "get_available_resources": self.h_get_available_resources,
             "drain_node": self.h_drain_node,
+            "node_drained": self.h_node_drained,
             "register_job": self.h_register_job,
             "register_actor": self.h_register_actor,
             "get_actor": self.h_get_actor,
@@ -442,6 +463,8 @@ class GcsServer:
         rejoining = node_id in self.nodes  # redial after a GCS restart
         self.nodes[node_id] = info
         self._topo_cache = None
+        # a re-registering node fills its own torus hole
+        self._departed_coords.pop(node_id.hex()[:8], None)
         self.available[node_id] = ResourceSet.from_raw(
             d.get("available", d["resources"]))
         self.last_heartbeat[node_id] = time.monotonic()
@@ -524,11 +547,121 @@ class GcsServer:
         load-aware spillback (reference: the scheduler's cluster resource
         view fed by resource usage broadcast, cluster_resource_scheduler.cc:217)."""
         return {node_id: avail.raw()
-                for node_id, avail in self.available.items()}
+                for node_id, avail in self.available.items()
+                # DRAINING nodes are leaving — spillback must not target
+                # them, so they simply vanish from this view
+                if self.nodes.get(node_id, {}).get("state") == "ALIVE"}
 
     async def h_drain_node(self, conn, d):
-        await self._remove_node(d["node_id"], reason="drained")
+        """Start (or report) a graceful drain: ALIVE -> DRAINING here;
+        the raylet then migrates its plasma objects to survivors,
+        finishes in-flight leases (bounded by the deadline), checkpoints
+        restartable actor state, calls node_drained and exits — so the
+        node finalizes DRAINED, never tripping the crash path. `preempt`
+        compresses the deadline (checkpoints first, objects best-effort)
+        and counts a preemption notice. Idempotent: a second drain call
+        or a notice on an already-draining node reports the in-progress
+        state without restarting anything."""
+        node_id = d["node_id"]
+        info = self.nodes.get(node_id)
+        preempt = bool(d.get("preempt"))
+        if preempt:
+            M_PREEMPT_NOTICES.inc()
+        if info is None:
+            return {"state": "UNKNOWN"}
+        if info["state"] == "DRAINING":
+            return {"state": "DRAINING",
+                    "deadline_s": info.get("drain_deadline_s")}
+        deadline_s = d.get("deadline_s")
+        if deadline_s is None:
+            deadline_s = (self.config.preempt_drain_deadline_s if preempt
+                          else self.config.drain_deadline_s)
+        info["state"] = "DRAINING"
+        info["drain_deadline_s"] = float(deadline_s)
+        info["drain_preempt"] = preempt
+        info["drain_started"] = time.time()
+        self._persist("nodes", node_id, info)
+        from ray_tpu._private.events import WARNING
+
+        self._event(WARNING, "NODE_DRAINING",
+                    f"node {node_id.hex()[:8]} draining "
+                    f"({'preempt' if preempt else 'planned'}, "
+                    f"deadline {float(deadline_s):.1f}s)",
+                    node_id=node_id.hex(), preempt=preempt)
+        # "updated" (not "removed"): every raylet keeps the node in its
+        # cluster view but reads state=DRAINING and stops targeting it
+        # for spillback/locality; new placements mask its coords
+        await self.publish("nodes", {"event": "updated",
+                                     "node": _node_public(info)})
+        node_conn = self.node_conns.get(node_id)
+        if node_conn is not None and not node_conn.closed:
+            try:
+                await asyncio.wait_for(
+                    node_conn.call("drain", {"deadline_s": deadline_s,
+                                             "preempt": preempt}),
+                    timeout=5.0)
+            except Exception:
+                logger.warning("drain RPC to %s failed; the heartbeat "
+                               "checker will reap it past the deadline",
+                               node_id.hex()[:8])
+        return {"state": "DRAINING", "deadline_s": deadline_s}
+
+    async def h_node_drained(self, conn, d):
+        """The raylet finished draining and is about to exit."""
+        await self._finish_drain(d["node_id"],
+                                 migrated=int(d.get("migrated", 0)),
+                                 leftovers=int(d.get("leftovers", 0)))
         return True
+
+    def _remember_departed(self, node_id: bytes, topo: dict | None):
+        if not topo:
+            return
+        now = time.time()
+        self._departed_coords[node_id.hex()[:8]] = (now, dict(topo))
+        for key in [k for k, (ts, _) in self._departed_coords.items()
+                    if now - ts > _DEPARTED_COORD_TTL_S]:
+            self._departed_coords.pop(key, None)
+
+    async def _finish_drain(self, node_id: bytes, migrated: int = 0,
+                            leftovers: int = 0):
+        """Planned twin of _remove_node: the node leaves as DRAINED, so
+        nothing trips the crash path — restartable actors relocate
+        without burning a restart, and only this node's own directory
+        entries drop (migrated copies on survivors keep every object
+        resolvable)."""
+        info = self.nodes.pop(node_id, None)
+        self.available.pop(node_id, None)
+        self._topo_cache = None
+        self.last_heartbeat.pop(node_id, None)
+        self.node_conns.pop(node_id, None)
+        if info is None:
+            return
+        self._remember_departed(node_id, info.get("topology"))
+        from ray_tpu._private.events import INFO
+
+        self._event(INFO, "NODE_DRAINED",
+                    f"node {node_id.hex()[:8]} drained "
+                    f"({migrated} objects migrated, {leftovers} left)",
+                    node_id=node_id.hex(), migrated=migrated)
+        info["state"] = "DRAINED"
+        self._persist_del("nodes", node_id)
+        await self.publish("nodes", {"event": "removed",
+                                     "node": _node_public(info),
+                                     "reason": "drained"})
+        if self.shard_addresses:
+            await self._broadcast_shards("prune_node", {"node_id": node_id})
+        # Planned relocation: restartable actors move to a survivor
+        # without consuming a restart; pinned (max_restarts=0) ones die.
+        for actor_id, rec in list(self.actors.items()):
+            if rec.get("node_id") == node_id and rec["state"] in (ALIVE, PENDING_CREATION):
+                await self._on_actor_interrupted(actor_id, "node drained",
+                                                 planned=True)
+        for oid, rec in list(self.object_locations.items()):
+            rec["nodes"].discard(node_id)
+            if not rec["nodes"]:
+                # a leftover the drain could not migrate in time: same
+                # typed-loss path as a crash, scoped to the leftovers
+                del self.object_locations[oid]
 
     async def _remove_node(self, node_id: bytes, reason: str):
         info = self.nodes.pop(node_id, None)
@@ -538,6 +671,7 @@ class GcsServer:
         self.node_conns.pop(node_id, None)
         if info is None:
             return
+        self._remember_departed(node_id, info.get("topology"))
         from ray_tpu._private.events import ERROR
 
         self._event(ERROR, "NODE_REMOVED",
@@ -570,7 +704,17 @@ class GcsServer:
             await asyncio.sleep(cfg.heartbeat_interval_s)
             now = time.monotonic()
             for node_id, last in list(self.last_heartbeat.items()):
-                if now - last > timeout:
+                limit = timeout
+                info = self.nodes.get(node_id)
+                if info is not None and info.get("state") == "DRAINING":
+                    # a draining raylet is busy migrating: give it its
+                    # full drain budget + grace before the crash path
+                    # takes over (it normally exits via node_drained
+                    # well before this)
+                    limit = max(timeout,
+                                float(info.get("drain_deadline_s") or 0.0)
+                                + cfg.drain_grace_s)
+                if now - last > limit:
                     logger.warning("node %s missed heartbeats; declaring dead",
                                    node_id.hex()[:8])
                     await self._remove_node(node_id, reason="heartbeat timeout")
@@ -659,13 +803,15 @@ class GcsServer:
                 node_id for node_id, avail in self.available.items()
                 if need.is_subset_of(avail)
             ]
-        # Only nodes with a live raylet connection are placeable. A
-        # restored-from-storage node whose raylet hasn't redialed yet is
-        # NOT dead (its actors are alive) — skip it and let the heartbeat
-        # checker decide its fate, never _remove_node from here.
+        # Only ALIVE nodes with a live raylet connection are placeable.
+        # A restored-from-storage node whose raylet hasn't redialed yet
+        # is NOT dead (its actors are alive) — skip it and let the
+        # heartbeat checker decide its fate, never _remove_node from
+        # here. DRAINING nodes are leaving: never place new actors there.
         candidates = [
             n for n in candidates
             if (c := self.node_conns.get(n)) is not None and not c.closed
+            and self.nodes.get(n, {}).get("state") == "ALIVE"
         ]
         if not candidates:
             if actor_id not in self._pending_actor_queue:
@@ -719,14 +865,25 @@ class GcsServer:
         rec["worker_id"] = reply["worker_id"]
         await self._publish_actor(rec)
 
-    async def _on_actor_interrupted(self, actor_id: bytes, reason: str):
+    async def _on_actor_interrupted(self, actor_id: bytes, reason: str,
+                                    planned: bool = False):
         rec = self.actors.get(actor_id)
         if rec is None or rec["state"] == DEAD:
             return
         restarts_left = (rec["max_restarts"] == -1
                          or rec["num_restarts"] < rec["max_restarts"])
+        if planned:
+            # drain relocation: moving a restartable actor is free (no
+            # restart burned) — only actors pinned at max_restarts=0
+            # cannot be relocated and die with the node
+            restarts_left = rec["max_restarts"] != 0
         if restarts_left:
-            rec["num_restarts"] += 1
+            if not planned:
+                rec["num_restarts"] += 1
+            # the new incarnation checks the KV for drained-away state
+            # (actor_ckpt:<id>, written by the departing raylet) and
+            # restores via __ray_restore__ before taking traffic
+            rec["spec"]["restore"] = True
             rec["state"] = RESTARTING
             rec["address"] = ""
             await self._publish_actor(rec)
@@ -735,6 +892,8 @@ class GcsServer:
             rec["state"] = DEAD
             rec["death_cause"] = reason
             rec["address"] = ""
+            if self.kv.pop(f"actor_ckpt:{actor_id.hex()}", None) is not None:
+                self._persist_del("kv", f"actor_ckpt:{actor_id.hex()}")
             await self._publish_actor(rec)
 
     async def _publish_actor(self, rec):
@@ -1180,7 +1339,14 @@ class GcsServer:
         from ray_tpu._private import stats
 
         snap = stats.snapshot()
-        snap["gcs.nodes_alive"] = {"type": "gauge", "value": len(self.nodes)}
+        snap["gcs.nodes_alive"] = {
+            "type": "gauge",
+            "value": sum(1 for n in self.nodes.values()
+                         if n.get("state") == "ALIVE")}
+        snap["gcs.nodes_draining"] = {
+            "type": "gauge",
+            "value": sum(1 for n in self.nodes.values()
+                         if n.get("state") == "DRAINING")}
         snap["gcs.actors_alive"] = {
             "type": "gauge",
             "value": sum(1 for r in self.actors.values()
@@ -1597,6 +1763,17 @@ class GcsServer:
         for i, nid in enumerate(best):
             avail[nid].subtract(needs[i])
         ring = [coords[nid] for nid in best]
+        # Torus holes this plan routed around: coord-bearing nodes that
+        # are DRAINING (still registered, masked out of avail) plus
+        # recently-departed coords — the placement record shows exactly
+        # which coords the snake re-sort skipped.
+        now = time.time()
+        masked = [dict(self.nodes[nid].get("topology") or {})
+                  for nid in snake
+                  if self.nodes.get(nid, {}).get("state")
+                  not in (None, "ALIVE")]
+        masked.extend(dict(t) for ts, t in self._departed_coords.values()
+                      if now - ts <= _DEPARTED_COORD_TTL_S)
         self._last_topology_plan = {
             "cost_model": getattr(model, "name", "") or cost_model or "ring",
             "cost": float(best_cost),
@@ -1606,6 +1783,9 @@ class GcsServer:
             # from this gang (SNIPPETS [2] table; parallel/mesh.py)
             "mesh_shape": list(_topo.mesh_shape_for(k)),
         }
+        if masked:
+            self._last_topology_plan["masked_coords"] = masked
+            M_RING_REPLACEMENTS.inc()
         return {i: nid for i, nid in enumerate(best)}
 
     def _place_bundles(self, bundles, strategy, cost_model: str = ""):
@@ -1627,7 +1807,10 @@ class GcsServer:
         `self._last_topology_plan` (ICI_RING success only) so
         _do_create_pg can stamp the record without re-deriving."""
         self._last_topology_plan = None
-        avail = {nid: r.copy() for nid, r in self.available.items()}
+        # DRAINING nodes are masked out of every strategy's candidate
+        # set: a group placed now must survive the node's departure
+        avail = {nid: r.copy() for nid, r in self.available.items()
+                 if self.nodes.get(nid, {}).get("state") == "ALIVE"}
         placement: dict[int, bytes] = {}
         node_ids = list(avail.keys())
         if not node_ids:

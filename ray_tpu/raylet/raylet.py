@@ -140,6 +140,11 @@ class Raylet:
         self.m_lease_grant_s = stats.Histogram(
             "raylet.lease_grant_s", stats.LATENCY_BOUNDARIES_S,
             "lease request arrival -> grant (queue + worker startup)")
+        self.m_drains = stats.Count(
+            "raylet.drains_total", "graceful drains started on this raylet")
+        self.m_drain_migrated_bytes = stats.Count(
+            "raylet.drain_migrated_bytes_total",
+            "plasma bytes pushed to survivors during drain")
         self.num_cpus = int(resources.get("CPU", os.cpu_count() or 1))
 
         # trace spans (tracing.py) recorded by this raylet — lease grants
@@ -206,6 +211,15 @@ class Raylet:
         self._raylet_conns: dict[str, rpc.Connection] = {}
         self._raylet_dial_locks: dict[str, asyncio.Lock] = {}
         self._shutting_down = False
+        # Elastic membership: set by h_drain (GCS-initiated or a
+        # preemption notice). A draining raylet grants no new leases,
+        # reserves no bundles, and is skipped as a spillback/locality
+        # target by peers (they read state=DRAINING off the nodes
+        # channel); the background _drain task migrates plasma to
+        # survivors, waits out in-flight leases, checkpoints actors,
+        # then exits through node_drained — never the crash path.
+        self._draining = False
+        self._drain_task: asyncio.Task | None = None
 
     def _handlers(self):
         return {
@@ -226,6 +240,7 @@ class Raylet:
             "set_resource": self.h_set_resource,
             "actor_exiting": self.h_actor_exiting,
             # gcs-facing
+            "drain": self.h_drain,
             "create_actor": self.h_create_actor,
             "kill_actor_worker": self.h_kill_actor_worker,
             "prepare_bundle": self.h_prepare_bundle,
@@ -553,6 +568,8 @@ class Raylet:
                 continue
             if info["address"] in exclude:
                 continue
+            if info.get("state", "ALIVE") != "ALIVE":
+                continue  # DRAINING peers accept no new leases
             if need.is_subset_of(ResourceSet.from_raw(info["resources"])):
                 cands.append(node_id)
         if not cands:
@@ -593,6 +610,8 @@ class Raylet:
                 continue
             if self.cluster_nodes[node_id]["address"] in exclude:
                 continue
+            if self.cluster_nodes[node_id].get("state", "ALIVE") != "ALIVE":
+                continue  # DRAINING peers accept no new leases
             if need.is_subset_of(rs):
                 cands.append(node_id)
         if not cands:
@@ -657,8 +676,9 @@ class Raylet:
             if node_id == me:
                 continue
             info = self.cluster_nodes.get(node_id)
-            if info is None or not need.is_subset_of(
-                    ResourceSet.from_raw(info["resources"])):
+            if info is None or info.get("state", "ALIVE") != "ALIVE":
+                continue  # a DRAINING holder is migrating those bytes away
+            if not need.is_subset_of(ResourceSet.from_raw(info["resources"])):
                 continue
             feasible.append((nbytes, node_id))
         best_bytes = max((n for n, _ in feasible), default=0)
@@ -737,6 +757,28 @@ class Raylet:
         soft = bool(d.get("soft"))
         hops = int(d.get("hops", 0))
         visited = list(d.get("visited") or ())
+        if self._draining:
+            # A draining node grants nothing: redirect the request to a
+            # survivor (the spillback pickers already exclude DRAINING
+            # peers, so two departing nodes can't ping-pong a request).
+            # Soft prewarm just comes back empty; with no survivor the
+            # owner queues exactly like an infeasible-everywhere task.
+            if soft:
+                return {"grants": []}
+            addr = self._pick_spillback(spec, exclude=visited)
+            if addr is not None:
+                self.m_spillbacks.inc()
+                return await self._spill(d, addr, hops + 1)
+            fut = asyncio.get_running_loop().create_future()
+            spec.setdefault("_queued_at", time.time())
+            self.pending_leases.append((spec, fut))
+            result = await fut
+            if result.get("granted"):
+                self._track_holder(conn, [result])
+                self._note_lease_granted(lease_t0, spec, 1)
+            if batched and "spillback" not in result:
+                return {"grants": [result]}
+            return result
         if hops == 0 and not soft:
             # Locality-aware lease targeting (reference: lease_policy.h):
             # a task whose plasma args are resident on another node is
@@ -1010,6 +1052,11 @@ class Raylet:
         return True
 
     async def _dispatch_pending(self):
+        if self._draining:
+            # no grants off the queue while draining; _drain bounces the
+            # queue to survivors and the exit-time conn close sends any
+            # stragglers through the owner's normal retry path
+            return
         if _fp.ARMED:
             # dispatch seam: `raise` leaves queued leases queued (the
             # next return/heartbeat/bundle event re-drives the queue)
@@ -1035,6 +1082,10 @@ class Raylet:
 
     async def h_create_actor(self, conn, d):
         spec = d["spec"]
+        if self._draining:
+            # looks like a stale-availability miss to the GCS: it zeroes
+            # its view of this node and requeues on an ALIVE one
+            raise InsufficientResources("node is draining")
         acquired = self._try_acquire(spec)
         if acquired is None:
             # GCS checked the resource snapshot, but we may have raced.
@@ -1103,6 +1154,8 @@ class Raylet:
     # ------------------------------------------------------------------
 
     async def h_prepare_bundle(self, conn, d):
+        if self._draining:
+            return False  # a departing node reserves nothing (2PC abort)
         need = ResourceSet.from_raw(d["resources"])
         if not need.is_subset_of(self.available):
             return False
@@ -1806,10 +1859,11 @@ class Raylet:
         await self._dispatch_pending()
         return {"total": self.total.raw(), "available": self.available.raw()}
 
-    async def h_get_metrics(self, conn, d):
-        from ray_tpu._private import stats
-
-        snap = stats.snapshot()
+    def _gauge_snapshot(self, snap: dict) -> dict:
+        """Fold this raylet's live gauges into a metrics snapshot — used
+        by BOTH h_get_metrics and the heartbeat piggyback, so the GCS
+        metrics-history rings (what the autoscaler's busy/idle predicate
+        reads) carry the same series the direct RPC shows."""
         snap["raylet.num_workers"] = {"type": "gauge",
                                       "value": len(self.workers)}
         snap["raylet.store_used_bytes"] = {"type": "gauge",
@@ -1818,8 +1872,19 @@ class Raylet:
                                         "value": len(self.local_objects)}
         snap["raylet.pending_leases"] = {"type": "gauge",
                                          "value": len(self.pending_leases)}
+        snap["raylet.active_leases"] = {
+            "type": "gauge",
+            "value": sum(1 for w in self.workers.values()
+                         if w.lease_id is not None
+                         or w.actor_id is not None)}
         snap["raylet.transfer_pins"] = {"type": "gauge",
                                         "value": self.transfer_pins.count()}
+        return snap
+
+    async def h_get_metrics(self, conn, d):
+        from ray_tpu._private import stats
+
+        snap = self._gauge_snapshot(stats.snapshot())
         # fold in per-worker process metrics (user-defined metrics from
         # util/metrics.py live in worker processes)
         import asyncio
@@ -2081,6 +2146,223 @@ class Raylet:
                 still.append((spec, fut))
         self.pending_leases = still
 
+    # ------------------------------------------------------------------
+    # elastic membership: graceful drain (planned departure)
+    # ------------------------------------------------------------------
+
+    async def h_drain(self, conn, d):
+        """GCS asks this raylet to leave gracefully (autoscaler scale-down,
+        `ray-tpu drain`, or our own preemption notice echoed back).
+        Returns immediately; the drain itself runs in the background so
+        the GCS RPC doesn't ride out the whole deadline. Idempotent: a
+        second drain (e.g. a preemption notice landing mid-drain) just
+        reports the in-progress state."""
+        if self._draining:
+            return {"state": "DRAINING"}
+        self._draining = True
+        self.m_drains.inc()
+        deadline_s = float(d.get("deadline_s")
+                           or self.config.drain_deadline_s)
+        preempt = bool(d.get("preempt"))
+        logger.info("drain requested (%s, deadline %.1fs): %d local "
+                    "objects, %d workers",
+                    "preempt" if preempt else "planned", deadline_s,
+                    len(self.local_objects), len(self.workers))
+        self._drain_task = asyncio.create_task(
+            self._drain(deadline_s, preempt))
+        return {"state": "DRAINING"}
+
+    async def _drain(self, deadline_s: float, preempt: bool):
+        """Planned departure: make the node's disappearance free.
+        Normal order: bounce the lease queue, migrate plasma to
+        survivors, let in-flight leases finish, checkpoint actors.
+        Preemption compresses the window (TPU spot gives seconds), so
+        the order flips: checkpoints first — they're small and
+        irreplaceable — objects best-effort with whatever remains.
+        Whatever misses the deadline takes exactly the crash path
+        (typed reclaim/loss), scoped to the leftovers."""
+        deadline = time.monotonic() + deadline_s
+        self._drain_migrated: set[bytes] = set()
+        skip_migrate = False
+        if _fp.ARMED:
+            # drain seam: `delay` stretches the window so chaos can kill
+            # the node mid-drain; `raise` skips the migration pass
+            # entirely (every object becomes a leftover)
+            try:
+                await _fp.fire_async_strict("raylet.drain")
+            except _fp.FailpointError:
+                skip_migrate = True
+        try:
+            self._drain_bounce_pending()
+            migrated = 0
+            if preempt:
+                await self._drain_checkpoint_actors(deadline)
+                if not skip_migrate:
+                    migrated = await self._drain_migrate_objects(deadline)
+            else:
+                if not skip_migrate:
+                    migrated = await self._drain_migrate_objects(deadline)
+                await self._drain_wait_leases(deadline)
+                if not skip_migrate:
+                    # in-flight tasks wrote their returns to plasma AFTER
+                    # the first pass — a second sweep migrates those too,
+                    # so finishing-during-drain never means losing the
+                    # result bytes
+                    migrated = await self._drain_migrate_objects(deadline)
+                await self._drain_checkpoint_actors(deadline)
+            leftovers = sum(1 for oid in self.local_objects
+                            if oid not in self._drain_migrated)
+            logger.info("drain complete: %d objects migrated, %d left",
+                        migrated, leftovers)
+            try:
+                await self.gcs.call("node_drained", {
+                    "node_id": self.node_id.binary(),
+                    "migrated": migrated,
+                    "leftovers": leftovers,
+                }, timeout=10.0)
+            except Exception:
+                # GCS unreachable: exiting anyway is correct — the
+                # heartbeat checker reaps us through the crash path
+                logger.warning("node_drained report failed; exiting anyway")
+        except Exception:
+            logger.exception("drain failed; exiting through the crash path")
+            self._fail_stop("drain error")
+        self._drain_exit()
+
+    def _drain_bounce_pending(self):
+        """Queued-but-ungranted leases spill to survivors via the normal
+        owner-visible bounce; requests with no feasible survivor stay
+        queued — the exit-time connection close routes them through the
+        owner's retry machinery like any node loss."""
+        still = []
+        for spec, fut in self.pending_leases:
+            if fut.done():
+                continue
+            addr = self._pick_spillback(spec)
+            if addr is not None:
+                self.m_spillbacks.inc()
+                fut.set_result({"spillback": addr, "hops": 1})
+            else:
+                still.append((spec, fut))
+        self.pending_leases = still
+
+    async def _drain_migrate_objects(self, deadline: float) -> int:
+        """Actively push every resident plasma object to a survivor:
+        notify the target with a push_hint (it runs a normal striped
+        pull over the bulk channel with us as the seed source), then
+        poll the GCS directory until a survivor is listed as a holder —
+        only a directory-confirmed copy counts as migrated, so the
+        object stays resolvable after our locations drop. Bounded by
+        drain_migrate_concurrency and the deadline."""
+        me = self.node_id.binary()
+        survivors = [
+            info for nid, info in self.cluster_nodes.items()
+            if nid != me and info.get("state", "ALIVE") == "ALIVE"
+            and info.get("address")
+        ]
+        if not survivors or self.gcs is None:
+            return 0
+        sem = asyncio.Semaphore(
+            max(1, self.config.drain_migrate_concurrency))
+
+        async def _one(idx: int, oid: bytes, rec: dict):
+            async with sem:
+                if time.monotonic() >= deadline:
+                    return
+                if _fp.ARMED:
+                    # migrate seam: `raise` turns THIS object into a
+                    # leftover (typed loss downstream); `delay` holds an
+                    # object mid-flight across the chaos kill window
+                    try:
+                        await _fp.fire_async_strict("transfer.migrate")
+                    except _fp.FailpointError:
+                        return
+                target = survivors[idx % len(survivors)]
+                try:
+                    tconn = await self._raylet_conn(target["address"])
+                    await tconn.notify("push_hint", {
+                        "object_id": oid, "from": self.address})
+                except Exception as e:
+                    logger.warning("drain push to %s failed: %s",
+                                   target["address"], e)
+                    return
+                while time.monotonic() < deadline:
+                    try:
+                        nodes = await self.gcs.call(
+                            "get_object_locations", {"object_id": oid})
+                    except Exception:
+                        return
+                    if any(n != me for n in nodes or ()):
+                        self._drain_migrated.add(oid)
+                        self.m_drain_migrated_bytes.inc(
+                            int(rec.get("size") or 0))
+                        return
+                    await asyncio.sleep(0.05)
+
+        todo = [(oid, rec) for oid, rec in self.local_objects.items()
+                if oid not in self._drain_migrated]
+        await asyncio.gather(
+            *(_one(i, oid, rec) for i, (oid, rec) in enumerate(todo)),
+            return_exceptions=True)
+        return len(self._drain_migrated)
+
+    async def _drain_wait_leases(self, deadline: float):
+        """Let in-flight tasks run to completion (actors are handled by
+        the checkpoint step — they never finish on their own). Leases
+        still live at the deadline are reclaimed through the normal
+        typed machinery when the node exits."""
+        while time.monotonic() < deadline:
+            if not any(w.lease_id is not None for w in self.workers.values()):
+                return
+            await asyncio.sleep(0.1)
+
+    async def _drain_checkpoint_actors(self, deadline: float):
+        """Snapshot restartable actor state to the control plane: each
+        actor worker runs the actor's __ray_checkpoint__() hook (if
+        defined) and we land the pickled state in the GCS KV — a
+        survivor by construction — keyed by actor id. The GCS then
+        relocates the actor (planned, no restart burned) and the new
+        incarnation restores via __ray_restore__. Actors without the
+        hook relocate stateless, exactly like today."""
+        for w in list(self.workers.values()):
+            if w.actor_id is None or w.conn.closed:
+                continue
+            budget = deadline - time.monotonic()
+            if budget <= 0:
+                return
+            try:
+                reply = await asyncio.wait_for(
+                    w.conn.call("checkpoint_actor", {}),
+                    timeout=max(0.2, budget))
+                state = (reply or {}).get("state")
+                if state is not None:
+                    await self.gcs.call("kv_put", {
+                        "key": f"actor_ckpt:{w.actor_id.hex()}",
+                        "value": state})
+            except Exception as e:
+                logger.warning("checkpoint of actor %s failed: %s",
+                               w.actor_id.hex()[:8], e)
+
+    def _drain_exit(self):
+        """Graceful twin of _fail_stop: the GCS already finalized us as
+        DRAINED (or will reap us), so stop accepting work and leave with
+        status 0. Workers get the intended-exit notice first so their
+        owners see a clean actor exit, not a crash."""
+        logger.info("raylet exiting after drain")
+        self._shutting_down = True
+        for w in list(self.workers.values()):
+            try:
+                w.conn.context["intended_exit"] = True
+                os.kill(w.pid, signal.SIGTERM)
+            except (ProcessLookupError, PermissionError):
+                pass
+        for proc, _flavor in self._starting_procs:
+            try:
+                proc.kill()
+            except OSError:
+                pass
+        os._exit(0)
+
     def _fail_stop(self, reason: str):
         """Fail-stop this node: kill every worker and exit. A raylet the
         GCS has given up on must NOT linger as a split-brain zombie that
@@ -2116,7 +2398,7 @@ class Raylet:
             return None
         from ray_tpu._private import stats
 
-        return stats.snapshot()
+        return self._gauge_snapshot(stats.snapshot())
 
     async def _flush_profile(self):
         """Flush recorded trace spans / profile events to the GCS (~2s
@@ -2157,6 +2439,25 @@ class Raylet:
         last_ok = time.monotonic()
         while True:
             await asyncio.sleep(interval)
+            if _fp.ARMED and not self._draining:
+                # preemption-notice seam: stands in for the cloud
+                # metadata "you have N seconds" signal (TPU spot). The
+                # notice starts a COMPRESSED drain through the GCS so
+                # the departure is cluster-visible — checkpoints first,
+                # objects best-effort (idempotent if already draining).
+                try:
+                    await _fp.fire_async_strict("node.preempt_notice")
+                except _fp.FailpointError:
+                    logger.warning("preemption notice received: "
+                                   "requesting compressed drain")
+                    try:
+                        await self.gcs.call("drain_node", {
+                            "node_id": self.node_id.binary(),
+                            "preempt": True,
+                        }, timeout=5.0)
+                    except Exception:
+                        logger.warning("preempt drain request failed; "
+                                       "retrying next beat")
             try:
                 if _fp.ARMED:
                     await _fp.fire_async_strict("raylet.heartbeat")

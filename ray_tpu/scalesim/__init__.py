@@ -1,4 +1,6 @@
+from ray_tpu.scalesim.elastic_sim import run_elastic_sim
 from ray_tpu.scalesim.harness import ControlPlane, run_scalesim
 from ray_tpu.scalesim.topology_sim import run_topology_sim
 
-__all__ = ["ControlPlane", "run_scalesim", "run_topology_sim"]
+__all__ = ["ControlPlane", "run_elastic_sim", "run_scalesim",
+           "run_topology_sim"]

@@ -1,0 +1,263 @@
+"""Scale-sim elastic arm: drain-aware vs static vs kill-based scale-down.
+
+Ramps a synthetic demand series up and down against three fleets, each
+with its own REAL director (harness.ControlPlane), and scores
+node-hours x SLO violations per policy:
+
+- ``static``  — never scales; capacity is always max (the no-autoscaler
+  control: zero violations, maximum node-hours).
+- ``drain``   — follows demand; scale-down goes through the elastic
+  membership plane (``drain_node`` -> raylet migrates its object
+  locations to a survivor -> ``node_drained`` -> DRAINED), so departed
+  nodes' objects stay resolvable.
+- ``kill``    — follows demand; scale-down abruptly closes the raylet's
+  registration conn (the crash path: ``_remove_node`` reclaims its
+  object locations exactly like a node loss).
+
+Each spoofed raylet registers a handful of synthetic object locations
+at join. The SLO ledger counts (a) objects from departed nodes that no
+longer resolve in the GCS directory — the bytes a real fleet would
+re-derive through lineage — and (b) capacity shortfall vs the demand
+series. Score = node_hours * (1 + violations); lower is better. The
+drain arm should match kill on node-hours and static on violations —
+that inequality pair IS the planned-vs-crash A/B (the kill arm staying
+green on everything else is the PR 4/7 safety-net control)."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+
+from ray_tpu._private import rpc
+from ray_tpu._private.common import ResourceSet
+from ray_tpu.scalesim.harness import ControlPlane
+
+_OBJ_SIZE = 1024
+
+
+class ElasticSimRaylet:
+    """Spoofed raylet with the elastic-membership surface: registers,
+    heartbeats, serves the 2PC bundle handlers AND the ``drain`` RPC —
+    draining re-registers its object locations on a survivor before
+    reporting ``node_drained``, exactly the real migration contract
+    (directory-confirmed copy before the node's own entries drop)."""
+
+    def __init__(self, idx: int, node_id: bytes, objects: int = 4):
+        self.idx = idx
+        self.node_id = node_id
+        self.total = ResourceSet({"CPU": 1.0})
+        self.available = self.total.copy()
+        self.oids = [node_id[:8] + bytes([idx % 256, k]) * 4
+                     for k in range(objects)]
+        self.conn: rpc.ReconnectingConnection | None = None
+        self.migrate_target: bytes | None = None  # set before drain
+        self._beat_task: asyncio.Task | None = None
+        self._draining = False
+        self.drained = asyncio.Event()
+
+    def _handlers(self):
+        return {
+            "drain": self.h_drain,
+            "prepare_bundle": self.h_prepare,
+            "commit_bundle": lambda conn, d: True,
+            "cancel_bundle": self.h_release,
+            "return_bundle": self.h_release,
+            "ping": lambda conn, d: "pong",
+        }
+
+    async def h_prepare(self, conn, d):
+        need = ResourceSet.from_raw(d["resources"])
+        if self._draining or not need.is_subset_of(self.available):
+            return False
+        self.available.subtract(need)
+        return True
+
+    async def h_release(self, conn, d):
+        return True
+
+    async def h_drain(self, conn, d):
+        if not self._draining:
+            self._draining = True
+            asyncio.create_task(self._do_drain())
+        return {"state": "DRAINING"}
+
+    async def _do_drain(self):
+        conn = await self.conn.ensure_connected()
+        migrated = 0
+        if self.migrate_target is not None:
+            for oid in self.oids:
+                await conn.call("add_object_location", {
+                    "object_id": oid, "node_id": self.migrate_target,
+                    "size": _OBJ_SIZE})
+                migrated += 1
+        await conn.call("node_drained", {
+            "node_id": self.node_id, "migrated": migrated,
+            "leftovers": len(self.oids) - migrated})
+        await self.close()
+        self.drained.set()
+
+    async def connect(self, gcs_address: str):
+        self.conn = rpc.ReconnectingConnection(
+            gcs_address, handlers=self._handlers(),
+            name=f"elastic{self.idx}", retry_timeout=30.0)
+        conn = await self.conn.ensure_connected()
+        await conn.call("register_node", {
+            "node_id": self.node_id,
+            "address": f"sim://{self.idx}",
+            "resources": self.total.raw(),
+            "available": self.available.raw(),
+            "hostname": f"sim{self.idx}",
+        })
+        for oid in self.oids:
+            await conn.call("add_object_location", {
+                "object_id": oid, "node_id": self.node_id,
+                "size": _OBJ_SIZE})
+        self._beat_task = asyncio.create_task(self._beat_loop())
+
+    async def _beat_loop(self):
+        while True:
+            await asyncio.sleep(0.05)
+            try:
+                await self.conn.call("heartbeat", {
+                    "node_id": self.node_id,
+                    "available": self.available.raw()})
+            except Exception:
+                await asyncio.sleep(0.2)
+
+    async def close(self):
+        if self._beat_task is not None:
+            self._beat_task.cancel()
+        if self.conn is not None:
+            await self.conn.close()
+
+
+def _demand_series(max_nodes: int, windows: int) -> list[int]:
+    """Triangle ramp max -> min -> max across the window budget (the
+    autoscale shape that exercises both directions every run)."""
+    lo = max(1, max_nodes // 4)
+    series = []
+    half = max(1, windows // 2)
+    for w in range(windows):
+        frac = (half - w) / half if w <= half else (w - half) / half
+        series.append(max(lo, round(lo + (max_nodes - lo) * abs(frac))))
+    return series
+
+
+async def _run_arm(policy: str, plane: ControlPlane, max_nodes: int,
+                   windows: int, objects_per_node: int) -> dict:
+    gcs = await rpc.connect(plane.gcs_address, name=f"elastic-{policy}")
+    fleet: list[ElasticSimRaylet] = []
+    next_idx = 0
+    departed_oids: list[bytes] = []
+    node_hours = 0
+    shortfall = 0
+    recovery_s: list[float] = []
+    demand = _demand_series(max_nodes, windows)
+
+    async def spawn():
+        nonlocal next_idx
+        r = ElasticSimRaylet(next_idx,
+                             bytes([next_idx % 251 + 1]) * 16,
+                             objects=objects_per_node)
+        next_idx += 1
+        await r.connect(plane.gcs_address)
+        fleet.append(r)
+
+    async def wait_departed(node_id: bytes, timeout: float = 10.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            nodes = await gcs.call("get_all_nodes", {})
+            if all(n["node_id"] != node_id for n in nodes):
+                return
+            await asyncio.sleep(0.02)
+        raise TimeoutError(f"node never left the table ({policy})")
+
+    async def scale_down(r: ElasticSimRaylet):
+        departed_oids.extend(r.oids)
+        t0 = time.monotonic()
+        if policy == "drain":
+            survivors = [s for s in fleet if s is not r]
+            r.migrate_target = survivors[0].node_id if survivors else None
+            reply = await gcs.call("drain_node", {"node_id": r.node_id})
+            assert reply["state"] == "DRAINING", reply
+            await asyncio.wait_for(r.drained.wait(), timeout=10.0)
+        else:  # kill: abrupt conn close -> the GCS crash path
+            await r.close()
+        await wait_departed(r.node_id)
+        recovery_s.append(time.monotonic() - t0)
+        fleet.remove(r)
+
+    try:
+        for _ in range(max_nodes):
+            await spawn()
+        for want in demand:
+            if policy != "static":
+                while len(fleet) > want:
+                    await scale_down(fleet[-1])
+                while len(fleet) < want:
+                    await spawn()
+            node_hours += len(fleet)
+            shortfall += max(0, want - len(fleet))
+        lost = 0
+        for oid in departed_oids:
+            locs = await gcs.call("get_object_locations",
+                                  {"object_id": oid})
+            if not locs:
+                lost += 1
+    finally:
+        for r in list(fleet):
+            await r.close()
+        await gcs.close()
+    violations = lost + shortfall
+    return {
+        "policy": policy,
+        "demand": demand,
+        "node_hours": node_hours,
+        "objects_departed": len(departed_oids),
+        "objects_lost": lost,
+        "bytes_rederived": lost * _OBJ_SIZE,
+        "capacity_shortfall": shortfall,
+        "slo_violations": violations,
+        "score": node_hours * (1 + violations),
+        "mean_recovery_ms": round(
+            sum(recovery_s) / max(len(recovery_s), 1) * 1e3, 2),
+        "departures": len(recovery_s),
+    }
+
+
+def run_elastic_sim(raylets: int = 6, windows: int = 6,
+                    objects_per_node: int = 4,
+                    out: str | None = None,
+                    keep_dirs: bool = False) -> dict:
+    """Run all three policies, each against its own live director.
+    Returns per-arm ledgers plus the drain-vs-kill A/B (recovery time
+    and bytes re-derived) and the drain-vs-static node-hour saving."""
+    arms: dict[str, dict] = {}
+    for policy in ("static", "drain", "kill"):
+        plane = ControlPlane(1, label=f"elastic-{policy}")
+        try:
+            arms[policy] = asyncio.run(_run_arm(
+                policy, plane, raylets, windows, objects_per_node))
+        finally:
+            plane.close(remove_dir=not keep_dirs)
+    result = {
+        "raylets": raylets, "windows": windows,
+        "objects_per_node": objects_per_node,
+        "arms": arms,
+        # planned-vs-crash A/B: drain must match kill on node-hours and
+        # static on losses; kill's losses are the lineage re-derive bill
+        "node_hours_saved_vs_static": (
+            arms["static"]["node_hours"] - arms["drain"]["node_hours"]),
+        "bytes_saved_vs_kill": (
+            arms["kill"]["bytes_rederived"]
+            - arms["drain"]["bytes_rederived"]),
+        "score_ratio_kill_over_drain": round(
+            arms["kill"]["score"] / max(arms["drain"]["score"], 1e-9), 3),
+        "score_ratio_static_over_drain": round(
+            arms["static"]["score"] / max(arms["drain"]["score"], 1e-9), 3),
+    }
+    if out:
+        with open(out, "w") as f:
+            json.dump(result, f, indent=1)
+    return result

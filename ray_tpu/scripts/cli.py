@@ -212,6 +212,54 @@ def cmd_status(args) -> int:
     return 0
 
 
+def cmd_drain(args) -> int:
+    """Graceful scale-down of one node: ALIVE -> DRAINING (stops taking
+    leases/spillback, migrates its objects, checkpoints restartable
+    actors) -> DRAINED. The node argument is an id prefix (as printed by
+    `ray-tpu status`) or a raylet address."""
+    addr = _gcs_address(args)
+    if not addr:
+        print("no cluster found", file=sys.stderr)
+        return 1
+    nodes = _rpc_call(addr, "get_all_nodes")
+    want = args.node.lower()
+    matches = [n for n in nodes
+               if n["node_id"].hex().startswith(want)
+               or n["address"] == args.node]
+    if not matches:
+        print(f"no node matches {args.node!r}", file=sys.stderr)
+        return 1
+    if len(matches) > 1:
+        print(f"{args.node!r} is ambiguous: "
+              + ", ".join(n["node_id"].hex()[:8] for n in matches),
+              file=sys.stderr)
+        return 1
+    node = matches[0]
+    if node.get("is_head"):
+        print("refusing to drain the head node (use `ray-tpu stop`)",
+              file=sys.stderr)
+        return 1
+    reply = _rpc_call(addr, "drain_node", {
+        "node_id": node["node_id"],
+        "preempt": bool(args.preempt),
+    })
+    print(f"node {node['node_id'].hex()[:8]}: {reply.get('state')}")
+    if not args.wait:
+        return 0
+    import time as _time
+
+    deadline = _time.monotonic() + args.timeout
+    while _time.monotonic() < deadline:
+        left = _rpc_call(addr, "get_all_nodes")
+        if all(n["node_id"] != node["node_id"] for n in left):
+            print(f"node {node['node_id'].hex()[:8]}: DRAINED")
+            return 0
+        _time.sleep(0.5)
+    print(f"node {node['node_id'].hex()[:8]}: still draining after "
+          f"{args.timeout:.0f}s", file=sys.stderr)
+    return 1
+
+
 def cmd_memory(args) -> int:
     """reference: scripts.py:1389 `ray memory` — object store usage."""
     addr = _gcs_address(args)
@@ -869,8 +917,31 @@ def cmd_scalesim(args) -> int:
     GCS op throughput, interleaved A/B vs the single-shard legacy arm
     (ray_tpu/scalesim/harness.py). --topology runs the placement arm
     instead: ICI_RING vs PACK over spoofed 4x4-torus raylets
-    (ray_tpu/scalesim/topology_sim.py)."""
+    (ray_tpu/scalesim/topology_sim.py). --elastic runs the membership
+    ramp arm: drain-aware vs static vs kill-based scale-down scored on
+    node-hours x SLO violations (ray_tpu/scalesim/elastic_sim.py)."""
     from ray_tpu.scalesim import run_scalesim
+
+    if args.elastic:
+        from ray_tpu.scalesim import run_elastic_sim
+
+        result = run_elastic_sim(raylets=args.raylets,
+                                 windows=args.windows, out=args.out)
+        for label, arm in result["arms"].items():
+            print(f"{label}: node-hours {arm['node_hours']}  "
+                  f"objects lost {arm['objects_lost']}/"
+                  f"{arm['objects_departed']}  shortfall "
+                  f"{arm['capacity_shortfall']}  score {arm['score']}  "
+                  f"recovery {arm['mean_recovery_ms']}ms")
+        print(f"score vs drain-aware: kill "
+              f"{result['score_ratio_kill_over_drain']}x, static "
+              f"{result['score_ratio_static_over_drain']}x; "
+              f"{result['bytes_saved_vs_kill']} bytes saved vs kill, "
+              f"{result['node_hours_saved_vs_static']} node-hours "
+              f"saved vs static")
+        if args.out:
+            print(f"wrote {args.out}")
+        return 0
 
     if args.topology:
         from ray_tpu.scalesim import run_topology_sim
@@ -944,6 +1015,22 @@ def main(argv=None) -> int:
     p = sub.add_parser("status", help="node table + resources")
     p.add_argument("--address", default=None)
     p.set_defaults(fn=cmd_status)
+
+    p = sub.add_parser("drain",
+                       help="gracefully drain one node out of the "
+                            "cluster (migrate objects, checkpoint "
+                            "actors, then exit)")
+    p.add_argument("node", help="node id prefix (see `ray-tpu status`) "
+                                "or raylet address")
+    p.add_argument("--address", default=None)
+    p.add_argument("--preempt", action="store_true",
+                   help="compressed drain: checkpoint gangs first, "
+                        "objects best-effort (preemption-notice path)")
+    p.add_argument("--wait", action="store_true",
+                   help="block until the node reaches DRAINED")
+    p.add_argument("--timeout", type=float, default=120.0,
+                   help="--wait limit in seconds")
+    p.set_defaults(fn=cmd_drain)
 
     p = sub.add_parser("memory", help="object-store usage per node")
     p.add_argument("--address", default=None)
@@ -1130,6 +1217,11 @@ def main(argv=None) -> int:
                         "ICI_RING vs PACK over spoofed 4x4-torus "
                         "raylets (circumference / spillback hops / "
                         "placement latency)")
+    p.add_argument("--elastic", action="store_true",
+                   help="run the elastic membership ramp arm instead: "
+                        "drain-aware vs static vs kill-based "
+                        "scale-down, scored on node-hours x SLO "
+                        "violations")
     p.add_argument("--out", default=None, help="write result JSON here")
     p.set_defaults(fn=cmd_scalesim)
 

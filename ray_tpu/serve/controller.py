@@ -316,6 +316,17 @@ class ServeController:
         cw = global_state.get_core_worker()
         if cw is None:
             return
+        # Elastic membership: a member sitting on a DRAINING node is as
+        # restart-worthy as a dead one — the node is leaving, and the
+        # fresh gang's ICI_RING placement re-snakes the torus around the
+        # hole (masked coords) while the old gang still answers. One
+        # cluster-view read per pass, not per gang.
+        try:
+            draining = {n["node_id"]
+                        for n in cw.cluster_info()["nodes"]
+                        if n.get("state") not in (None, "ALIVE")}
+        except Exception:
+            draining = set()
         now = time.monotonic()
         with self._autoscale_lock:
             candidates = [
@@ -325,7 +336,7 @@ class ServeController:
                 if not gang.get("restarting")
                 and gang.get("restart_backoff_until", 0.0) <= now]
         for name, rec, gang in candidates:
-            if not self._gang_is_dead(cw, gang):
+            if not self._gang_is_dead(cw, gang, draining):
                 continue
             with self._autoscale_lock:
                 gangs = rec.get("gangs") or []
@@ -337,13 +348,17 @@ class ServeController:
             self._restart_gang(name, rec, i, gang)
 
     @staticmethod
-    def _gang_is_dead(cw, gang: dict) -> bool:
+    def _gang_is_dead(cw, gang: dict, draining_nodes: set = frozenset()) -> bool:
         for member in gang["members"]:
             try:
                 info = cw.get_actor_info(member._actor_id.binary())
             except Exception:
                 return False  # GCS unreachable: don't thrash
             if info is None or info.get("state") == "DEAD":
+                return True
+            if info.get("node_id") in draining_nodes:
+                # planned departure: restart proactively, inside the
+                # drain window, instead of waiting for the member to die
                 return True
         return False
 

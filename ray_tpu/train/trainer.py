@@ -287,15 +287,42 @@ class Trainer:
     # ------------------------------------------------------------------
 
     def _any_worker_dead(self) -> bool:
+        return self._gang_interrupted()[0]
+
+    def _gang_interrupted(self) -> tuple[bool, bool]:
+        """-> (broken, planned). Broken: a worker is DEAD or parked on a
+        DRAINING node (the node is leaving; its bundle can't follow).
+        Planned: every interruption found is a drain — the next
+        generation re-gangs from a fresh ICI_RING reservation placed
+        around the hole (the GCS placement record carries the masked
+        coords), with the collective tier re-derived from that record
+        rather than probe rounds."""
         cw = global_state.require_core_worker()
+        try:
+            draining = {n["node_id"] for n in cw.cluster_info()["nodes"]
+                        if n.get("state") not in (None, "ALIVE")}
+        except Exception:
+            draining = set()
+        broken = False
+        planned = True
         for w in self.workers:
             info = cw.get_actor_info(w._actor_id.binary())
             if info is None or info.get("state") == "DEAD":
-                return True
-        return False
+                broken = True
+                if "drained" not in (info or {}).get("death_cause", ""):
+                    planned = False
+            elif info.get("node_id") in draining:
+                broken = True
+        return broken, broken and planned
+
+    # planned departures re-gang for free, but boundedly so — a fleet
+    # draining in a loop must not keep a train() call alive forever
+    _MAX_PLANNED_REGANGS = 8
 
     def _run_with_retries(self, fn_name: str, num_steps, **kw):
-        for attempt in range(self._max_retries + 1):
+        attempt = 0
+        planned_regangs = 0
+        while True:
             try:
                 if not self.workers:
                     raise exc.WorkerCrashedError("worker group is empty")
@@ -305,18 +332,32 @@ class Trainer:
                     timeout=600)
             except (exc.ActorDiedError, exc.WorkerCrashedError,
                     exc.GetTimeoutError):
-                if attempt == self._max_retries:
+                _, planned = self._gang_interrupted()
+                if planned and planned_regangs < self._MAX_PLANNED_REGANGS:
+                    # a drain took a worker: planned departure costs no
+                    # retry budget (crash recovery stays bounded as before)
+                    planned_regangs += 1
+                elif attempt >= self._max_retries:
                     raise
+                else:
+                    attempt += 1
             except exc.TaskError:
                 # A collective timing out inside a surviving worker usually
                 # means a peer died mid-epoch; anything else is a user error.
-                if attempt == self._max_retries or not self._any_worker_dead():
+                broken, planned = self._gang_interrupted()
+                if not broken:
                     raise
+                if planned and planned_regangs < self._MAX_PLANNED_REGANGS:
+                    planned_regangs += 1
+                elif attempt >= self._max_retries:
+                    raise
+                else:
+                    attempt += 1
             time.sleep(0.5)
             try:
                 self._resize_worker_group()
             except Exception:
-                if attempt == self._max_retries:
+                if attempt >= self._max_retries:
                     raise
                 # group left empty; next attempt resizes again
 

@@ -194,6 +194,16 @@ def _leak_notes(leaked_pids: dict, leaked_segs: set) -> str:
         return ""
     notes: list[str] = []
     try:
+        # drained-node state: a node still DRAINING when the driver shut
+        # down means a drain never finished — its raylet process is the
+        # usual orphan, so name the wedge before the bare pids
+        for n in (snap.get("gcs") or {}).get("nodes_table") or []:
+            if n.get("state") == "DRAINING":
+                notes.append(
+                    f"  node {n.get('node_id')} still DRAINING at "
+                    f"shutdown (conn_live={n.get('conn_live')}) — drain "
+                    f"never reached DRAINED; its raylet is the likely "
+                    f"orphan")
         by_pid: dict[int, str] = {}
         for label, proc in debug_state.iter_processes(snap):
             pid = proc.get("pid")

@@ -38,9 +38,16 @@ def test_scale_up_on_pending_and_down_when_idle(ray_start_cluster):
     ray_tpu.get(s.ready.remote(), timeout=60)
     refs = [work.remote() for _ in range(4)]  # head saturated -> pending
 
-    time.sleep(0.7)  # let leases queue
-    stats = scaler.update()
-    assert stats["launched"] >= 1, "no scale-up despite pending work"
+    # The pending-lease signal rides the raylet heartbeat's metrics
+    # piggyback (every ~2s), so poll the reconcile until a sample with
+    # queued leases lands in the director's history ring.
+    launched = 0
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline and not launched:
+        launched += scaler.update()["launched"]
+        if not launched:
+            time.sleep(0.5)
+    assert launched >= 1, "no scale-up despite pending work"
     assert provider.non_terminated_nodes()
 
     nodes = ray_tpu.get(refs, timeout=120)
@@ -48,14 +55,201 @@ def test_scale_up_on_pending_and_down_when_idle(ray_start_cluster):
     assert any(n != head_id for n in nodes), (
         "work never reached the autoscaled node")
 
-    # Idle: after idle_timeout the worker node is reaped.
-    deadline = time.monotonic() + 30
+    # Idle: after idle_timeout the worker node is DRAINED, then reaped.
+    # The busy predicate looks back over the whole metrics window, so
+    # the recently-active node stays pinned until its active-lease
+    # samples age out (~metrics_window * 2s), then drains gracefully.
+    from tests.conftest import scale_timeout
+
+    deadline = time.monotonic() + scale_timeout(60)
     while time.monotonic() < deadline:
         stats = scaler.update()
         if not provider.non_terminated_nodes():
             break
         time.sleep(0.5)
     assert not provider.non_terminated_nodes(), "idle node never reaped"
+
+
+# ---------------------------------------------------------------------------
+# offline reconcile units: canned director replies, fake provider — the
+# deficit math, clamps, idle reaping, and the never-terminate-a-non-
+# drained-node invariant, with zero processes
+# ---------------------------------------------------------------------------
+
+
+class _FakeProvider:
+    def __init__(self):
+        self._nodes: list[str] = []
+        self._ids: dict[str, bytes] = {}
+        self.created = 0
+        self.terminated: list[str] = []
+        self._next = 0
+
+    def non_terminated_nodes(self):
+        return list(self._nodes)
+
+    def create_node(self, node_config, count=1):
+        out = []
+        for _ in range(count):
+            pid = f"fake-{self._next}"
+            self._next += 1
+            self._nodes.append(pid)
+            out.append(pid)
+        self.created += count
+        return out
+
+    def terminate_node(self, pid):
+        self._nodes.remove(pid)
+        self.terminated.append(pid)
+
+    def record_node_id(self, pid, node_id):
+        self._ids[pid] = node_id
+
+    def node_id_of(self, pid):
+        return self._ids.get(pid)
+
+
+def _idle_series():
+    return {"raylet.pending_leases": [[0.0, 0]],
+            "raylet.active_leases": [[0.0, 0]],
+            "raylet.transfer_pins": [[0.0, 0]]}
+
+
+class _FakeDirector:
+    """Stands in for `_rpc_many`: canned node table + history, and a
+    drain_node endpoint that flips the node to DRAINING (and later out
+    of the table, like _finish_drain does)."""
+
+    def __init__(self):
+        self.nodes: list[dict] = []
+        self.history: dict[str, dict] = {}
+        self.pending = 0
+        self.drain_calls: list[bytes] = []
+
+    def add_node(self, i, busy=False, head=False):
+        node_id = bytes([i + 1]) * 16
+        self.nodes.append({"node_id": node_id, "address": f"sim://{i}",
+                           "is_head": head, "state": "ALIVE"})
+        series = _idle_series()
+        if busy:
+            series["raylet.active_leases"] = [[0.0, 1]]
+        self.history[f"{node_id.hex()[:8]}/raylet"] = series
+        return node_id
+
+    def __call__(self, address, calls):
+        out = []
+        for method, data in calls:
+            if method == "get_all_nodes":
+                out.append([dict(n) for n in self.nodes])
+            elif method == "get_metrics_history":
+                h = dict(self.history)
+                if self.pending and self.nodes:
+                    src = (f"{self.nodes[0]['node_id'].hex()[:8]}"
+                           "/raylet")
+                    h[src] = dict(h.get(src) or _idle_series())
+                    h[src]["raylet.pending_leases"] = [[0.0, self.pending]]
+                out.append(h)
+            elif method == "drain_node":
+                self.drain_calls.append(data["node_id"])
+                for n in self.nodes:
+                    if n["node_id"] == data["node_id"]:
+                        n["state"] = "DRAINING"
+                out.append({"state": "DRAINING", "deadline_s": 30.0})
+            else:
+                raise AssertionError(f"unexpected rpc {method}")
+        return out
+
+    def finish_drains(self):
+        self.nodes = [n for n in self.nodes if n["state"] == "ALIVE"]
+
+
+def _scaler(director, provider, **kw):
+    kw.setdefault("min_workers", 0)
+    kw.setdefault("max_workers", 4)
+    kw.setdefault("idle_timeout_s", 0.0)
+    kw.setdefault("drain_grace_s", 60.0)
+    s = StandardAutoscaler(provider, gcs_address="fake://", **kw)
+    s._rpc_many = director
+    return s
+
+
+def test_update_deficit_and_max_clamp():
+    d = _FakeDirector()
+    d.add_node(0, head=True)
+    s = _scaler(d, _FakeProvider(), max_workers=2)
+    d.pending = 5
+    stats = s.update()  # deficit 5, clamped to max_workers room
+    assert stats["launched"] == 2
+    assert s.provider.created == 2
+    # at the cap: more pending launches nothing
+    assert s.update()["launched"] == 0
+
+
+def test_update_min_workers_floor():
+    d = _FakeDirector()
+    d.add_node(0, head=True)
+    s = _scaler(d, _FakeProvider(), min_workers=2)
+    assert s.update()["launched"] == 2  # no pending; floor alone launches
+
+
+def test_update_idle_reap_through_drain():
+    d = _FakeDirector()
+    d.add_node(0, head=True)
+    p = _FakeProvider()
+    s = _scaler(d, p)
+    nid = d.add_node(1)
+    (pid,) = p.create_node({})
+    p.record_node_id(pid, nid)
+
+    stats = s.update()  # idle_timeout 0: drain starts immediately
+    assert d.drain_calls == [nid]
+    assert stats["draining"] == 1
+    # mid-drain: node still in the table -> MUST NOT be terminated
+    assert p.terminated == []
+    assert s.update()["terminated"] == 0
+    assert p.terminated == []
+    # GCS finalizes DRAINED (node leaves the table) -> now reaped
+    d.finish_drains()
+    assert s.update()["terminated"] == 1
+    assert p.terminated == [pid]
+
+
+def test_update_never_reaps_below_min_workers():
+    d = _FakeDirector()
+    d.add_node(0, head=True)
+    p = _FakeProvider()
+    s = _scaler(d, p, min_workers=1)
+    nid = d.add_node(1)
+    (pid,) = p.create_node({})
+    p.record_node_id(pid, nid)
+    for _ in range(3):
+        s.update()
+    assert d.drain_calls == [], "drained the last node below min_workers"
+    assert p.non_terminated_nodes() == [pid]
+
+
+def test_update_busy_node_not_reaped_and_wedged_drain_gives_up():
+    d = _FakeDirector()
+    d.add_node(0, head=True)
+    p = _FakeProvider()
+    s = _scaler(d, p, drain_grace_s=0.05)
+    busy_nid = d.add_node(1, busy=True)
+    idle_nid = d.add_node(2)
+    pid_busy, pid_idle = p.create_node({}, count=2)
+    p.record_node_id(pid_busy, busy_nid)
+    p.record_node_id(pid_idle, idle_nid)
+
+    s.update()
+    assert d.drain_calls == [idle_nid], "busy node must not drain"
+    # the drain wedges (node never leaves the table): within the grace
+    # window nothing is terminated...
+    assert p.terminated == []
+    # ...but past drain_deadline+grace the GCS has already reaped it as
+    # DEAD, so the machine is a corpse and the provider may collect it
+    time.sleep(0.06)
+    s.update()
+    assert p.terminated == [pid_idle]
+    assert pid_busy in p.non_terminated_nodes()
 
 
 def test_tpu_pod_provider_offline_control_flow():

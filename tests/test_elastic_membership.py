@@ -1,0 +1,371 @@
+"""Elastic membership end to end (ISSUE 19): graceful drain (object
+migration + in-flight completion + DRAINED-not-DEAD), actor checkpoint/
+restore across a preemption-notice compressed drain, ICI_RING
+re-placement around the drained torus hole, the seeded kill-mid-drain
+chaos sweep, and the elastic scale-sim smoke."""
+
+import asyncio
+import os
+import random
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import exceptions as exc
+from ray_tpu._private import failpoints as fp
+from ray_tpu._private import rpc
+from ray_tpu._private.node import start_gcs
+
+from tests.conftest import scale_timeout, state_dump_on_failure
+
+
+def _start(cluster, nodes):
+    cluster.gcs_svc, cluster.gcs_address = start_gcs(
+        cluster.session_dir, cluster.config)
+    for i, kw in enumerate(nodes):
+        cluster.add_node(is_head=(i == 0), **kw)
+    cluster.connect_driver()
+
+
+def _gcs(cluster, method, data=None):
+    async def _go():
+        conn = await rpc.connect(cluster.gcs_address, name="drain-test")
+        try:
+            return await conn.call(method, data or {}, timeout=15)
+        finally:
+            await conn.close()
+
+    return asyncio.run(_go())
+
+
+def _drain(cluster, node, preempt=False):
+    reply = _gcs(cluster, "drain_node",
+                 {"node_id": node.node_id.binary(), "preempt": preempt})
+    assert reply["state"] == "DRAINING", reply
+    return reply
+
+
+def _wait_node_gone(cluster, node, timeout):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        nodes = _gcs(cluster, "get_all_nodes")
+        if all(n["node_id"] != node.node_id.binary() for n in nodes):
+            return
+        time.sleep(0.1)
+    raise AssertionError(
+        f"node {node.node_id.hex()[:8]} never left the GCS table")
+
+
+def _node_events(cluster, node):
+    node8 = node.node_id.hex()[:8]
+    return [e["label"] for e in _gcs(cluster, "get_events")
+            if node8 in e.get("message", "")]
+
+
+# ---------------------------------------------------------------------------
+# graceful drain: the deterministic acceptance scenario
+# ---------------------------------------------------------------------------
+
+
+def test_drain_migrates_objects_and_finishes_tasks(ray_start_cluster):
+    """A node with resident plasma objects AND in-flight tasks drains:
+    zero task failures, every object bit-exact from survivors, and the
+    GCS reads the departure as DRAINED (planned), never DEAD."""
+    cluster = ray_start_cluster
+    _start(cluster, [{"num_cpus": 2},
+                     {"num_cpus": 2},
+                     {"num_cpus": 2, "resources": {"b": 2}}])
+    target = cluster.nodes[2]
+
+    # >100KB so returns land in the target's plasma, not inline
+    @ray_tpu.remote(num_cpus=1, resources={"b": 0.1})
+    def blob(i):
+        return np.full(300_000, i, dtype=np.int32)
+
+    @ray_tpu.remote(num_cpus=1, resources={"b": 0.1})
+    def slow(i):
+        time.sleep(1.5)
+        return np.full(200_000, 100 + i, dtype=np.int32)
+
+    resident = [blob.remote(i) for i in range(3)]
+    done, _ = ray_tpu.wait(resident, num_returns=len(resident),
+                           timeout=scale_timeout(60))
+    assert len(done) == len(resident)
+    in_flight = [slow.remote(i) for i in range(2)]
+    time.sleep(0.3)  # let the leases grant on the target
+
+    _drain(cluster, target)
+    # idempotent: a second request reports the in-progress drain
+    assert _gcs(cluster, "drain_node",
+                {"node_id": target.node_id.binary()})["state"] == "DRAINING"
+    _wait_node_gone(cluster, target, scale_timeout(45))
+
+    # in-flight tasks finished inside the drain window — zero failures
+    for i, ref in enumerate(in_flight):
+        got = ray_tpu.get(ref, timeout=scale_timeout(30))
+        assert (got == 100 + i).all() and got.shape == (200_000,)
+    # resident objects were migrated to survivors before the node left:
+    # still resolvable, bit-exact (the h_drain_node regression — the old
+    # handler removed the node outright and stranded these)
+    for i, ref in enumerate(resident):
+        got = ray_tpu.get(ref, timeout=scale_timeout(30))
+        assert (got == i).all() and got.shape == (300_000,)
+
+    labels = _node_events(cluster, target)
+    assert "NODE_DRAINING" in labels and "NODE_DRAINED" in labels
+    assert "NODE_REMOVED" not in labels, "planned drain took the crash path"
+
+
+def test_cli_drain_subcommand(ray_start_cluster, capsys):
+    """`ray-tpu drain <node8> --wait`: resolves the prefix, starts the
+    drain, blocks to DRAINED; refuses to drain the head."""
+    from ray_tpu.scripts import cli
+
+    cluster = ray_start_cluster
+    _start(cluster, [{"num_cpus": 2}, {"num_cpus": 1}])
+    target = cluster.nodes[1]
+    assert cli.main(["drain", target.node_id.hex()[:8],
+                     "--address", cluster.gcs_address,
+                     "--wait", "--timeout", "60"]) == 0
+    out = capsys.readouterr().out
+    assert "DRAINING" in out and "DRAINED" in out
+    assert cli.main(["drain", cluster.head_node.node_id.hex()[:8],
+                     "--address", cluster.gcs_address]) == 1
+    assert "refusing" in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------------
+# actor checkpoint/restore + preemption notice
+# ---------------------------------------------------------------------------
+
+
+def test_preempt_drain_checkpoints_actor_state_to_survivor(
+        ray_start_cluster):
+    """Compressed (preemption) drain: the actor's __ray_checkpoint__
+    state lands in the control plane, the actor relocates to a survivor
+    WITHOUT burning a restart, and the new incarnation restores via
+    __ray_restore__."""
+    cluster = ray_start_cluster
+    # TWO nodes carry the actor's custom resource: whichever hosts it
+    # gets drained, the other is the feasible relocation target
+    _start(cluster, [{"num_cpus": 2},
+                     {"num_cpus": 2, "resources": {"b": 1}},
+                     {"num_cpus": 2, "resources": {"b": 1}}])
+
+    @ray_tpu.remote(num_cpus=1, resources={"b": 1}, max_restarts=1)
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def bump(self):
+            self.n += 1
+            return self.n
+
+        def where(self):
+            from ray_tpu._private import global_state
+            return global_state.require_core_worker().node_id.binary()
+
+        def __ray_checkpoint__(self):
+            return {"n": self.n}
+
+        def __ray_restore__(self, state):
+            self.n = state["n"]
+
+    c = Counter.remote()
+    for _ in range(3):
+        ray_tpu.get(c.bump.remote(), timeout=scale_timeout(60))
+    home = ray_tpu.get(c.where.remote(), timeout=scale_timeout(30))
+    (target,) = [n for n in cluster.nodes if n.node_id.binary() == home]
+
+    _drain(cluster, target, preempt=True)
+    _wait_node_gone(cluster, target, scale_timeout(30))
+
+    # the relocated incarnation carries the checkpointed count: bump -> 4
+    deadline = time.monotonic() + scale_timeout(40)
+    got = None
+    while time.monotonic() < deadline:
+        try:
+            got = ray_tpu.get(c.bump.remote(), timeout=scale_timeout(20))
+            break
+        except (exc.ActorUnavailableError, exc.GetTimeoutError):
+            time.sleep(0.3)
+    assert got == 4, f"checkpointed state lost across the drain: {got}"
+    assert ray_tpu.get(c.where.remote(),
+                       timeout=scale_timeout(30)) != target.node_id.binary()
+
+    cm = ray_tpu.cluster_metrics()
+    assert cm["gcs"].get("gcs.preemption_notices_total",
+                         {}).get("value", 0) >= 1
+    labels = _node_events(cluster, target)
+    assert "NODE_DRAINED" in labels and "NODE_REMOVED" not in labels
+
+
+def test_preemption_notice_failpoint_triggers_compressed_drain(
+        ray_start_cluster, monkeypatch):
+    """`node.preempt_notice` armed in ONE raylet (env-inherited, the
+    cloud's spot-reclaim warning): that node requests its own compressed
+    drain on the next heartbeat and leaves as DRAINED. Repeat notices on
+    the already-draining node are idempotent."""
+    cluster = ray_start_cluster
+    _start(cluster, [{"num_cpus": 2}, {"num_cpus": 2}])
+    monkeypatch.setenv("RAY_TPU_FAILPOINTS",
+                       "node.preempt_notice=raise(role=raylet)")
+    doomed = cluster.add_node(num_cpus=1)
+    monkeypatch.delenv("RAY_TPU_FAILPOINTS")
+
+    _wait_node_gone(cluster, doomed, scale_timeout(30))
+    labels = _node_events(cluster, doomed)
+    assert "NODE_DRAINING" in labels and "NODE_DRAINED" in labels
+    assert "NODE_REMOVED" not in labels
+    cm = ray_tpu.cluster_metrics()
+    assert cm["gcs"].get("gcs.preemption_notices_total",
+                         {}).get("value", 0) >= 1
+    # survivors are untouched
+    nodes = _gcs(cluster, "get_all_nodes")
+    assert len(nodes) == 2 and all(n["state"] == "ALIVE" for n in nodes)
+
+
+# ---------------------------------------------------------------------------
+# ICI_RING re-placement around the torus hole
+# ---------------------------------------------------------------------------
+
+
+def test_ici_ring_replacement_masks_drained_coords(ray_start_cluster):
+    """Drain a node out of a 1x5 torus, then place an ICI_RING gang:
+    the ring snakes around the hole (no bundle on the departed node)
+    and the placement record stamps the departed coord as masked."""
+    from ray_tpu.util.placement_group import (placement_group,
+                                              placement_group_table,
+                                              remove_placement_group)
+
+    cluster = ray_start_cluster
+    _start(cluster, [
+        {"num_cpus": 1, "topology": {"slice_id": "s0", "coords": [i],
+                                     "dims": [5]}}
+        for i in range(5)])
+    hole = cluster.nodes[2]
+    _drain(cluster, hole)
+    _wait_node_gone(cluster, hole, scale_timeout(30))
+
+    pg = placement_group([{"CPU": 1}] * 4, strategy="ICI_RING")
+    assert pg.ready(timeout=scale_timeout(20))
+    rec = placement_group_table()[pg.id.hex()]
+    assert all(b["node_id"] != hole.node_id.binary()
+               for b in rec["bundles"]), "bundle placed on drained node"
+    plan = rec.get("topology_plan") or {}
+    masked = plan.get("masked_coords") or []
+    assert any(m.get("coords") == [2] for m in masked), (
+        f"departed coord not masked in the plan: {plan}")
+    cm = ray_tpu.cluster_metrics()
+    assert cm["gcs"].get("gcs.ring_replacements_total",
+                         {}).get("value", 0) >= 1
+    remove_placement_group(pg)
+
+
+# ---------------------------------------------------------------------------
+# chaos: node killed MID-drain (slow tier: pytest -m chaos)
+# ---------------------------------------------------------------------------
+
+_SEEDS = ([int(os.environ["RAY_TPU_CHAOS_SEED"])]
+          if os.environ.get("RAY_TPU_CHAOS_SEED")
+          else [211, 212, 213, 214, 215])
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+@pytest.mark.parametrize("seed", _SEEDS)
+def test_chaos_kill_mid_drain(seed, ray_start_cluster):
+    """SIGKILL the draining node partway through its migration pass
+    (transfer.migrate=delay stretches the window; the kill instant is
+    seeded): every object either migrated in time (bit-exact from a
+    survivor) or is a typed ObjectLostError — never a hang, never
+    corruption — and the survivors' resources return to full (no leaked
+    pins/leases)."""
+    rng = random.Random(seed)
+    cluster = ray_start_cluster
+    _start(cluster, [{"num_cpus": 2},
+                     {"num_cpus": 2},
+                     {"num_cpus": 2, "resources": {"b": 2}}])
+    target = cluster.nodes[2]
+
+    @ray_tpu.remote(num_cpus=1, resources={"b": 0.1})
+    def blob(i):
+        return np.full(200_000, i, dtype=np.int32)
+
+    refs = [blob.remote(i) for i in range(4)]
+    done, _ = ray_tpu.wait(refs, num_returns=len(refs),
+                           timeout=scale_timeout(60))
+    assert len(done) == len(refs)
+
+    delay_ms = rng.choice([50, 150, 300, 600])
+    kill_after = rng.uniform(0.0, 1.2)
+    print(f"[chaos] seed={seed} migrate_delay={delay_ms}ms "
+          f"kill_after={kill_after:.2f}s "
+          f"(replay: RAY_TPU_CHAOS_SEED={seed})")
+    fp.arm_cluster(f"transfer.migrate=delay(ms={delay_ms},role=raylet)")
+    try:
+        time.sleep(0.2)  # arming rides pubsub to the raylets
+        _drain(cluster, target)
+        time.sleep(kill_after)
+        cluster.remove_node(target)  # SIGKILL mid-drain
+
+        migrated = lost = 0
+        with state_dump_on_failure(f"kill-mid-drain-seed{seed}"):
+            for i, ref in enumerate(refs):
+                try:
+                    got = ray_tpu.get(ref, timeout=scale_timeout(30))
+                    assert (got == i).all(), "SILENT CORRUPTION"
+                    migrated += 1
+                except exc.ObjectLostError:
+                    lost += 1
+        print(f"[chaos seed={seed}] {migrated} migrated, {lost} typed-lost")
+    finally:
+        fp.disarm_cluster()
+
+    # no leaked pins/leases: every survivor's availability returns to
+    # its registered total
+    from ray_tpu._private.common import ResourceSet
+
+    deadline = time.monotonic() + scale_timeout(30)
+    while time.monotonic() < deadline:
+        nodes = _gcs(cluster, "get_all_nodes")
+        avail = _gcs(cluster, "get_available_resources")
+        totals = {n["node_id"]: ResourceSet.from_raw(n["resources"])
+                  for n in nodes}
+        free = {nid: ResourceSet.from_raw(raw)
+                for nid, raw in avail.items()}
+        if (len(nodes) == 2
+                and all(free.get(nid) is not None
+                        and free[nid].get("CPU") == t.get("CPU")
+                        for nid, t in totals.items())):
+            break
+        time.sleep(0.2)
+    else:
+        raise AssertionError("survivor resources never returned to full "
+                             "(leaked lease or pin)")
+
+
+# ---------------------------------------------------------------------------
+# elastic scale-sim smoke (tier-1 gate for `ray-tpu scalesim --elastic`)
+# ---------------------------------------------------------------------------
+
+
+def test_elastic_sim_smoke(tmp_path):
+    from ray_tpu.scalesim import run_elastic_sim
+
+    out = tmp_path / "elastic.json"
+    result = run_elastic_sim(raylets=3, windows=3, objects_per_node=2,
+                             out=str(out))
+    assert out.exists()
+    arms = result["arms"]
+    assert set(arms) == {"static", "drain", "kill"}
+    # drain-aware: follows demand (cheaper than static) AND loses
+    # nothing (unlike kill) — the planned-vs-crash A/B in one line
+    assert arms["drain"]["objects_lost"] == 0
+    assert arms["drain"]["departures"] >= 1
+    assert arms["kill"]["objects_lost"] > 0
+    assert arms["drain"]["node_hours"] < arms["static"]["node_hours"]
+    assert arms["drain"]["score"] < arms["kill"]["score"]
+    assert result["bytes_saved_vs_kill"] > 0
