@@ -163,6 +163,13 @@ class Config:
     # declare a DRAINING node DEAD (covers the final migrate/ack RTT).
     drain_grace_s: float = 5.0
 
+    # --- training ---
+    # Batches each train worker keeps in flight against its DatasetShard
+    # ingest actor (train/ingest.py). 2 = double buffering: the next
+    # batch transfers over the bulk channel while the current step
+    # computes, so a healthy pipeline shows train.ingest_wait_s p50 ~ 0.
+    train_ingest_prefetch_depth: int = 2
+
     # --- rpc ---
     rpc_connect_timeout_s: float = 10.0
     rpc_call_timeout_s: float = 0.0  # 0 = no timeout

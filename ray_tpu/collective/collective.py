@@ -294,11 +294,15 @@ def allgather(tensor, group_name: str = "default"):
 
 
 def reducescatter(tensor, group_name: str = "default",
-                  op: ReduceOp = ReduceOp.SUM):
+                  op: ReduceOp = ReduceOp.SUM, quantize=None):
+    """quantize: per-op wire codec override ("int8" / None), same
+    semantics as the group-construction default — the sharded trainer's
+    grad bucket rides this knob."""
     group = _manager.get_group(group_name)
     t = _prep(tensor)
     return _traced_op("collective.reducescatter", group_name,
-                      lambda: group.reducescatter(t, op), t.nbytes)
+                      lambda: group.reducescatter(t, op, quantize=quantize),
+                      t.nbytes)
 
 
 def barrier(group_name: str = "default"):

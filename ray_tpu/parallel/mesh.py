@@ -124,6 +124,35 @@ class MeshSpec:
         return cls.auto(total, tp=tp, pp=pp, sp=sp, ep=ep)
 
 
+def fsdp_mesh(devices=None) -> Mesh:
+    """The topology-derived ('data', 'fsdp') mesh for
+    Trainer(mesh_mode="fsdp"): device count -> mesh_shape_for's
+    predefined (data, fsdp) factorization — the SAME table the ICI_RING
+    placement record carries, so gang rank order and mesh layout agree.
+    Batch shards over 'data', params/optimizer state over 'fsdp'."""
+    devices = list(devices) if devices is not None else jax.devices()
+    shape = mesh_shape_for(len(devices))
+    n = shape[0] * shape[1]
+    arr = np.array(devices[:n]).reshape(shape)
+    return Mesh(arr, ("data", "fsdp"))
+
+
+def fsdp_param_specs(params, mesh: Mesh):
+    """Per-leaf PartitionSpecs sharding each param over the 'fsdp' axis
+    along its leading dimension when that divides evenly; small or
+    indivisible leaves (biases, scalars) stay replicated — the standard
+    FSDP layout compromise."""
+    fsdp = mesh.shape["fsdp"]
+
+    def spec(p):
+        shape = getattr(p, "shape", ())
+        if shape and shape[0] % fsdp == 0 and shape[0] >= fsdp > 1:
+            return P("fsdp", *([None] * (len(shape) - 1)))
+        return P()
+
+    return jax.tree.map(spec, params)
+
+
 def batch_sharding(mesh: Mesh) -> NamedSharding:
     """Inputs: batch over dp, sequence over sp."""
     return NamedSharding(mesh, P("dp", "sp"))

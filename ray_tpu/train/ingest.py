@@ -1,0 +1,149 @@
+"""Streaming ingest pipeline: sharded dataset actors produce per-rank
+batches into the object plane; each train worker prefetches
+`prefetch_depth` batches ahead (double-buffered at the default depth 2)
+so input time overlaps step compute instead of serializing before it.
+
+Data path: `DatasetShard.next_batch` returns the batch through the
+normal actor return path — large batches land in plasma and cross-node
+pulls ride the bulk transfer channel (raylet/transfer.py), so the
+worker's prefetched ObjectRefs resolve via striped chunk streams, not
+pickles through the driver. The worker's `IngestStream` keeps at most
+`prefetch_depth` requests in flight and observes `train.ingest_wait_s`
+around each blocking get — the "is training input-bound?" histogram.
+
+Failure domain: an ingest actor dying mid-epoch surfaces as a typed
+actor error inside the consuming worker's epoch; the Trainer's gang
+scan treats dead ingest actors like dead workers (resize restarts the
+gang AND its dataset actors at the surviving world size, re-sharding
+the dataset over the new rank count). Un-consumed prefetched refs are
+dropped on every exit path, so no plasma batches leak."""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import pickle
+import time
+from typing import Any, Callable
+
+from ray_tpu._private import failpoints as _fp
+
+# End-of-epoch sentinel: actor returns (not raises) it so prefetched
+# requests past the end resolve cheaply instead of erroring.
+_END = "__ray_tpu_ingest_end__"
+
+
+@dataclasses.dataclass
+class IngestSpec:
+    """Trainer(ingest=IngestSpec(...)) — one DatasetShard actor per
+    worker rank.
+
+    dataset_fn(shard_index, num_shards, config) -> either a reusable
+    sequence of batches (replayed every epoch) or a callable
+    ``epoch -> iterable`` for epoch-varying streams. Cloudpickled to
+    the actor, so closures and __main__ classes work.
+
+    prefetch_depth: in-flight batches per worker (None = the
+    `train_ingest_prefetch_depth` config knob, default 2 — double
+    buffering). resources: per-dataset-actor resource dict
+    (default {"CPU": 1})."""
+
+    dataset_fn: Callable[[int, int, dict], Any]
+    prefetch_depth: int | None = None
+    resources: dict | None = None
+
+
+class DatasetShard:
+    """Actor producing one rank's batch stream. Single-threaded actor
+    semantics give in-order `next_batch` delivery, so the worker's
+    pipelined requests arrive as a strictly sequential pull."""
+
+    def __init__(self, dataset_fn_pickled: bytes, shard_index: int,
+                 num_shards: int, config: dict | None):
+        fn = pickle.loads(dataset_fn_pickled)
+        self._source = fn(shard_index, num_shards, config or {})
+        self._gen = None
+        self._iter = None
+
+    def next_batch(self, gen: int, epoch: int):
+        """Next batch of the consumer's iteration `gen`, or the end
+        sentinel. A new gen rebuilds the iterator — gen (not epoch) is
+        the rebuild key so an epoch RETRIED after a mid-stream abort
+        replays from the start instead of resuming a half-consumed
+        iterator. Sequences replay as-is; callables get the epoch."""
+        if _fp.ARMED:
+            _fp.fire_strict("train.ingest_batch")
+        if gen != self._gen:
+            src = (self._source(epoch) if callable(self._source)
+                   else self._source)
+            self._iter = iter(src)
+            self._gen = gen
+        try:
+            return next(self._iter)
+        except StopIteration:
+            return _END
+
+    def ping(self):
+        return True
+
+    def failpoints(self):
+        """Chaos-test introspection: this actor process's failpoint
+        registry. Cluster arming rides pubsub (async); tests poll this
+        until the spec lands before relying on an armed point."""
+        return _fp.snapshot()
+
+
+class IngestStream:
+    """Worker-side iterable over one DatasetShard, `depth` requests in
+    flight. Fresh iterator per epoch (the operator's epoch counter is
+    read lazily, so one IngestStream instance serves the whole run)."""
+
+    def __init__(self, actor, depth: int, epoch_fn: Callable[[], int],
+                 get_timeout: float = 300.0):
+        self._actor = actor
+        self._depth = max(1, int(depth))
+        self._epoch_fn = epoch_fn
+        self._timeout = get_timeout
+        self._gen = 0
+
+    def __iter__(self):
+        import ray_tpu
+        from ray_tpu.train import metrics as _tm
+
+        epoch = self._epoch_fn()
+        self._gen += 1
+        gen = self._gen
+        refs: collections.deque = collections.deque()
+        try:
+            while True:
+                while len(refs) < self._depth:
+                    refs.append(self._actor.next_batch.remote(gen, epoch))
+                t0 = time.perf_counter()
+                batch = ray_tpu.get(refs.popleft(), timeout=self._timeout)
+                _tm.INGEST_WAIT_S.observe(time.perf_counter() - t0)
+                if isinstance(batch, str) and batch == _END:
+                    return
+                yield batch
+        finally:
+            # Drop in-flight refs on every exit (end, error, early
+            # break): out-of-scope ObjectRefs release their plasma
+            # entries — the conftest leak check holds us to this.
+            refs.clear()
+
+
+def hist_quantile(snap: dict, q: float) -> float:
+    """Quantile upper bound from a Histogram snapshot (bench/gate
+    readback for `train.ingest_wait_s`): the boundary of the bucket
+    where the cumulative count crosses q (inf for the overflow
+    bucket)."""
+    n = snap.get("count", 0)
+    if not n:
+        return 0.0
+    target = q * n
+    cum = 0
+    for i, c in enumerate(snap["counts"]):
+        cum += c
+        if cum >= target:
+            bounds = snap["boundaries"]
+            return bounds[i] if i < len(bounds) else float("inf")
+    return float("inf")

@@ -1,0 +1,34 @@
+"""Training-plane metrics (registered at import so the metrics-registry
+drift gate — tests/test_observability.py — can hold ARCHITECTURE.md to
+them).
+
+step_s is the FULL step: input wait (ingest get / loader next) +
+dispatch; ingest_wait_s isolates the input half, so "input-bound" reads
+directly off the pair (a healthy double-buffered ingest pipeline keeps
+ingest_wait_s p50 ~0 while step_s tracks compute). optim_shard_bytes is
+the per-process optimizer-state footprint — 1/N of the replicated
+figure once the weight update is sharded."""
+
+from __future__ import annotations
+
+from ray_tpu._private import stats
+
+STEP_S = stats.Histogram(
+    "train.step_s", stats.LATENCY_BOUNDARIES_S,
+    "one training step wall time, input wait included (per worker)")
+
+TOKENS_TOTAL = stats.Count(
+    "train.tokens_total",
+    "training examples consumed by dispatched steps (per worker; "
+    "tokens/s = delta over the metrics history)")
+
+INGEST_WAIT_S = stats.Histogram(
+    "train.ingest_wait_s", stats.LATENCY_BOUNDARIES_S,
+    "time the step loop blocked waiting for the next prefetched ingest "
+    "batch (p50 ~0 = input fully overlapped with compute)")
+
+OPT_SHARD_BYTES = stats.Gauge(
+    "train.optim_shard_bytes",
+    "bytes of optimizer state held by this worker (the local 1/N shard "
+    "under the sharded weight update; the full replicated state "
+    "otherwise)")

@@ -83,12 +83,28 @@ class TrainingOperator:
         self._eval_fn = eval_fn
         self._optimizer = optimizer
         self._stateful = stateful
-        self._mesh = mesh
         if stateful:
             self.params, self.model_state = model_init(jax.random.key(seed))
         else:
             self.params = model_init(jax.random.key(seed))
             self.model_state = None
+        if mesh is None and self.config.get("mesh_mode") == "fsdp":
+            # FSDP mesh mode: the topology-derived ('data','fsdp') mesh
+            # (parallel.mesh.mesh_shape_for — the same table the
+            # ICI_RING placement record carries), params sharded over
+            # the fsdp axis, batch over data. The fused step stays ONE
+            # jit: with_sharding_constraint pins the updated params so
+            # XLA keeps every optimizer buffer on its shard.
+            from jax.sharding import PartitionSpec as P
+
+            from ray_tpu.parallel import mesh as _meshlib
+
+            mesh = _meshlib.fsdp_mesh()
+            if param_spec is None:
+                param_spec = _meshlib.fsdp_param_specs(self.params, mesh)
+            if batch_spec is None:
+                batch_spec = P("data")
+        self._mesh = mesh
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -108,12 +124,48 @@ class TrainingOperator:
                                                   to_sharding(None))
             self._batch_sharding = to_sharding(
                 batch_spec if batch_spec is not None else P("dp"))
-        # After placement: optax init inherits the params' shardings
-        # (zeros_like preserves sharding), so optimizer state is laid out
-        # like the params without extra plumbing.
-        self.opt_state = optimizer.init(self.params)
+            self._param_shardings = jax.tree.map(
+                lambda p: p.sharding, self.params)
+        else:
+            self._param_shardings = None
+        # sharded stays on at world_size == 1 (collectives degenerate to
+        # identity) so an elastic resize N→1→N keeps ONE state layout —
+        # optimizer shards merge/split instead of changing format.
+        self._sharded = (bool(self.config.get("sharded_update"))
+                         and mesh is None)
         _, self._unravel = ravel_pytree(self.params)
+        if self._sharded:
+            self._init_sharded_state()
+        else:
+            # After placement: optax init inherits the params' shardings
+            # (zeros_like preserves sharding), so optimizer state is laid
+            # out like the params without extra plumbing.
+            self.opt_state = optimizer.init(self.params)
+        from ray_tpu.train import metrics as _tm
+        from ray_tpu.train import sharding as _shard
+
+        _tm.OPT_SHARD_BYTES.set(_shard.opt_nbytes(self.opt_state))
         self._build_steps()
+
+    def _init_sharded_state(self):
+        """ZeRO weight-update sharding (arXiv:2004.13336): this rank
+        keeps the FULL params (needed for the forward) but only 1/N of
+        the optimizer state — optax initialized on the rank's uniform
+        span of the padded flat param bucket (layout: train/sharding.py).
+        The step becomes reducescatter(grads) → local shard update →
+        allgather(params)."""
+        from ray_tpu.train import sharding as _shard
+
+        flat, _ = ravel_pytree(self.params)
+        self._numel = int(flat.size)
+        self._pad_numel = _shard.padded_numel(self._numel, self.world_size)
+        self._shard_lo, self._shard_hi = _shard.shard_span(
+            self._numel, self.world_size, self.world_rank)
+        self._param_shard = jnp.pad(
+            flat, (0, self._pad_numel - self._numel)
+        )[self._shard_lo:self._shard_hi]
+        self.opt_state = self._optimizer.init(self._param_shard)
+        self._opt_treedef = jax.tree.structure(self.opt_state)
 
     def register_data(self, *, train_loader: Iterable | None = None,
                       validation_loader: Iterable | None = None):
@@ -128,6 +180,15 @@ class TrainingOperator:
         loss_fn, optimizer = self._loss_fn, self._optimizer
         unravel = self._unravel
         stateful = self._stateful
+        shardings = self._param_shardings
+
+        def pin(params):
+            # FSDP/mesh mode: constrain the UPDATED params back onto
+            # their named shardings so the whole fused step — grads,
+            # optimizer buffers, update — stays sharded inside one jit
+            # instead of XLA replicating intermediates.
+            return (params if shardings is None
+                    else jax.lax.with_sharding_constraint(params, shardings))
         # compile observability (profiling.py): the first dispatch of a
         # NEW batch shape class recompiles the jitted step — record it
         # (jax.compiles_total / jax.compile_s / a `jax.compile` span) so
@@ -144,7 +205,8 @@ class TrainingOperator:
                     loss_fn, has_aux=True)(params, mstate, batch)
                 updates, opt_state = optimizer.update(grads, opt_state,
                                                       params)
-                params = jax.tree.map(lambda p, u: p + u, params, updates)
+                params = pin(jax.tree.map(lambda p, u: p + u, params,
+                                          updates))
                 return params, new_mstate, opt_state, loss
 
             def grad_step(params, mstate, batch):
@@ -160,7 +222,8 @@ class TrainingOperator:
                 loss, grads = jax.value_and_grad(loss_fn)(params, batch)
                 updates, opt_state = optimizer.update(grads, opt_state,
                                                       params)
-                params = jax.tree.map(lambda p, u: p + u, params, updates)
+                params = pin(jax.tree.map(lambda p, u: p + u, params,
+                                          updates))
                 return params, mstate, opt_state, loss
 
             def grad_step(params, mstate, batch):
@@ -177,6 +240,22 @@ class TrainingOperator:
             return jax.tree.map(lambda p, u: p + u, params, updates), opt_state
 
         self._apply_step = jax.jit(apply_step, donate_argnums=(0, 1))
+        if self._sharded:
+            ws = self.world_size
+            pad = self._pad_numel - self._numel
+
+            # The ZeRO step's local half: average the reduce-scattered
+            # grad shard, update THIS rank's 1/N of (params, opt state).
+            # Elementwise over the flat bucket, so it is bitwise the
+            # same arithmetic the replicated apply_step would do on
+            # these elements — the bit-exactness bar rests on this.
+            def shard_apply(pshard, opt_state, gshard):
+                g = gshard / ws
+                updates, opt_state = optimizer.update(g, opt_state, pshard)
+                return pshard + updates, opt_state
+
+            self._shard_apply = jax.jit(shard_apply, donate_argnums=(0, 1))
+            self._pad_grads = jax.jit(lambda g: jnp.pad(g, (0, pad)))
         # persistent AOT compile cache over the step seams: one
         # CachedFunction per (step name, batch shape class), keyed
         # additionally by a jaxpr hash of the USER computation
@@ -205,6 +284,38 @@ class TrainingOperator:
         # default (Trainer(quantize="int8")) applies to the wire here.
         avg = col.allreduce(flat_grads, group_name=self._group_name)
         return avg / self.world_size
+
+    def _reducescatter_grads(self, flat_grads: jax.Array):
+        """Sharded step, wire half 1: pad the flat grad bucket to the
+        shard layout and reduce-scatter it — each rank receives only the
+        summed span it will update, (w-1)/w * bucket bytes on the wire
+        instead of ~2x bucket for allreduce. The group's quantize
+        default (Trainer(quantize="int8")) drops it ~4x further."""
+        from ray_tpu._private import failpoints as _fp
+        from ray_tpu.collective import collective as col
+
+        if _fp.ARMED:
+            _fp.fire_strict("train.reducescatter")
+        padded = self._pad_grads(flat_grads)
+        if self.world_size == 1:
+            return padded  # whole (padded) bucket IS the rank's span
+        return col.reducescatter(padded, group_name=self._group_name)
+
+    def _allgather_params(self):
+        """Sharded step, wire half 2: every rank contributes its updated
+        param shard; concatenation (uniform spans, rank order) rebuilds
+        the padded flat bucket, trimmed + unraveled into self.params.
+        The gather relays exact bytes, so params stay bit-identical
+        across ranks even under a quantized (lossy) grad wire."""
+        if self.world_size == 1:
+            self.params = self._unravel(self._param_shard[:self._numel])
+            return
+        from ray_tpu.collective import collective as col
+
+        shards = col.allgather(np.asarray(self._param_shard),
+                               group_name=self._group_name)
+        flat = np.concatenate(shards)[:self._numel]
+        self.params = self._unravel(jnp.asarray(flat))
 
     # ------------------------------------------------------------------
     # train/validate loops (reference: training_operator.py:437 train_epoch)
@@ -253,7 +364,7 @@ class TrainingOperator:
             self.params, self.model_state, self.opt_state, loss = step(
                 self.params, self.model_state, self.opt_state, batch)
             return loss
-        if self.world_size == 1:
+        if self.world_size == 1 and not self._sharded:
             step = self._cached_step("fused", shape_key,
                                      self._fused_step, self._fused_donate)
             self.params, self.model_state, self.opt_state, loss = step(
@@ -262,6 +373,16 @@ class TrainingOperator:
         grad = self._cached_step("grad", shape_key, self._grad_step)
         loss, self.model_state, flat_grads = grad(
             self.params, self.model_state, batch)
+        if self._sharded:
+            # ZeRO schedule: reducescatter(grads) -> update local 1/N
+            # shard of (params, opt state) -> allgather(params).
+            gshard = self._reducescatter_grads(flat_grads)
+            apply = self._cached_step("shard-apply", "flat",
+                                      self._shard_apply, (0, 1))
+            self._param_shard, self.opt_state = apply(
+                self._param_shard, self.opt_state, jnp.asarray(gshard))
+            self._allgather_params()
+            return loss
         flat_grads = self._allreduce_grads(flat_grads)
         apply = self._cached_step("apply", "flat", self._apply_step,
                                   (0, 1))
@@ -273,16 +394,28 @@ class TrainingOperator:
                     profile_dir: str | None = None) -> dict:
         if self._train_loader is None:
             raise RuntimeError("no train_loader registered")
+        from ray_tpu.train import metrics as _tm
+
         if profile_dir:
             jax.profiler.start_trace(profile_dir)
         try:
             t0 = time.perf_counter()
             losses, samples = [], 0
             step = 0
+            t_step = t0
             for batch in self._train_loader:
+                # step_s spans loader wait + dispatch: together with
+                # ingest_wait_s (observed inside IngestStream's get)
+                # the pair answers "is training input-bound?"
                 losses.append(self._dispatch_batch(batch))
                 self.global_step += 1
-                samples += _batch_size(batch)
+                bs = _batch_size(batch)
+                samples += bs
+                if bs:
+                    _tm.TOKENS_TOTAL.inc(bs)
+                now = time.perf_counter()
+                _tm.STEP_S.observe(now - t_step)
+                t_step = now
                 step += 1
                 if num_steps is not None and step >= num_steps:
                     break
@@ -342,26 +475,85 @@ class TrainingOperator:
                 x = multihost_utils.process_allgather(x)
             return np.asarray(x)
 
-        return {
+        out = {
             "params": jax.tree.map(to_np, self.params),
             "model_state": (None if self.model_state is None
                             else jax.tree.map(to_np, self.model_state)),
-            "opt_state": jax.tree.map(to_np, self.opt_state),
             "epoch": self.epoch,
             "global_step": self.global_step,
         }
+        if self._sharded:
+            # no replicated opt blob exists in sharded mode — the state
+            # carries THIS rank's shard (train/sharding.py dict format)
+            out["sharded_update"] = True
+            out["opt_shard"] = self.opt_shard_state()
+        else:
+            out["opt_state"] = jax.tree.map(to_np, self.opt_state)
+        return out
 
     def load_state_dict(self, state: dict):
         self.params = jax.tree.map(jnp.asarray, state["params"])
         if state.get("model_state") is not None:
             self.model_state = jax.tree.map(jnp.asarray,
                                             state["model_state"])
-        self.opt_state = jax.tree.map(
-            lambda ref, x: jnp.asarray(x) if isinstance(
-                x, np.ndarray) else x,
-            self.opt_state, state["opt_state"])
+        if self._sharded:
+            if "opt_state" in state:
+                raise ValueError(
+                    "replicated checkpoint (full opt_state) cannot load "
+                    "into a sharded-update trainer; re-save it sharded "
+                    "or construct Trainer(sharded=False)")
+            # rebuild the local param shard from the restored params;
+            # the optimizer shard arrives separately (load_opt_shard,
+            # possibly resharded) unless this state happens to carry a
+            # geometry-matching shard (same-rank broadcast restore).
+            flat, _ = ravel_pytree(self.params)
+            self._param_shard = jnp.pad(
+                flat, (0, self._pad_numel - self._numel)
+            )[self._shard_lo:self._shard_hi]
+            sh = state.get("opt_shard")
+            if (sh is not None and sh["world_size"] == self.world_size
+                    and sh["rank"] == self.world_rank):
+                self.load_opt_shard(sh)
+        else:
+            if state.get("sharded_update"):
+                raise ValueError(
+                    "sharded checkpoint cannot load into an unsharded "
+                    "trainer; construct Trainer(sharded=True) or load "
+                    "the sharded manifest via Trainer.load()")
+            self.opt_state = jax.tree.map(
+                lambda ref, x: jnp.asarray(x) if isinstance(
+                    x, np.ndarray) else x,
+                self.opt_state, state["opt_state"])
         self.epoch = state["epoch"]
         self.global_step = state["global_step"]
+
+    def opt_shard_state(self) -> dict:
+        """This rank's optimizer-state shard in the train/sharding.py
+        dict format (numpy leaves) — the unit of sharded checkpoints and
+        elastic resharding."""
+        leaves = [np.asarray(x) if isinstance(x, (jnp.ndarray, np.ndarray))
+                  else x for x in jax.tree.leaves(self.opt_state)]
+        return {"rank": self.world_rank, "world_size": self.world_size,
+                "span": (self._shard_lo, self._shard_hi),
+                "numel": self._numel, "pad_numel": self._pad_numel,
+                "leaves": leaves}
+
+    def load_opt_shard(self, shard: dict):
+        """Install a shard produced by opt_shard_state (or
+        sharding.reshard_opt_shards) — geometry must match this rank."""
+        if (int(shard["world_size"]) != self.world_size
+                or tuple(shard["span"]) != (self._shard_lo, self._shard_hi)
+                or int(shard["numel"]) != self._numel):
+            raise ValueError(
+                f"optimizer shard geometry {shard['world_size']}x"
+                f"{tuple(shard['span'])} (numel {shard['numel']}) does "
+                f"not match rank {self.world_rank}: expected "
+                f"{self.world_size}x({self._shard_lo}, {self._shard_hi}) "
+                f"numel {self._numel}; reshard with "
+                "train.sharding.reshard_opt_shards first")
+        leaves = [jnp.asarray(x) if isinstance(x, np.ndarray) else x
+                  for x in shard["leaves"]]
+        self.opt_state = jax.tree.unflatten(self._opt_treedef, leaves)
 
 
 def _batch_size(batch) -> int:

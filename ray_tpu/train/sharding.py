@@ -1,0 +1,123 @@
+"""Cross-replica weight-update shard math (ZeRO; PAPERS.md "Automatic
+Cross-Replica Sharding of Weight Update in Data-Parallel Training",
+arXiv:2004.13336).
+
+Layout contract — shared by the operator's sharded step, the collective
+layer's quantized reducescatter fast path, and the checkpoint manifest:
+
+- the model's parameters ravel to ONE flat f32 bucket of `numel`
+  elements (jax.flatten_util.ravel_pytree order);
+- the bucket is zero-padded to ``pad_numel = ceil(numel / (world *
+  QUANT_BLOCK)) * world * QUANT_BLOCK`` so every rank owns one
+  *uniform*, QUANT_BLOCK-aligned span of ``pad_numel // world``
+  elements. Uniform spans keep the allgather of param shards on the
+  fast collective tiers (which require uniform geometry) and line the
+  reducescatter chunks up with the int8 block-scale grid, so
+  ``quantize="int8"`` engages with zero re-marshalling;
+- rank r's span is ``[r*S, (r+1)*S)`` with ``S = pad_numel // world``
+  — identical to np.array_split (the hub/ring/shm reducescatter
+  partition) because pad_numel divides evenly;
+- optimizer state is ``optimizer.init(param_shard)``: every array leaf
+  of the optax state is either a 1-D vector of exactly S elements
+  (shard-partitioned — momentum/adam moments) or smaller (replicated —
+  step counters, scalars). Resharding relies on exactly that shape
+  dichotomy.
+
+Reshard-on-resize contract: pad-region gradients are identically zero,
+so pad-region optimizer state stays at its zero init; merging shards
+and re-splitting to a new world size therefore reconstructs the exact
+state any world size would have reached (optimizers whose state init is
+not zeros_like — none in optax's common set — are outside the
+contract)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ray_tpu.collective.types import QUANT_BLOCK
+
+
+def padded_numel(numel: int, world: int) -> int:
+    """Smallest multiple of world * QUANT_BLOCK holding `numel`."""
+    if world < 1:
+        raise ValueError(f"world must be >= 1, got {world}")
+    unit = world * QUANT_BLOCK
+    return -(-numel // unit) * unit
+
+
+def shard_span(numel: int, world: int, rank: int) -> tuple[int, int]:
+    """Rank's [lo, hi) span of the padded flat bucket."""
+    s = padded_numel(numel, world) // world
+    return rank * s, (rank + 1) * s
+
+
+def shard_spans(numel: int, world: int) -> list[tuple[int, int]]:
+    return [shard_span(numel, world, r) for r in range(world)]
+
+
+def opt_nbytes(opt_state) -> int:
+    """Bytes held by the array leaves of an optimizer state (the
+    `train.optim_shard_bytes` gauge — 1/N of the replicated figure in
+    sharded mode)."""
+    import jax
+
+    total = 0
+    for leaf in jax.tree.leaves(opt_state):
+        nbytes = getattr(leaf, "nbytes", None)
+        if nbytes is not None:
+            total += int(nbytes)
+    return total
+
+
+def _is_partitioned(leaf, span_elems: int) -> bool:
+    return (isinstance(leaf, np.ndarray) and leaf.ndim == 1
+            and leaf.size == span_elems)
+
+
+def merge_opt_shards(shards: list[dict]) -> list:
+    """Merge per-rank shard states (``opt_shard_state()`` dicts, rank
+    order) back into full padded flat leaves: partitioned leaves
+    concatenate across ranks, replicated leaves come from rank 0."""
+    if not shards:
+        raise ValueError("no shards to merge")
+    order = sorted(shards, key=lambda s: s["rank"])
+    ranks = [s["rank"] for s in order]
+    if ranks != list(range(len(order))):
+        raise ValueError(f"shard set is not ranks 0..N-1: {ranks}")
+    span = order[0]["span"][1] - order[0]["span"][0]
+    merged = []
+    for j, leaf in enumerate(order[0]["leaves"]):
+        if _is_partitioned(leaf, span):
+            merged.append(np.concatenate([s["leaves"][j] for s in order]))
+        else:
+            merged.append(leaf)
+    return merged
+
+
+def reshard_opt_shards(shards: list[dict], new_world: int) -> list[dict]:
+    """Re-partition a saved/live shard set to `new_world` ranks — the
+    elastic-resize restore and any-world-size checkpoint load path.
+    Partitioned leaves are merged, trimmed to the real `numel`, then
+    zero-padded to the NEW pad_numel and split into uniform spans."""
+    if not shards:
+        raise ValueError("no shards to reshard")
+    numel = int(shards[0]["numel"])
+    merged = merge_opt_shards(shards)
+    old_span = shards[0]["span"][1] - shards[0]["span"][0]
+    new_pad = padded_numel(numel, new_world)
+    s = new_pad // new_world
+    out = []
+    for rank in range(new_world):
+        lo, hi = rank * s, (rank + 1) * s
+        leaves = []
+        for j, full in enumerate(merged):
+            if _is_partitioned(shards[0]["leaves"][j], old_span):
+                vec = np.zeros(new_pad, full.dtype)
+                vec[:numel] = full[:numel]
+                leaves.append(vec[lo:hi].copy())
+            else:
+                leaves.append(full)
+        out.append({"rank": rank, "world_size": new_world,
+                    "span": (lo, hi), "numel": numel,
+                    "pad_numel": new_pad, "leaves": leaves})
+    return out

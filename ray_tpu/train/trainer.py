@@ -19,8 +19,14 @@ import cloudpickle
 
 import ray_tpu
 from ray_tpu import exceptions as exc
+from ray_tpu._private import failpoints as _fp
 from ray_tpu._private import global_state
 from ray_tpu.collective.collective import CollectiveActorMixin
+
+# Sharded checkpoint manifest marker (Trainer.save/load): `path` holds a
+# small index dict with this format tag; params + per-rank optimizer
+# shards live in sibling files it names.
+_SHARDED_CKPT_FORMAT = "ray_tpu.sharded_ckpt"
 
 
 class TrainWorker(CollectiveActorMixin):
@@ -70,6 +76,41 @@ class TrainWorker(CollectiveActorMixin):
         snap = stats.snapshot().get(name)
         return float(snap["value"]) if snap else 0.0
 
+    def read_metric(self, name: str):
+        """Full metric snapshot (histograms/gauges, not just counter
+        values) — bench + ingest-wait gate readback."""
+        from ray_tpu._private import stats
+
+        return stats.snapshot().get(name)
+
+    def peak_rss(self) -> int:
+        """Peak RSS of this worker process in bytes (bench readback)."""
+        import resource
+        import sys
+
+        ru = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        return int(ru if sys.platform == "darwin" else ru * 1024)
+
+    def attach_ingest(self, dataset_actor, depth: int):
+        """Register a streaming loader over this rank's DatasetShard
+        actor: batches prefetch `depth` deep through the object plane
+        while the step computes (validation loader untouched)."""
+        from ray_tpu.train.ingest import IngestStream
+
+        op = self.operator
+        op.register_data(
+            train_loader=IngestStream(dataset_actor, depth,
+                                      lambda: op.epoch),
+            validation_loader=op._val_loader)
+        return True
+
+    def opt_shard_state(self):
+        return self.operator.opt_shard_state()
+
+    def load_opt_shard(self, shard):
+        self.operator.load_opt_shard(shard)
+        return True
+
     def sync_state(self, src_rank: int = 0):
         """Collectively broadcast the full training state from src_rank
         over the group's data plane (shm segment / pipelined ring for
@@ -113,8 +154,11 @@ class Trainer:
                  setup_timeout: float = 600.0,
                  quantize: str | None = None,
                  collective_transport: str = "auto",
-                 placement_strategy: str | None = "ICI_RING"):
-        """quantize="int8" makes the gradient-sync allreduce ride the
+                 placement_strategy: str | None = "ICI_RING",
+                 sharded: bool = False,
+                 mesh_mode: str | None = None,
+                 ingest=None):
+        """quantize="int8" makes the gradient-sync collective ride the
         block-scaled int8 wire format (EQuARX-style) on the tiers that
         have a wire — the collective DEVICE plane and the host TCP ring
         — cutting gradient bytes ~4x; state sync (broadcast) and
@@ -126,9 +170,52 @@ class Trainer:
         ranks land on ICI-neighboring nodes and the collective tier is
         DERIVED from the reservation (probe-free); clusters without
         topology coords degrade it to PACK at the GCS. None disables
-        the reservation entirely (pre-topology scheduling)."""
+        the reservation entirely (pre-topology scheduling).
+
+        sharded=True turns on the ZeRO weight-update schedule
+        (arXiv:2004.13336): reducescatter(grads) → optimizer update on
+        the local 1/N shard of (params, opt state) → allgather(params).
+        Optimizer memory per worker drops N×; with quantize="int8" the
+        grad wire drops ~4× on top. Checkpoints become per-rank shard
+        files behind an index manifest (save/load), and elastic resizes
+        re-partition the optimizer shards to the new world size instead
+        of re-broadcasting a replicated blob.
+
+        mesh_mode="fsdp" builds the topology-derived ('data','fsdp')
+        mesh (parallel.mesh.fsdp_mesh) inside each worker and shards
+        params over the fsdp axis — single-worker or multihost groups
+        only (host-backend data parallelism would not sync mesh-local
+        shards).
+
+        ingest: an ingest.IngestSpec — one DatasetShard actor per rank
+        streaming prefetched batches through the object plane
+        (train/ingest.py); replaces the operator's train_loader."""
         self._operator_cls = training_operator_cls
-        self._config = config or {}
+        self._config = dict(config or {})
+        self._sharded = bool(sharded)
+        if sharded:
+            if mesh_mode is not None:
+                raise ValueError(
+                    "sharded=True (host-collective ZeRO) and mesh_mode "
+                    "(XLA SPMD) are mutually exclusive update plans")
+            if self._config.get("multihost"):
+                raise ValueError(
+                    "sharded=True uses the HOST collective plane; "
+                    "multihost groups sync through XLA psum instead")
+            self._config["sharded_update"] = True
+        if mesh_mode is not None:
+            if mesh_mode != "fsdp":
+                raise ValueError(f"unknown mesh_mode {mesh_mode!r} "
+                                 "(expected 'fsdp' or None)")
+            if num_workers > 1 and not self._config.get("multihost"):
+                raise ValueError(
+                    "mesh_mode='fsdp' with multiple workers requires "
+                    "config={'multihost': True} (a GLOBAL mesh); "
+                    "host-backend workers would each build a private "
+                    "mesh and never sync")
+            self._config["mesh_mode"] = mesh_mode
+        self._ingest = ingest
+        self._ingest_actors: list = []
         self._quantize = quantize
         self._collective_transport = collective_transport
         self._placement_strategy = placement_strategy
@@ -148,6 +235,7 @@ class Trainer:
         self._uid = uuid.uuid4().hex[:8]
         self.workers: list = []
         self._last_state: dict | None = None
+        self._last_shards: list | None = None
         self._start_workers(num_workers)
 
     # ------------------------------------------------------------------
@@ -238,6 +326,39 @@ class Trainer:
         ray_tpu.get([w.setup_operator.remote() for w in self.workers],
                     timeout=self._setup_timeout)
         self._active_workers = num_workers
+        self._start_ingest(num_workers)
+        self._restore_state()
+
+    def _start_ingest(self, num_workers: int):
+        """One DatasetShard actor per rank; every generation re-shards
+        the dataset over the CURRENT world size (elastic resize included
+        — the survivors' shards re-cover the whole dataset)."""
+        if self._ingest is None:
+            return
+        from ray_tpu._private.config import get_config
+        from ray_tpu.train.ingest import DatasetShard
+
+        spec = self._ingest
+        depth = (spec.prefetch_depth if spec.prefetch_depth is not None
+                 else get_config().train_ingest_prefetch_depth)
+        shard_cls = ray_tpu.remote(
+            resources=dict(spec.resources or {"CPU": 1}))(DatasetShard)
+        fn_pickled = cloudpickle.dumps(spec.dataset_fn)
+        self._ingest_actors = [
+            shard_cls.remote(fn_pickled, rank, num_workers, self._config)
+            for rank in range(num_workers)]
+        ray_tpu.get([a.ping.remote() for a in self._ingest_actors],
+                    timeout=self._setup_timeout)
+        ray_tpu.get([w.attach_ingest.remote(a, depth)
+                     for w, a in zip(self.workers, self._ingest_actors)],
+                    timeout=self._setup_timeout)
+
+    def _restore_state(self):
+        """Re-install training state into a freshly started generation:
+        params/progress broadcast once over the data plane, then (in
+        sharded mode) per-rank optimizer shards — re-partitioned to the
+        new world size when it changed, never a replicated blob."""
+        num_workers = len(self.workers)
         if self._last_state is not None:
             if (num_workers > 1 and self._backend == "host"
                     and not self._config.get("multihost")):
@@ -254,14 +375,26 @@ class Trainer:
                 ray_tpu.get([w.load_state_dict.remote(self._last_state)
                              for w in self.workers],
                             timeout=self._setup_timeout)
+        if self._sharded and self._last_shards:
+            shards = self._last_shards
+            if len(shards) != num_workers:
+                if _fp.ARMED:
+                    _fp.fire_strict("train.reshard")
+                from ray_tpu.train import sharding as _shardlib
+
+                shards = _shardlib.reshard_opt_shards(shards, num_workers)
+            ray_tpu.get([w.load_opt_shard.remote(s)
+                         for w, s in zip(self.workers, shards)],
+                        timeout=self._setup_timeout)
 
     def _kill_workers(self):
-        for w in self.workers:
+        for w in self.workers + self._ingest_actors:
             try:
                 ray_tpu.kill(w)
             except Exception:
                 pass
         self.workers = []
+        self._ingest_actors = []
         # release the gang's bundles BEFORE the next generation reserves
         # its own — a lingering hold would starve the new reservation
         self._release_gang()
@@ -269,6 +402,14 @@ class Trainer:
     def _resize_worker_group(self):
         """Reference: torch_trainer.py:328 — shut the group down, restart
         at whatever size is currently schedulable, restore state."""
+        broken, _ = self._gang_interrupted()
+        if not broken and len(self.workers) == self._num_workers:
+            # No-op resize: the gang is intact at full strength — keep
+            # it. Restarting here would pay a redundant state broadcast
+            # and drop every warm compile cache for nothing (the old
+            # path did exactly that). Wedged-but-alive groups still
+            # terminate: the caller's retry budget bounds us.
+            return
         self._kill_workers()
         # Prefer the full size; shrink to what every resource type can hold.
         target = self._num_workers
@@ -305,7 +446,10 @@ class Trainer:
             draining = set()
         broken = False
         planned = True
-        for w in self.workers:
+        # ingest actors are part of the gang: a dead DatasetShard means
+        # its rank's stream is gone, so the generation restarts (and
+        # re-shards the dataset) exactly like a dead worker
+        for w in self.workers + self._ingest_actors:
             info = cw.get_actor_info(w._actor_id.binary())
             if info is None or info.get("state") == "DEAD":
                 broken = True
@@ -367,6 +511,14 @@ class Trainer:
         results = self._run_with_retries("train_epoch", num_steps, **kw)
         self._last_state = ray_tpu.get(self.workers[0].state_dict.remote(),
                                        timeout=120)
+        if self._sharded:
+            # the epoch-boundary snapshot is params (rank 0; identical
+            # everywhere) + ALL optimizer shards — the reshardable unit
+            # the elastic restore path consumes
+            self._last_state.pop("opt_shard", None)
+            self._last_shards = ray_tpu.get(
+                [w.opt_shard_state.remote() for w in self.workers],
+                timeout=120)
         return _reduce(results) if reduce_results else results
 
     def validate(self, num_steps: int | None = None,
@@ -387,13 +539,66 @@ class Trainer:
                     timeout=120)
 
     def save(self, path: str) -> str:
+        """Unsharded: one pickle, as before. Sharded: each worker's
+        optimizer shard returns through the object plane (plasma +, for
+        cross-node workers, the bulk transfer channel) and the driver
+        writes one file per shard plus a small index manifest at `path`
+        — no full replicated optimizer blob ever assembles anywhere."""
+        if not self._sharded:
+            with open(path, "wb") as f:
+                pickle.dump(self.state_dict(), f)
+            return path
+        import os
+
+        state = ray_tpu.get(self.workers[0].state_dict.remote(),
+                            timeout=120)
+        state.pop("opt_shard", None)
+        shard_refs = [w.opt_shard_state.remote() for w in self.workers]
+        params_file = os.path.basename(path) + ".params"
+        with open(path + ".params", "wb") as f:
+            pickle.dump(state, f)
+        spans, shard_files = [], []
+        for i, ref in enumerate(shard_refs):
+            sh = ray_tpu.get(ref, timeout=120)
+            spans.append(tuple(sh["span"]))
+            shard_files.append(os.path.basename(path) + f".shard{i}")
+            with open(f"{path}.shard{i}", "wb") as f:
+                pickle.dump(sh, f)
+            numel, pad_numel = sh["numel"], sh["pad_numel"]
+        manifest = {
+            "format": _SHARDED_CKPT_FORMAT, "version": 1,
+            "world_size": len(shard_files),
+            "numel": numel, "pad_numel": pad_numel, "spans": spans,
+            "epoch": state["epoch"], "global_step": state["global_step"],
+            "params_file": params_file, "shard_files": shard_files,
+        }
         with open(path, "wb") as f:
-            pickle.dump(self.state_dict(), f)
+            pickle.dump(manifest, f)
         return path
 
     def load(self, path: str):
+        """Loads either format; a sharded manifest reshards to the
+        CURRENT world size on the way in (any saved N → any running N)."""
         with open(path, "rb") as f:
-            self.load_state_dict(pickle.load(f))
+            blob = pickle.load(f)
+        if not (isinstance(blob, dict)
+                and blob.get("format") == _SHARDED_CKPT_FORMAT):
+            self.load_state_dict(blob)
+            return
+        if not self._sharded:
+            raise ValueError(
+                f"{path} is a sharded checkpoint manifest; load it with "
+                "Trainer(sharded=True)")
+        import os
+
+        base = os.path.dirname(os.path.abspath(path))
+        with open(os.path.join(base, blob["params_file"]), "rb") as f:
+            self._last_state = pickle.load(f)
+        self._last_shards = []
+        for sf in blob["shard_files"]:
+            with open(os.path.join(base, sf), "rb") as f:
+                self._last_shards.append(pickle.load(f))
+        self._restore_state()
 
     @property
     def num_workers(self) -> int:
@@ -409,6 +614,12 @@ class Trainer:
             except Exception:
                 pass
         self.workers = []
+        for a in self._ingest_actors:
+            try:
+                ray_tpu.kill(a)
+            except Exception:
+                pass
+        self._ingest_actors = []
         self._release_gang()
 
 

@@ -509,6 +509,7 @@ def test_metric_name_drift_gate(ray_start_regular):
     from ray_tpu.collective import metrics as _cmetrics  # noqa: F401
     from ray_tpu.gcs import shard           # noqa: F401
     from ray_tpu.raylet import transfer     # noqa: F401
+    from ray_tpu.train import metrics as _train_metrics  # noqa: F401
 
     @ray_tpu.remote
     def poke():
